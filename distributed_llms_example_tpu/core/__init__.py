@@ -1,0 +1,5 @@
+from distributed_llms_example_tpu.core.config import MeshConfig, TrainConfig
+from distributed_llms_example_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_llms_example_tpu.core.precision import Policy
+
+__all__ = ["MeshConfig", "TrainConfig", "MeshSpec", "build_mesh", "Policy"]

@@ -1,0 +1,199 @@
+"""Configuration for the framework.
+
+The reference exposes exactly six CLI parameters, identical across its three
+entry points (reference train-torchrun.py:182-188, train-accelerator.py:319-325,
+train-task.py:410-416): ``model-ckpt``, ``output-dir``, ``batch-size``,
+``num-epochs``, ``warmup-steps``, ``evaluation-steps``.  Two of them are dead
+in the reference (``batch-size`` is hardcoded away in train-accelerator.py:169
+and train-task.py:180; ``warmup-steps`` is overridden to 1 in
+train-accelerator.py:204) — here every flag is honored for real.
+
+On top of those six we add the knobs a TPU SPMD framework actually needs:
+mesh shape, precision policy, gradient accumulation, checkpointing cadence,
+and sequence lengths (the reference hardcodes 1024/128,
+train-accelerator.py:115-127).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh shape.
+
+    Axis semantics (order is physical-locality order; ``tensor`` is the
+    innermost / fastest-varying axis so tensor-parallel collectives ride the
+    shortest ICI links):
+
+    - ``data``:     pure data parallelism (batch sharding, params replicated)
+    - ``fsdp``:     data parallelism with parameters/optimizer sharded
+                    (ZeRO-3 equivalent; batch is also sharded over this axis)
+    - ``sequence``: sequence/context parallelism (activations sharded over
+                    the length dimension; ring attention)
+    - ``tensor``:   tensor (megatron-style) model parallelism
+
+    A value of -1 means "absorb all remaining devices" (at most one axis).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "sequence": self.sequence,
+            "tensor": self.tensor,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Checkpoint/resume policy.
+
+    The reference saves exactly once, at the end of training
+    (train-accelerator.py:277-280) and has no resume path (SURVEY.md §5);
+    periodic save + resume is an intentional capability add.
+    """
+
+    save_every_steps: int = 0  # 0 = only at end of training
+    keep: int = 3
+    resume: bool = True  # resume from latest checkpoint in output_dir if present
+    async_save: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    # --- the reference's six parameters (names + defaults from valohai.yaml:8-20) ---
+    model_ckpt: str = "t5-small"
+    output_dir: str = "/tmp/dllm-tpu-out"
+    batch_size: int = 8  # GLOBAL batch size (split across data×fsdp×sequence hosts)
+    num_epochs: int = 1
+    warmup_steps: int = 500
+    evaluation_steps: int = 500
+
+    # --- optimizer (reference: AdamW lr 5e-5, linear schedule, weight_decay
+    #     nominally 0.01 in variant A, train-torchrun.py:120) ---
+    learning_rate: float = 5e-5
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+    grad_accum_steps: int = 1  # reference variant A uses 16 (train-torchrun.py:126)
+    label_smoothing: float = 0.0
+
+    # --- data (reference hardcodes src 1024 / tgt 128, train-accelerator.py:115-127) ---
+    max_source_length: int = 1024
+    max_target_length: int = 128
+    source_column: str = "dialogue"  # with "article" fallback, per reference dual schema
+    target_column: str = "summary"  # with "highlights" fallback
+    shuffle_seed: int = 1234  # reference DataPartitioner seed (train-task.py:46)
+    pad_to_multiple: int = 128  # TPU-idiomatic version of pad_to_multiple_of=8
+
+    # --- precision / memory ---
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    remat: bool = False  # jax.checkpoint the transformer blocks
+
+    # --- eval/generation (reference live path: beams=2, max_length=128,
+    #     train-accelerator.py:239-242) ---
+    num_beams: int = 2
+    eval_max_new_tokens: int = 128
+    eval_batch_size: int = 0  # 0 = use batch_size
+
+    # --- logging (reference cadences: 10/300/100 steps; we default to 100) ---
+    log_every_steps: int = 100
+
+    # --- nested ---
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+
+    # --- tokenizer: path to HF tokenizer files, or "byte" for the built-in
+    #     network-free byte-level tokenizer ---
+    tokenizer: str = ""  # "" = try model_ckpt as a local path, else byte
+
+    def replace(self, **kw: Any) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+
+# Single source of defaults for the CLI layer: the dataclass itself.
+_D = TrainConfig()
+
+
+def add_reference_args(p: argparse.ArgumentParser) -> None:
+    """The six flags of the reference CLIs (train-torchrun.py:182-188), with
+    the same names surfaced by valohai.yaml:8-20."""
+    p.add_argument("--model-ckpt", type=str, default=_D.model_ckpt)
+    p.add_argument("--output-dir", type=str, default=_D.output_dir)
+    p.add_argument("--batch-size", type=int, default=_D.batch_size)
+    p.add_argument("--num-epochs", type=int, default=_D.num_epochs)
+    p.add_argument("--warmup-steps", type=int, default=_D.warmup_steps)
+    p.add_argument("--evaluation-steps", type=int, default=_D.evaluation_steps)
+
+
+def add_tpu_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--learning-rate", type=float, default=_D.learning_rate)
+    p.add_argument("--weight-decay", type=float, default=_D.weight_decay)
+    p.add_argument("--grad-accum-steps", type=int, default=_D.grad_accum_steps)
+    p.add_argument("--max-source-length", type=int, default=_D.max_source_length)
+    p.add_argument("--max-target-length", type=int, default=_D.max_target_length)
+    p.add_argument("--param-dtype", type=str, default=_D.param_dtype)
+    p.add_argument("--compute-dtype", type=str, default=_D.compute_dtype)
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--num-beams", type=int, default=_D.num_beams)
+    p.add_argument("--log-every-steps", type=int, default=_D.log_every_steps)
+    p.add_argument("--tokenizer", type=str, default=_D.tokenizer)
+    p.add_argument("--save-every-steps", type=int, default=_D.checkpoint.save_every_steps)
+    p.add_argument("--no-resume", action="store_true")
+    p.add_argument("--mesh", type=str, default="data=-1", help="comma list axis=size, e.g. data=2,fsdp=4,tensor=1")
+    # multi-host rendezvous (the triple consumed at reference train-task.py:421-425)
+    p.add_argument("--coordinator-address", type=str, default="")
+    p.add_argument("--num-processes", type=int, default=0)
+    p.add_argument("--process-id", type=int, default=-1)
+
+
+def parse_mesh_arg(spec: str) -> MeshConfig:
+    """Parse ``"data=2,fsdp=4"`` into a MeshConfig."""
+    kw: dict[str, int] = {}
+    if spec.strip():
+        for part in spec.split(","):
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k not in ("data", "fsdp", "sequence", "tensor"):
+                raise ValueError(f"unknown mesh axis {k!r}")
+            kw[k] = int(v)
+    # MeshConfig defaults data to -1 (wildcard); if the user put the wildcard
+    # on a different axis, pin data to 1 so there is exactly one wildcard.
+    if "data" not in kw:
+        kw["data"] = 1 if -1 in kw.values() else -1
+    return MeshConfig(**kw)
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    """Build a TrainConfig from an argparse namespace.
+
+    Only attributes actually present on the namespace are applied, so the
+    dataclass remains the single source of defaults (argparse defaults are
+    themselves read from the dataclass above).
+    """
+    present = vars(args)
+    fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    kw = {k: v for k, v in present.items() if k in fields and k not in ("mesh", "checkpoint")}
+    if "mesh" in present:
+        kw["mesh"] = parse_mesh_arg(present["mesh"])
+    ckpt_kw = {}
+    if "save_every_steps" in present:
+        ckpt_kw["save_every_steps"] = present["save_every_steps"]
+    if "no_resume" in present:
+        ckpt_kw["resume"] = not present["no_resume"]
+    if ckpt_kw:
+        kw["checkpoint"] = CheckpointConfig(**ckpt_kw)
+    return TrainConfig(**kw)

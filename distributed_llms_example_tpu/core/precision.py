@@ -1,0 +1,57 @@
+"""Mixed-precision policy.
+
+The reference runs fp32 everywhere (its only precision awareness is an fp16
+gate on collator padding, reference train-accelerator.py:158).  On TPU the
+native fast path is bfloat16 on the MXU: parameters and optimizer state stay
+float32, matmul/activation compute runs bf16, and loss/grad reductions are
+fp32.  This module is the single place that policy lives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def parse_dtype(name: str) -> jnp.dtype:
+    try:
+        return _DTYPES[name]
+    except KeyError:
+        raise ValueError(f"unknown dtype {name!r}; choose from {sorted(_DTYPES)}") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """What dtype each class of tensor uses.
+
+    - ``param_dtype``: dtype parameters are stored in (fp32 master weights)
+    - ``compute_dtype``: dtype activations/matmuls run in (bf16 on TPU)
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @classmethod
+    def from_names(cls, param: str = "float32", compute: str = "bfloat16") -> "Policy":
+        return cls(param_dtype=parse_dtype(param), compute_dtype=parse_dtype(compute))
+
+    def cast_to_compute(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def cast_to_param(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.param_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )

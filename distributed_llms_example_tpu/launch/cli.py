@@ -1,0 +1,35 @@
+"""Unified training CLI — replaces the reference's three entry-point scripts.
+
+Placeholder for the full trainer wiring (built in a later milestone); the
+argument surface (the reference's six flags plus TPU knobs) is already final.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from distributed_llms_example_tpu.core.config import (
+    add_reference_args,
+    add_tpu_args,
+    config_from_args,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dllm-train", description=__doc__)
+    add_reference_args(p)
+    add_tpu_args(p)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    print(cfg.to_json())
+    print("error: trainer not yet wired to the CLI (work in progress)", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+from distributed_llms_example_tpu.parallel.sharding import (
+    ShardingRules,
+    batch_sharding,
+    infer_param_shardings,
+    replicated,
+)
+
+__all__ = ["ShardingRules", "batch_sharding", "infer_param_shardings", "replicated"]

@@ -1,0 +1,3 @@
+from distributed_llms_example_tpu.utils.jsonlog import MetricLogger, log_json
+
+__all__ = ["MetricLogger", "log_json"]

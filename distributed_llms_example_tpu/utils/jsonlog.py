@@ -1,0 +1,71 @@
+"""JSON-lines metric emission — the Valohai metadata channel.
+
+The reference's observability contract is "print one JSON object per line to
+stdout; the platform parses it as execution metadata".  Three producers in
+the reference implement it (train-torchrun.py:144-147 PrinterCallback,
+train-accelerator.py:230-232 loss dumps, train-task.py:301-303), each with
+its own rank-noise control (non-main ranks silenced via log levels,
+train-accelerator.py:45-51).  Here there is one producer and it is
+process-0-only by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Mapping
+
+import jax
+
+
+def _to_scalar(v: Any) -> Any:
+    """Device arrays / numpy scalars → plain Python for json.dumps."""
+    if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+        v = v.item()
+    if isinstance(v, float):
+        return round(v, 6)
+    return v
+
+
+def log_json(metrics: Mapping[str, Any], *, all_processes: bool = False, file=None) -> None:
+    """Print ``metrics`` as a single JSON line from process 0 (parity with
+    the reference's PrinterCallback, train-torchrun.py:144-147, which strips
+    the ``total_flos`` noise key — callers here just don't add noise)."""
+    if not all_processes and jax.process_index() != 0:
+        return
+    out = {k: _to_scalar(v) for k, v in metrics.items()}
+    print(json.dumps(out), file=file or sys.stdout, flush=True)
+
+
+class MetricLogger:
+    """Step-cadence metric logger with tokens/sec accounting.
+
+    Cadence control replaces the reference's three hardcoded cadences
+    (10/300/100 steps — train-torchrun.py:122, train-accelerator.py:230,
+    train-task.py:301) with one configurable ``every``.
+    """
+
+    def __init__(self, every: int = 100):
+        self.every = max(1, int(every))
+        self._t0 = time.perf_counter()
+        self._tokens_since = 0
+        self._steps_since = 0
+
+    def step(self, step: int, loss: float, lr: float | None = None, tokens: int = 0, **extra: Any) -> None:
+        self._tokens_since += tokens
+        self._steps_since += 1
+        if step % self.every != 0:
+            return
+        dt = time.perf_counter() - self._t0
+        m: dict[str, Any] = {"step": step, "loss": loss}
+        if lr is not None:
+            m["learning_rate"] = lr
+        if dt > 0 and self._tokens_since:
+            m["tokens_per_sec"] = self._tokens_since / dt
+            m["steps_per_sec"] = self._steps_since / dt
+        m.update(extra)
+        log_json(m)
+        self._t0 = time.perf_counter()
+        self._tokens_since = 0
+        self._steps_since = 0

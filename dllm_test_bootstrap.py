@@ -1,0 +1,26 @@
+"""Early pytest bootstrap (loaded via ``-p dllm_test_bootstrap`` in addopts).
+
+Tests need JAX on an 8-device virtual CPU mesh, which requires
+``JAX_PLATFORMS=cpu`` and ``--xla_force_host_platform_device_count=8`` to be
+set before the interpreter initializes JAX.  Environments that register a
+TPU PJRT plugin from sitecustomize initialize JAX at interpreter startup, so
+the only reliable fix is to re-exec pytest once with a corrected
+environment.  This module is imported during pytest's pre-parse phase,
+before output capture starts, so the re-exec'ed process keeps the original
+stdout/stderr.
+"""
+
+import os
+import sys
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+
+if os.environ.get("_DLLM_TPU_TEST_REEXEC") != "1":
+    env = dict(os.environ)
+    env["_DLLM_TPU_TEST_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    if _FLAG not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+    # neutralize eager TPU-plugin registration done by sitecustomize
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)

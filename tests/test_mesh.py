@@ -1,0 +1,99 @@
+"""Mesh construction + multi-host fact resolution tests."""
+
+import os
+
+import jax
+import pytest
+
+from distributed_llms_example_tpu.core.config import MeshConfig
+from distributed_llms_example_tpu.core.mesh import (
+    _valohai_facts,
+    build_mesh,
+    device_report,
+    resolve_mesh_shape,
+)
+
+
+def test_resolve_wildcard():
+    spec = resolve_mesh_shape(MeshConfig(data=-1, fsdp=2, tensor=2), 8)
+    assert spec.as_tuple() == (2, 2, 1, 2)
+    assert spec.size == 8
+    assert spec.batch_shards == 4
+
+
+def test_resolve_exact():
+    spec = resolve_mesh_shape(MeshConfig(data=8, fsdp=1), 8)
+    assert spec.as_tuple() == (8, 1, 1, 1)
+
+
+def test_resolve_errors():
+    with pytest.raises(ValueError):
+        resolve_mesh_shape(MeshConfig(data=3, fsdp=2), 8)  # 6 != 8
+    with pytest.raises(ValueError):
+        resolve_mesh_shape(MeshConfig(data=-1, fsdp=3), 8)  # 8 % 3 != 0
+    with pytest.raises(ValueError, match="positive"):
+        resolve_mesh_shape(MeshConfig(data=-1, fsdp=0), 8)  # zero axis
+
+
+def test_build_mesh_axes(mesh8):
+    assert mesh8.axis_names == ("data", "fsdp", "sequence", "tensor")
+    assert mesh8.devices.size == 8
+
+
+def test_valohai_facts_from_env(monkeypatch):
+    monkeypatch.setenv("VH_MASTER_IP", "10.0.0.7")
+    monkeypatch.setenv("VH_WORLD_SIZE", "4")
+    monkeypatch.setenv("VH_RANK", "2")
+    assert _valohai_facts() == ("10.0.0.7", 4, 2)
+
+
+def test_valohai_facts_torchrun_compat(monkeypatch):
+    for k in ("VH_MASTER_IP", "VH_WORLD_SIZE", "VH_RANK"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.9")
+    monkeypatch.setenv("WORLD_SIZE", "2")
+    monkeypatch.setenv("RANK", "1")
+    assert _valohai_facts() == ("10.0.0.9", 2, 1)
+
+
+def test_valohai_facts_local_fallback(monkeypatch):
+    for k in ("VH_MASTER_IP", "VH_WORLD_SIZE", "VH_RANK", "MASTER_ADDR", "WORLD_SIZE", "RANK"):
+        monkeypatch.delenv(k, raising=False)
+    ip, world, rank = _valohai_facts()
+    assert world == 1 and rank is None
+
+
+def test_initialize_distributed_refuses_partial_facts(monkeypatch):
+    from distributed_llms_example_tpu.core.mesh import initialize_distributed
+
+    for k in ("VH_MASTER_IP", "VH_WORLD_SIZE", "VH_RANK", "MASTER_ADDR", "WORLD_SIZE", "RANK"):
+        monkeypatch.delenv(k, raising=False)
+    # multi-process without a coordinator must raise, not silently degrade
+    with pytest.raises(ValueError, match="coordinator"):
+        initialize_distributed(num_processes=4, process_id=1)
+    # multi-process without a rank must raise too
+    with pytest.raises(ValueError, match="process id"):
+        initialize_distributed(coordinator_address="10.0.0.1", num_processes=4)
+    # world size 1 is the local fallback: no error, no init
+    initialize_distributed(num_processes=1)
+
+
+def test_device_report():
+    rep = device_report()
+    assert rep["global_device_count"] == jax.device_count()
+    assert rep["backend"] == "cpu"
+    assert len(rep["devices"]) >= 1
+
+
+def test_mesh_config_parse():
+    from distributed_llms_example_tpu.core.config import parse_mesh_arg
+
+    cfg = parse_mesh_arg("data=2,fsdp=4")
+    assert cfg.data == 2 and cfg.fsdp == 4 and cfg.tensor == 1
+    cfg = parse_mesh_arg("")
+    assert cfg.data == -1
+    # wildcard on a non-data axis must not collide with data's default -1
+    cfg = parse_mesh_arg("tensor=-1")
+    assert cfg.data == 1 and cfg.tensor == -1
+    with pytest.raises(ValueError):
+        parse_mesh_arg("bogus=2")

@@ -1,0 +1,76 @@
+"""Sharding-rule tests: specs resolve, arrays actually land sharded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_llms_example_tpu.parallel.sharding import (
+    batch_sharding,
+    default_rules,
+    infer_param_shardings,
+    shard_params,
+)
+
+
+def _fake_params():
+    return {
+        "shared": {"embedding": jnp.zeros((64, 32))},
+        "encoder": {
+            "block_0": {
+                "self_attn": {
+                    "q_proj": {"kernel": jnp.zeros((32, 32))},
+                    "o_proj": {"kernel": jnp.zeros((32, 32))},
+                },
+                "mlp": {
+                    "wi": {"kernel": jnp.zeros((32, 128))},
+                    "wo": {"kernel": jnp.zeros((128, 32))},
+                },
+                "norm": {"scale": jnp.ones((32,))},
+            }
+        },
+    }
+
+
+def test_rule_specs():
+    rules = default_rules()
+    specs = rules.tree_specs(_fake_params())
+    assert specs["shared"]["embedding"] == P("tensor", "fsdp")
+    blk = specs["encoder"]["block_0"]
+    assert blk["self_attn"]["q_proj"]["kernel"] == P("fsdp", "tensor")
+    assert blk["self_attn"]["o_proj"]["kernel"] == P("tensor", "fsdp")
+    assert blk["mlp"]["wi"]["kernel"] == P("fsdp", "tensor")
+    assert blk["mlp"]["wo"]["kernel"] == P("tensor", "fsdp")
+    assert blk["norm"]["scale"] == P()
+
+
+def test_spec_clipped_to_rank():
+    rules = default_rules()
+    # a 1-D array matching a 2-D rule must get the spec truncated, not crash:
+    # P("fsdp", "tensor") clipped to rank 1 → P("fsdp")
+    assert rules.spec_for("encoder/block_0/self_attn/q_proj/kernel", 1) == P("fsdp")
+    # unmatched paths fall through to the replicated default
+    assert rules.spec_for("encoder/block_0/self_attn/q_proj/bias", 1) == P()
+
+
+def test_shard_params_places_arrays(mesh8):
+    params = _fake_params()
+    sharded = shard_params(params, mesh8)
+    emb = sharded["shared"]["embedding"]
+    # tensor axis = 2, fsdp axis = 2 → embedding split 2x2
+    shard_shapes = {s.data.shape for s in emb.addressable_shards}
+    assert shard_shapes == {(32, 16)}
+    # replicated norm scale: every shard is the full array
+    scale = sharded["encoder"]["block_0"]["norm"]["scale"]
+    assert {s.data.shape for s in scale.addressable_shards} == {(32,)}
+
+
+def test_batch_sharding_runs_collective(mesh8):
+    """A jitted mean over a batch sharded on (data, fsdp) must equal the
+    host-side mean — exercises the partitioner-inserted all-reduce that
+    replaces the reference's hand-rolled average_gradients."""
+    bs = batch_sharding(mesh8)
+    x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    xs = jax.device_put(x, bs)
+    got = jax.jit(lambda a: jnp.mean(a * 2.0))(xs)
+    np.testing.assert_allclose(np.asarray(got), (x * 2.0).mean(), rtol=1e-6)

@@ -1,0 +1,117 @@
+"""HF PyTorch checkpoint → framework parameter tree conversion.
+
+The reference gets weights through ``AutoModelForSeq2SeqLM.from_pretrained``
+(reference train-torchrun.py:35); this framework has its own model
+definitions, so checkpoints are converted once at load time: torch tensors
+→ numpy, ``nn.Linear`` weights transposed (torch stores (out, in), flax
+kernels are (in, out)), names remapped per model family.
+
+Works on a raw ``state_dict`` (no torch model construction needed), so it
+also serves local directories containing ``pytorch_model.bin`` or
+``model.safetensors``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def _set(tree: dict, path: str, value: np.ndarray) -> None:
+    keys = path.split("/")
+    node = tree
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = value
+
+
+# --- T5 -------------------------------------------------------------------
+
+_T5_LAYER = {
+    ("0", "SelfAttention"): "self_attn",
+    ("0", "layer_norm"): "self_attn_norm",
+    ("1", "EncDecAttention"): "cross_attn",
+    ("1", "layer_norm"): None,  # resolved by context: mlp_norm in encoder, cross_attn_norm in decoder
+    ("2", "DenseReluDense"): "mlp",
+    ("2", "layer_norm"): "mlp_norm",
+    ("1", "DenseReluDense"): "mlp",
+}
+
+_T5_PROJ = {"q": "q_proj", "k": "k_proj", "v": "v_proj", "o": "o_proj"}
+
+
+def convert_t5_state_dict(state_dict: Mapping[str, Any]) -> dict:
+    """HF ``T5ForConditionalGeneration`` state_dict → our param tree."""
+    params: dict = {}
+    for name, tensor in state_dict.items():
+        arr = _to_numpy(tensor)
+        if name == "shared.weight":
+            _set(params, "shared/embedding", arr)
+            continue
+        if name == "lm_head.weight":
+            _set(params, "lm_head/kernel", _t(arr))
+            continue
+        m = re.match(r"(encoder|decoder)\.final_layer_norm\.weight", name)
+        if m:
+            _set(params, f"{m.group(1)}/final_norm/scale", arr)
+            continue
+        m = re.match(r"(encoder|decoder)\.embed_tokens\.weight", name)
+        if m:
+            continue  # duplicate of shared.weight
+        m = re.match(
+            r"(encoder|decoder)\.block\.(\d+)\.layer\.(\d+)\.(SelfAttention|EncDecAttention|"
+            r"DenseReluDense|layer_norm)\.(.+)",
+            name,
+        )
+        if not m:
+            raise ValueError(f"unrecognized T5 parameter: {name}")
+        stack, block, layer_idx, kind, rest = m.groups()
+        is_decoder = stack == "decoder"
+        if kind == "SelfAttention" and rest == "relative_attention_bias.weight":
+            _set(params, f"{stack}/relative_attention_bias/embedding", arr)
+            continue
+        if kind in ("SelfAttention", "EncDecAttention"):
+            sub = "self_attn" if kind == "SelfAttention" else "cross_attn"
+            proj, _, leaf = rest.partition(".")
+            _set(params, f"{stack}/block_{block}/{sub}/{_T5_PROJ[proj]}/kernel", _t(arr))
+            continue
+        if kind == "DenseReluDense":
+            proj, _, leaf = rest.partition(".")
+            _set(params, f"{stack}/block_{block}/mlp/{proj}/kernel", _t(arr))
+            continue
+        # layer_norm: position depends on stack layout
+        if layer_idx == "0":
+            sub = "self_attn_norm"
+        elif layer_idx == "1":
+            sub = "cross_attn_norm" if is_decoder else "mlp_norm"
+        else:
+            sub = "mlp_norm"
+        _set(params, f"{stack}/block_{block}/{sub}/scale", arr)
+    return params
+
+
+# --- generic entry point --------------------------------------------------
+
+CONVERTERS: dict[str, Callable[[Mapping[str, Any]], dict]] = {
+    "t5": convert_t5_state_dict,
+}
+
+
+def convert_state_dict(family: str, state_dict: Mapping[str, Any]) -> dict:
+    try:
+        conv = CONVERTERS[family]
+    except KeyError:
+        raise ValueError(f"no converter for model family {family!r}; have {sorted(CONVERTERS)}") from None
+    return conv(state_dict)

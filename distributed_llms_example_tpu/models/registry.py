@@ -1,0 +1,133 @@
+"""Model registry: named configs + checkpoint loading.
+
+Stands in for ``AutoModelForSeq2SeqLM.from_pretrained(model_ckpt)``
+(reference train-torchrun.py:35): a name resolves to (a) a built-in config
+— sized to match the public checkpoints — plus random init, or (b) a local
+directory containing HF ``config.json`` + ``pytorch_model.bin`` /
+``model.safetensors``, which is converted into framework params.  There is
+no network path at all (the image has zero egress; weight download is the
+platform's job, mirroring how the reference receives datasets as Valohai
+inputs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llms_example_tpu.models import t5 as t5_mod
+from distributed_llms_example_tpu.models.convert import convert_state_dict
+from distributed_llms_example_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+# Built-in configs sized like the public checkpoints (dims from the public
+# HF config.json files; no weights are bundled).
+T5_CONFIGS: dict[str, T5Config] = {
+    "t5-test": T5Config(vocab_size=256, d_model=64, d_kv=16, d_ff=128, num_layers=2, num_heads=4),
+    "t5-small": T5Config(d_model=512, d_kv=64, d_ff=2048, num_layers=6, num_heads=8),
+    "t5-base": T5Config(d_model=768, d_kv=64, d_ff=3072, num_layers=12, num_heads=12),
+    "t5-large": T5Config(d_model=1024, d_kv=64, d_ff=4096, num_layers=24, num_heads=16),
+    "flan-t5-xl": T5Config(
+        d_model=2048,
+        d_kv=64,
+        d_ff=5120,
+        num_layers=24,
+        num_heads=32,
+        feed_forward_proj="gated-gelu",
+        tie_word_embeddings=False,
+    ),
+}
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    family: str
+    config: Any
+    module: Any  # the flax module (not bound)
+    params: Any | None  # None until init_params/load
+    is_seq2seq: bool = True
+
+    def init_params(self, rng: jax.Array | int = 0) -> Any:
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        if self.is_seq2seq:
+            dummy = jnp.ones((1, 8), jnp.int32)
+            variables = self.module.init(rng, dummy, jnp.ones_like(dummy), dummy)
+        else:
+            dummy = jnp.ones((1, 8), jnp.int32)
+            variables = self.module.init(rng, dummy)
+        return variables["params"]
+
+
+def _t5_from_hf_config(cfg: dict) -> T5Config:
+    return T5Config(
+        vocab_size=cfg["vocab_size"],
+        d_model=cfg["d_model"],
+        d_kv=cfg["d_kv"],
+        d_ff=cfg["d_ff"],
+        num_layers=cfg["num_layers"],
+        num_decoder_layers=cfg.get("num_decoder_layers"),
+        num_heads=cfg["num_heads"],
+        relative_attention_num_buckets=cfg.get("relative_attention_num_buckets", 32),
+        relative_attention_max_distance=cfg.get("relative_attention_max_distance", 128),
+        dropout_rate=cfg.get("dropout_rate", 0.1),
+        layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-6),
+        feed_forward_proj=cfg.get("feed_forward_proj", "relu").replace("gated-gelu_new", "gated-gelu"),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", True),
+        pad_token_id=cfg.get("pad_token_id", 0),
+        eos_token_id=cfg.get("eos_token_id", 1),
+        decoder_start_token_id=cfg.get("decoder_start_token_id", 0),
+    )
+
+
+def _load_local_state_dict(path: str) -> dict:
+    st_path = os.path.join(path, "model.safetensors")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file  # ships with transformers
+
+        return dict(load_file(st_path))
+    bin_path = os.path.join(path, "pytorch_model.bin")
+    if os.path.exists(bin_path):
+        import torch
+
+        return torch.load(bin_path, map_location="cpu", weights_only=True)
+    raise FileNotFoundError(f"no model.safetensors or pytorch_model.bin under {path}")
+
+
+def load_model(
+    name_or_path: str,
+    *,
+    dtype: jnp.dtype = jnp.float32,
+    remat: bool = False,
+    load_weights: bool = True,
+) -> LoadedModel:
+    """Resolve a model name or local HF checkpoint dir into a LoadedModel."""
+    if os.path.isdir(name_or_path):
+        with open(os.path.join(name_or_path, "config.json")) as f:
+            hf_cfg = json.load(f)
+        model_type = hf_cfg.get("model_type", "t5")
+        if model_type == "t5":
+            cfg = _t5_from_hf_config(hf_cfg)
+            module = T5ForConditionalGeneration(cfg, dtype=dtype, remat=remat)
+            params = None
+            if load_weights:
+                params = convert_state_dict("t5", _load_local_state_dict(name_or_path))
+                params = jax.tree.map(jnp.asarray, params)
+            return LoadedModel("t5", cfg, module, params)
+        raise ValueError(f"unsupported model_type {model_type!r} at {name_or_path}")
+    # short names: strip org prefixes like "google/"
+    short = name_or_path.rsplit("/", 1)[-1]
+    if short in T5_CONFIGS:
+        cfg = T5_CONFIGS[short]
+        module = T5ForConditionalGeneration(cfg, dtype=dtype, remat=remat)
+        return LoadedModel("t5", cfg, module, None)
+    raise ValueError(
+        f"unknown model {name_or_path!r}: not a local checkpoint dir and not one of {sorted(T5_CONFIGS)}"
+    )
+
+
+__all__ = ["LoadedModel", "load_model", "T5_CONFIGS", "t5_mod"]

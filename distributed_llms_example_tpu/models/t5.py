@@ -1,0 +1,390 @@
+"""T5 encoder-decoder, written TPU-first in flax.linen.
+
+Replaces the reference's opaque ``AutoModelForSeq2SeqLM.from_pretrained``
+(reference train-accelerator.py:40-41) with an in-repo model definition the
+sharding rules and Pallas kernels can see into.  Numerical semantics match
+HF T5 so converted checkpoints are drop-in (verified by parity tests):
+
+- RMSNorm (no mean subtraction, no bias), fp32 statistics
+- relative position bias added to attention scores, bias table shared
+  across layers (held once per stack, not per block 0 as HF stores it)
+- attention scores are NOT scaled by 1/sqrt(d_kv) — T5 folds that into init
+- pre-norm residual blocks; final stack norm
+- tied embeddings scale decoder output by d_model**-0.5 before the logits
+  projection; T5 v1.1 ("gated-gelu") unties and adds a separate lm_head
+
+Supports both T5 v1.0 (relu FFN, tied) and v1.1/flan (gated-gelu, untied).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_llms_example_tpu.ops.attention import (
+    NEG_INF,
+    dot_product_attention,
+    mask_to_bias,
+)
+from distributed_llms_example_tpu.ops.norms import RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: Optional[int] = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"  # or "gated-gelu"
+    tie_word_embeddings: bool = True
+    pad_token_id: int = 0
+    eos_token_id: int = 1
+    decoder_start_token_id: int = 0
+
+    @property
+    def decoder_layers(self) -> int:
+        return self.num_decoder_layers if self.num_decoder_layers is not None else self.num_layers
+
+    @property
+    def is_gated(self) -> bool:
+        return self.feed_forward_proj.startswith("gated")
+
+
+def relative_position_bucket(
+    relative_position: jnp.ndarray,
+    *,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jnp.ndarray:
+    """T5's log-bucketed relative position (kv_pos - q_pos) → bucket id."""
+    ret = jnp.zeros_like(relative_position)
+    if bidirectional:
+        num_buckets //= 2
+        ret += (relative_position > 0).astype(jnp.int32) * num_buckets
+        rel = jnp.abs(relative_position)
+    else:
+        rel = -jnp.minimum(relative_position, 0)
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    rel_f = jnp.maximum(rel.astype(jnp.float32), 1.0)
+    if_large = max_exact + (
+        jnp.log(rel_f / max_exact) / jnp.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    if_large = jnp.minimum(if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, rel, if_large)
+
+
+class T5Attention(nn.Module):
+    """Multi-head attention with optional causal masking and KV cache.
+
+    Cache protocol (flax "cache" collection): initialize zero-filled
+    full-length buffers with ``init_cache``, then each call with a
+    single-query-step writes k/v at ``cache_index`` and attends over the
+    prefix — the standard fixed-shape autoregressive decode under jit.
+    """
+
+    config: T5Config
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        cfg = self.config
+        inner = cfg.num_heads * cfg.d_kv
+        dense = lambda name: nn.Dense(inner, use_bias=False, dtype=self.dtype, name=name)  # noqa: E731
+        self.q_proj, self.k_proj, self.v_proj = dense("q_proj"), dense("k_proj"), dense("v_proj")
+        self.o_proj = nn.Dense(cfg.d_model, use_bias=False, dtype=self.dtype, name="o_proj")
+
+    def _split(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.config.num_heads, self.config.d_kv).transpose(0, 2, 1, 3)
+
+    def _merge(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, h, s, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    @nn.compact
+    def _cache_kv(self, key: jnp.ndarray, value: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, Any]:
+        """Append this step's k/v into the cache; returns full-length k/v and
+        the (pre-update) cache index."""
+        # At creation time (init with full-length dummy inputs) the buffers
+        # are allocated but NOT written: cache_index must stay 0 so the first
+        # real decode step writes at position 0.
+        is_initialized = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros, key.shape, key.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros, value.shape, value.dtype)
+        cache_index = self.variable("cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32))
+        idx = cache_index.value
+        if is_initialized:
+            # buffers are stored (batch, heads, max_len, head_dim); write at idx on axis 2
+            k = jax.lax.dynamic_update_slice(cached_k.value, key, (0, 0, idx, 0))
+            v = jax.lax.dynamic_update_slice(cached_v.value, value, (0, 0, idx, 0))
+            cached_k.value, cached_v.value = k, v
+            cache_index.value = idx + key.shape[2]
+        else:
+            k, v = cached_k.value, cached_v.value
+        return k, v, idx
+
+    def __call__(
+        self,
+        hidden: jnp.ndarray,
+        kv_hidden: jnp.ndarray | None = None,
+        bias: jnp.ndarray | None = None,
+        *,
+        use_cache: bool = False,
+    ) -> jnp.ndarray:
+        kv_src = hidden if kv_hidden is None else kv_hidden
+        q = self._split(self.q_proj(hidden))
+        k = self._split(self.k_proj(kv_src))
+        v = self._split(self.v_proj(kv_src))
+        if use_cache and self.causal:
+            k, v, idx = self._cache_kv(k, v)
+            # mask out cache slots beyond the current position
+            kv_len = k.shape[2]
+            q_len = q.shape[2]
+            pos = jnp.arange(kv_len)[None, None, None, :]
+            valid = pos <= (idx + q_len - 1)
+            causal = pos <= (idx + jnp.arange(q_len)[None, None, :, None])
+            step_bias = jnp.where(valid & causal, 0.0, NEG_INF)
+            bias = step_bias if bias is None else bias + step_bias
+        out = dot_product_attention(q, k, v, bias, scale=1.0, dtype=self.dtype)
+        return self.o_proj(self._merge(out))
+
+
+class T5MLP(nn.Module):
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        cfg = self.config
+        if cfg.is_gated:
+            gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=self.dtype, name="wi_0")(x)
+            lin = nn.Dense(cfg.d_ff, use_bias=False, dtype=self.dtype, name="wi_1")(x)
+            h = nn.gelu(gate, approximate=True) * lin
+        else:
+            h = nn.relu(nn.Dense(cfg.d_ff, use_bias=False, dtype=self.dtype, name="wi")(x))
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=self.dtype, name="wo")(h)
+
+
+class T5Block(nn.Module):
+    config: T5Config
+    causal: bool = False
+    has_cross: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        cfg = self.config
+        eps = cfg.layer_norm_epsilon
+        self.self_attn_norm = RMSNorm(epsilon=eps, dtype=self.dtype, name="self_attn_norm")
+        self.self_attn = T5Attention(cfg, causal=self.causal, dtype=self.dtype, name="self_attn")
+        if self.has_cross:
+            self.cross_attn_norm = RMSNorm(epsilon=eps, dtype=self.dtype, name="cross_attn_norm")
+            self.cross_attn = T5Attention(cfg, causal=False, dtype=self.dtype, name="cross_attn")
+        self.mlp_norm = RMSNorm(epsilon=eps, dtype=self.dtype, name="mlp_norm")
+        self.mlp = T5MLP(cfg, dtype=self.dtype, name="mlp")
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def __call__(
+        self,
+        hidden: jnp.ndarray,
+        self_bias: jnp.ndarray | None,
+        encoder_hidden: jnp.ndarray | None = None,
+        cross_bias: jnp.ndarray | None = None,
+        *,
+        deterministic: bool = True,
+        use_cache: bool = False,
+    ) -> jnp.ndarray:
+        h = self.self_attn(self.self_attn_norm(hidden), bias=self_bias, use_cache=use_cache)
+        hidden = hidden + self.dropout(h, deterministic=deterministic)
+        if self.has_cross:
+            h = self.cross_attn(self.cross_attn_norm(hidden), kv_hidden=encoder_hidden, bias=cross_bias)
+            hidden = hidden + self.dropout(h, deterministic=deterministic)
+        h = self.mlp(self.mlp_norm(hidden), deterministic=deterministic)
+        return hidden + self.dropout(h, deterministic=deterministic)
+
+
+class T5Stack(nn.Module):
+    config: T5Config
+    causal: bool = False  # True → decoder (causal self-attn + cross-attn)
+    dtype: jnp.dtype = jnp.float32
+    remat: bool = False
+
+    def setup(self) -> None:
+        cfg = self.config
+        n = cfg.decoder_layers if self.causal else cfg.num_layers
+        self.relative_attention_bias = nn.Embed(
+            cfg.relative_attention_num_buckets,
+            cfg.num_heads,
+            dtype=jnp.float32,
+            name="relative_attention_bias",
+        )
+        block = T5Block
+        if self.remat:
+            block = nn.remat(T5Block, static_argnums=())
+        self.blocks = [
+            block(cfg, causal=self.causal, has_cross=self.causal, dtype=self.dtype, name=f"block_{i}")
+            for i in range(n)
+        ]
+        self.final_norm = RMSNorm(epsilon=cfg.layer_norm_epsilon, dtype=self.dtype, name="final_norm")
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def position_bias(self, q_len: int, kv_len: int, offset: int | jnp.ndarray = 0) -> jnp.ndarray:
+        """(1, heads, q_len, kv_len) additive relative-position bias."""
+        cfg = self.config
+        q_pos = jnp.arange(q_len)[:, None] + offset
+        kv_pos = jnp.arange(kv_len)[None, :]
+        buckets = relative_position_bucket(
+            kv_pos - q_pos,
+            bidirectional=not self.causal,
+            num_buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance,
+        )
+        bias = self.relative_attention_bias(buckets)  # (q, kv, heads)
+        return bias.transpose(2, 0, 1)[None].astype(self.dtype)
+
+    def __call__(
+        self,
+        hidden: jnp.ndarray,
+        attention_mask: jnp.ndarray | None = None,
+        encoder_hidden: jnp.ndarray | None = None,
+        encoder_mask: jnp.ndarray | None = None,
+        *,
+        deterministic: bool = True,
+        use_cache: bool = False,
+        cache_offset: int | jnp.ndarray = 0,
+        max_kv_len: int | None = None,
+    ) -> jnp.ndarray:
+        q_len = hidden.shape[1]
+        if use_cache and self.causal:
+            # Incremental decoding: relative bias of the current step(s)
+            # against the full cache buffer (max_kv_len); masking of not-yet-
+            # written cache slots + causality is added inside T5Attention.
+            if max_kv_len is None:
+                raise ValueError("max_kv_len is required when decoding with a cache")
+            self_bias = self.position_bias(q_len, max_kv_len, offset=cache_offset)
+        else:
+            self_bias = self.position_bias(q_len, q_len)
+            if self.causal:
+                causal = jnp.tril(jnp.ones((q_len, q_len), dtype=bool))
+                self_bias = self_bias + jnp.where(causal, 0.0, NEG_INF)[None, None]
+            if attention_mask is not None:
+                self_bias = self_bias + mask_to_bias(attention_mask)
+        cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
+        hidden = self.dropout(hidden, deterministic=deterministic)
+        for blk in self.blocks:
+            hidden = blk(
+                hidden,
+                self_bias,
+                encoder_hidden,
+                cross_bias,
+                deterministic=deterministic,
+                use_cache=use_cache,
+            )
+        return self.dropout(self.final_norm(hidden), deterministic=deterministic)
+
+
+class T5ForConditionalGeneration(nn.Module):
+    """Full seq2seq model: encode + decode + LM head."""
+
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+    remat: bool = False
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.shared = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            embedding_init=nn.initializers.normal(1.0),
+            dtype=self.dtype,
+            name="shared",
+        )
+        self.encoder = T5Stack(cfg, causal=False, dtype=self.dtype, remat=self.remat, name="encoder")
+        self.decoder = T5Stack(cfg, causal=True, dtype=self.dtype, remat=self.remat, name="decoder")
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")
+
+    def encode(
+        self, input_ids: jnp.ndarray, attention_mask: jnp.ndarray | None = None, *, deterministic: bool = True
+    ) -> jnp.ndarray:
+        return self.encoder(
+            self.shared(input_ids), attention_mask=attention_mask, deterministic=deterministic
+        )
+
+    def _logits(self, hidden: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            hidden = hidden * (cfg.d_model**-0.5)
+            return hidden @ self.shared.embedding.astype(self.dtype).T
+        return self.lm_head(hidden)
+
+    def decode(
+        self,
+        decoder_input_ids: jnp.ndarray,
+        encoder_hidden: jnp.ndarray,
+        encoder_mask: jnp.ndarray | None = None,
+        decoder_attention_mask: jnp.ndarray | None = None,
+        *,
+        deterministic: bool = True,
+        use_cache: bool = False,
+        cache_offset: int | jnp.ndarray = 0,
+        max_kv_len: int | None = None,
+    ) -> jnp.ndarray:
+        hidden = self.shared(decoder_input_ids)
+        if use_cache:
+            hidden = self.decoder(
+                hidden,
+                encoder_hidden=encoder_hidden,
+                encoder_mask=encoder_mask,
+                deterministic=deterministic,
+                use_cache=True,
+                cache_offset=cache_offset,
+                max_kv_len=max_kv_len,
+            )
+        else:
+            hidden = self.decoder(
+                hidden,
+                attention_mask=decoder_attention_mask,
+                encoder_hidden=encoder_hidden,
+                encoder_mask=encoder_mask,
+                deterministic=deterministic,
+            )
+        return self._logits(hidden)
+
+    def __call__(
+        self,
+        input_ids: jnp.ndarray,
+        attention_mask: jnp.ndarray | None = None,
+        decoder_input_ids: jnp.ndarray | None = None,
+        decoder_attention_mask: jnp.ndarray | None = None,
+        *,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        enc = self.encode(input_ids, attention_mask, deterministic=deterministic)
+        return self.decode(
+            decoder_input_ids,
+            enc,
+            encoder_mask=attention_mask,
+            decoder_attention_mask=decoder_attention_mask,
+            deterministic=deterministic,
+        )
+
+
+def shift_right(labels: jnp.ndarray, decoder_start_token_id: int, pad_token_id: int) -> jnp.ndarray:
+    """Teacher-forcing decoder inputs from labels (HF shift_tokens_right
+    semantics: -100 label positions become pad)."""
+    shifted = jnp.roll(labels, 1, axis=-1).at[:, 0].set(decoder_start_token_id)
+    return jnp.where(shifted == -100, pad_token_id, shifted)

@@ -1,0 +1,61 @@
+"""Attention core shared by all model families.
+
+Replaces what the reference consumes as opaque CUDA/cuDNN kernels inside
+``model(**batch)`` (reference train-accelerator.py:220) with an explicit,
+TPU-shaped computation: one batched einsum onto the MXU for QK^T, fp32
+softmax, one einsum for the value contraction.  XLA fuses mask/bias/softmax
+into the surrounding matmuls; a Pallas flash-attention kernel
+(``ops/flash_attention.py``) is used for long sequences where materializing
+the (S, S) score matrix would be HBM-bound.
+
+Conventions: q/k/v are (batch, heads, q_len/kv_len, head_dim); ``bias`` is
+additive, broadcastable to (batch, heads, q_len, kv_len) and already
+encodes masking as large negative values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative mask value; safe in both fp32 and bf16
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    scale: float | None = None,
+    dtype: jnp.dtype | None = None,
+) -> jnp.ndarray:
+    """Plain softmax attention.
+
+    ``scale=None`` means 1/sqrt(head_dim); pass ``scale=1.0`` for T5, which
+    folds the scale into initialization and does NOT scale scores.
+    Softmax runs in float32 regardless of compute dtype.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    dtype = dtype or q.dtype
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
+
+
+def make_causal_bias(q_len: int, kv_len: int, offset: int = 0) -> jnp.ndarray:
+    """(1, 1, q_len, kv_len) additive causal mask; ``offset`` is the absolute
+    position of query 0 (for incremental decoding with a KV cache)."""
+    q_pos = jnp.arange(q_len)[:, None] + offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    mask = q_pos >= kv_pos
+    return jnp.where(mask, 0.0, NEG_INF)[None, None, :, :]
+
+
+def mask_to_bias(attention_mask: jnp.ndarray) -> jnp.ndarray:
+    """(batch, kv_len) {0,1} padding mask → (batch, 1, 1, kv_len) additive bias."""
+    return jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)

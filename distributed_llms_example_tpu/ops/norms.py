@@ -1,0 +1,44 @@
+"""Normalization layers.
+
+The compute dtype discipline matters on TPU: statistics are accumulated in
+float32 even when activations are bf16, then the result is cast back —
+matching what XLA's fused layernorm does and avoiding bf16 variance
+underflow.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RMSNorm(nn.Module):
+    """T5/LLaMA-style RMS normalization: no mean subtraction, no bias."""
+
+    epsilon: float = 1e-6
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jnp.reciprocal(jnp.sqrt(var + self.epsilon))
+        return (y * scale).astype(self.dtype)
+
+
+class LayerNorm(nn.Module):
+    """Standard layernorm (BART-style: with bias), fp32 statistics."""
+
+    epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + self.epsilon))
+        return (y * scale + bias).astype(self.dtype)

@@ -1,0 +1,64 @@
+"""Model registry: name resolution, local checkpoint loading, sharded init."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_llms_example_tpu.models.registry import T5_CONFIGS, load_model
+
+
+def test_builtin_names():
+    lm = load_model("t5-test")
+    assert lm.family == "t5" and lm.config.d_model == 64
+    params = lm.init_params(0)
+    assert params["shared"]["embedding"].shape == (256, 64)
+    # org prefixes are stripped
+    lm2 = load_model("google/flan-t5-xl", load_weights=False)
+    assert lm2.config.is_gated and not lm2.config.tie_word_embeddings
+
+
+def test_unknown_name_error():
+    with pytest.raises(ValueError, match="unknown model"):
+        load_model("gpt-42-enormous")
+
+
+def test_local_checkpoint_roundtrip(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=64, d_model=32, d_kv=8, d_ff=64, num_layers=1, num_decoder_layers=1, num_heads=4,
+        dropout_rate=0.0,
+    )
+    torch.manual_seed(1)
+    hf_model = transformers.T5ForConditionalGeneration(hf_cfg)
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    torch.save(hf_model.state_dict(), ckpt / "pytorch_model.bin")
+    (ckpt / "config.json").write_text(json.dumps({**hf_cfg.to_dict(), "model_type": "t5"}))
+
+    lm = load_model(str(ckpt))
+    assert lm.params is not None
+    ids = np.ones((1, 4), np.int32)
+    logits = lm.module.apply({"params": lm.params}, ids, np.ones_like(ids), ids)
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=torch.ones(1, 4, dtype=torch.long),
+            attention_mask=torch.ones(1, 4, dtype=torch.long),
+            decoder_input_ids=torch.ones(1, 4, dtype=torch.long),
+        ).logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=1e-4, rtol=1e-3)
+
+
+def test_sharded_init_on_mesh(mesh8):
+    """Params initialized then sharded by the default rules on an 8-device mesh."""
+    from distributed_llms_example_tpu.parallel.sharding import shard_params
+
+    lm = load_model("t5-test")
+    params = lm.init_params(0)
+    sharded = shard_params(params, mesh8)
+    emb = sharded["shared"]["embedding"]  # (256, 64) over (tensor=2, fsdp=2)
+    assert {s.data.shape for s in emb.addressable_shards} == {(128, 32)}
+    assert sorted(T5_CONFIGS) == ["flan-t5-xl", "t5-base", "t5-large", "t5-small", "t5-test"]

@@ -1,0 +1,130 @@
+"""Fixed-shape bucketed batching for TPU.
+
+The reference pads everything to ``max_length`` at tokenize time
+(train-accelerator.py:115-127, ``padding="max_length"``) — simple but
+wasteful: a 60-token dialogue burns a 1024-wide matmul row.  The dynamic
+padding of its ``DataCollatorForSeq2Seq`` (train-accelerator.py:155-159)
+is the other extreme and would recompile XLA programs at every new shape.
+
+The TPU-idiomatic middle ground: pad each batch to the smallest multiple
+of ``bucket_multiple`` that fits the longest example in the *global* batch
+(capped at the configured max).  The bucket is a deterministic function of
+the global batch, so every host picks the same shape, and the number of
+distinct compiled programs is bounded by max_len / bucket_multiple.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from distributed_llms_example_tpu.data.dataset import (
+    SummarizationDataset,
+    host_batch_slices,
+    iter_global_batches,
+)
+
+LABEL_PAD = -100  # loss-mask value, parity with HF label padding
+
+
+def bucket_len(max_len_in_batch: int, multiple: int, cap: int) -> int:
+    b = ((max(1, max_len_in_batch) + multiple - 1) // multiple) * multiple
+    return min(b, cap)
+
+
+def pad_2d(seqs: Sequence[Sequence[int]], width: int, pad_value: int) -> np.ndarray:
+    out = np.full((len(seqs), width), pad_value, dtype=np.int32)
+    for i, s in enumerate(seqs):
+        s = list(s)[:width]
+        out[i, : len(s)] = s
+    return out
+
+
+def make_batch(
+    ds: SummarizationDataset,
+    idx: np.ndarray,
+    *,
+    pad_id: int,
+    bucket_multiple: int = 128,
+    max_source_length: int = 1024,
+    max_target_length: int = 128,
+) -> dict[str, np.ndarray]:
+    """Assemble one (host-local or global) batch at bucketed fixed shapes."""
+    ex = [ds[int(i)] for i in idx]
+    src_w = bucket_len(max(len(e.input_ids) for e in ex), bucket_multiple, max_source_length)
+    tgt_w = bucket_len(max(len(e.labels) for e in ex), min(bucket_multiple, max_target_length), max_target_length)
+    input_ids = pad_2d([e.input_ids for e in ex], src_w, pad_id)
+    attention_mask = (input_ids != pad_id).astype(np.int32)
+    # pad_id may legitimately appear inside a sequence (byte tokenizer never
+    # emits it, HF pad ids don't occur mid-sequence) — mask from lengths instead
+    for i, e in enumerate(ex):
+        attention_mask[i, : min(len(e.input_ids), src_w)] = 1
+    labels = pad_2d([e.labels for e in ex], tgt_w, LABEL_PAD)
+    return {"input_ids": input_ids, "attention_mask": attention_mask, "labels": labels}
+
+
+class BatchIterator:
+    """Per-epoch iterator over host-local batches with global determinism.
+
+    Every host iterates the same global index stream; each materializes only
+    its slice (global_batch / process_count examples), but computes the
+    bucket from the full global batch so shapes agree across hosts.
+    """
+
+    def __init__(
+        self,
+        ds: SummarizationDataset,
+        *,
+        global_batch: int,
+        process_count: int = 1,
+        process_index: int = 0,
+        seed: int = 1234,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        bucket_multiple: int = 128,
+        max_source_length: int = 1024,
+        max_target_length: int = 128,
+    ):
+        self.ds = ds
+        self.global_batch = global_batch
+        self.process_count = process_count
+        self.process_index = process_index
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.bucket_multiple = bucket_multiple
+        self.max_source_length = max_source_length
+        self.max_target_length = max_target_length
+        self._slice = host_batch_slices(global_batch, process_count, process_index)
+
+    def steps_per_epoch(self) -> int:
+        steps, rem = divmod(len(self.ds), self.global_batch)
+        return steps + (1 if rem and not self.drop_last else 0)
+
+    def epoch(self, epoch: int) -> Iterator[dict[str, np.ndarray]]:
+        pad_id = self.ds.tokenizer.pad_id
+        for global_idx in iter_global_batches(
+            len(self.ds),
+            self.global_batch,
+            seed=self.seed,
+            epoch=epoch,
+            shuffle=self.shuffle,
+            drop_last=self.drop_last,
+        ):
+            # bucket from the GLOBAL batch (shape agreement across hosts)...
+            widths = [len(self.ds[int(i)].input_ids) for i in global_idx]
+            tgt_widths = [len(self.ds[int(i)].labels) for i in global_idx]
+            src_w = bucket_len(max(widths), self.bucket_multiple, self.max_source_length)
+            tgt_w = bucket_len(
+                max(tgt_widths), min(self.bucket_multiple, self.max_target_length), self.max_target_length
+            )
+            # ...materialize only the host-local slice
+            local_idx = global_idx[self._slice]
+            ex = [self.ds[int(i)] for i in local_idx]
+            input_ids = pad_2d([e.input_ids for e in ex], src_w, pad_id)
+            attention_mask = np.zeros_like(input_ids)
+            for i, e in enumerate(ex):
+                attention_mask[i, : min(len(e.input_ids), src_w)] = 1
+            labels = pad_2d([e.labels for e in ex], tgt_w, LABEL_PAD)
+            yield {"input_ids": input_ids, "attention_mask": attention_mask, "labels": labels}

@@ -1,0 +1,154 @@
+"""JSON summarization datasets + deterministic partitioning.
+
+Parity targets in the reference:
+
+- ``load_dataset('json', data_files={train,val})`` over ``train.json`` /
+  ``val.json`` placed next to the first Valohai input file
+  (reference train-torchrun.py:153-159) — here a plain loader that accepts
+  a JSON array, a JSONL file, or a {"data": [...]} wrapper;
+- the dual column schema: the live path reads ``dialogue``/``summary``
+  (train-task.py:158,164) while the dead eval path reads
+  ``article``/``highlights`` (train-task.py:125-126) — here both are
+  accepted, in that order;
+- ``DataPartitioner`` (train-task.py:45-62): seed-1234 shuffled index
+  split by fractional sizes with ``.use(rank)`` — re-implemented as a pure
+  function, plus the epoch-aware per-host sampler the reference lacks
+  (its variant C re-uses one fixed shard forever and every rank loads the
+  whole file, train-task.py:373-380).
+
+A C++ loader for large JSONL files lives in ``native/``; this module is the
+always-available Python path with the same semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from distributed_llms_example_tpu.data.tokenizer import Tokenizer
+
+SOURCE_COLUMNS = ("dialogue", "article", "document", "text")
+TARGET_COLUMNS = ("summary", "highlights", "target")
+
+
+def load_json_records(path: str) -> list[dict]:
+    """Load a JSON array / JSONL / {"data": [...]} file into records."""
+    with open(path, "r", encoding="utf-8") as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "[":
+            return json.load(f)
+        if head == "{":
+            first = json.loads(f.readline())
+            rest = [json.loads(line) for line in f if line.strip()]
+            if not rest and isinstance(first.get("data"), list):
+                return first["data"]
+            return [first, *rest]
+        raise ValueError(f"{path}: not a JSON array, JSONL, or data-wrapper file")
+
+
+def resolve_columns(record: dict, source_column: str = "", target_column: str = "") -> tuple[str, str]:
+    """Pick (source, target) column names, honoring explicit config first."""
+    src = source_column if source_column in record else next((c for c in SOURCE_COLUMNS if c in record), None)
+    tgt = target_column if target_column in record else next((c for c in TARGET_COLUMNS if c in record), None)
+    if src is None or tgt is None:
+        raise ValueError(
+            f"cannot find source/target columns in record keys {sorted(record)}; "
+            f"expected one of {SOURCE_COLUMNS} and {TARGET_COLUMNS}"
+        )
+    return src, tgt
+
+
+def partition_indices(n: int, sizes: Sequence[float], seed: int = 1234) -> list[list[int]]:
+    """Reference ``DataPartitioner`` semantics (train-task.py:45-62): seeded
+    shuffle, fractional split; partition k is ``use(k)``."""
+    idx = list(range(n))
+    random.Random(seed).shuffle(idx)
+    out: list[list[int]] = []
+    start = 0
+    for frac in sizes:
+        take = int(frac * n)
+        out.append(idx[start : start + take])
+        start += take
+    return out
+
+
+@dataclasses.dataclass
+class Example:
+    input_ids: list[int]
+    labels: list[int]
+
+
+class SummarizationDataset:
+    """Tokenized summarization examples with truncation (no padding here —
+    padding is the batcher's job so shapes can be bucketed)."""
+
+    def __init__(
+        self,
+        records: Sequence[dict],
+        tokenizer: Tokenizer,
+        *,
+        max_source_length: int = 1024,
+        max_target_length: int = 128,
+        source_column: str = "",
+        target_column: str = "",
+    ):
+        self.tokenizer = tokenizer
+        self.examples: list[Example] = []
+        if not records:
+            return
+        src_col, tgt_col = resolve_columns(dict(records[0]), source_column, target_column)
+        eos = tokenizer.eos_id
+        for r in records:
+            src = tokenizer.encode(str(r[src_col]))[: max_source_length - 1] + [eos]
+            tgt = tokenizer.encode(str(r[tgt_col]))[: max_target_length - 1] + [eos]
+            self.examples.append(Example(src, tgt))
+
+    def __len__(self) -> int:
+        return len(self.examples)
+
+    def __getitem__(self, i: int) -> Example:
+        return self.examples[i]
+
+
+def epoch_order(n: int, *, seed: int, epoch: int, shuffle: bool = True) -> np.ndarray:
+    """Deterministic global example order for an epoch — identical on every
+    host (the multi-host determinism the reference ducks, SURVEY.md §7
+    hard-part 3)."""
+    if not shuffle:
+        return np.arange(n)
+    rng = np.random.RandomState(seed + epoch)
+    return rng.permutation(n)
+
+
+def host_batch_slices(global_batch: int, process_count: int, process_index: int) -> slice:
+    """The contiguous slice of each global batch this host materializes."""
+    if global_batch % process_count != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {process_count} processes")
+    per = global_batch // process_count
+    return slice(process_index * per, (process_index + 1) * per)
+
+
+def iter_global_batches(
+    n: int,
+    global_batch: int,
+    *,
+    seed: int,
+    epoch: int,
+    shuffle: bool = True,
+    drop_last: bool = True,
+) -> Iterator[np.ndarray]:
+    """Yield index arrays of exactly ``global_batch`` per step, same on all
+    hosts.  With ``drop_last=False`` the final short batch wraps around to
+    the epoch start so shapes stay fixed (no recompilation)."""
+    order = epoch_order(n, seed=seed, epoch=epoch, shuffle=shuffle)
+    steps, rem = divmod(n, global_batch)
+    for s in range(steps):
+        yield order[s * global_batch : (s + 1) * global_batch]
+    if rem and not drop_last:
+        tail = order[steps * global_batch :]
+        yield np.concatenate([tail, order[: global_batch - rem]])

@@ -1,0 +1,79 @@
+"""Tokenizers.
+
+The reference downloads ``AutoTokenizer.from_pretrained(model_ckpt)`` from
+the HF hub (reference train-torchrun.py:34).  This framework runs in
+zero-egress environments, so tokenization is pluggable:
+
+- ``HFTokenizer`` wraps a tokenizer loaded from *local* files (a checkpoint
+  directory shipped as a platform input, the same mechanism the reference
+  uses for datasets);
+- ``ByteTokenizer`` is a dependency-free byte-level fallback (UTF-8 bytes
+  shifted past the special ids) that makes every pipeline runnable and
+  testable with no assets at all.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    pad_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + {pad=0, eos=1}; ids are byte+2."""
+
+    OFFSET = 2
+
+    def __init__(self) -> None:
+        self.pad_id = 0
+        self.eos_id = 1
+        self.vocab_size = 256 + self.OFFSET
+
+    def encode(self, text: str) -> list[int]:
+        return [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i - self.OFFSET for i in ids if i >= self.OFFSET)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """A Hugging Face tokenizer loaded from a local directory."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.pad_id = self._tok.pad_token_id if self._tok.pad_token_id is not None else 0
+        self.eos_id = self._tok.eos_token_id if self._tok.eos_token_id is not None else 1
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode([i for i in ids], skip_special_tokens=True)
+
+
+def get_tokenizer(spec: str, model_ckpt: str = "") -> Tokenizer:
+    """Resolve a tokenizer spec: explicit path > model checkpoint dir > byte."""
+    import os
+
+    if spec and spec != "byte":
+        return HFTokenizer(spec)
+    if spec == "byte":
+        return ByteTokenizer()
+    if model_ckpt and os.path.isdir(model_ckpt):
+        try:
+            return HFTokenizer(model_ckpt)
+        except Exception:
+            pass
+    return ByteTokenizer()

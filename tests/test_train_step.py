@@ -1,0 +1,136 @@
+"""Train-step tests on the 8-device mesh: loss decreases, grad accumulation
+is exact, schedules and decay masks behave."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_example_tpu.data.batching import LABEL_PAD
+from distributed_llms_example_tpu.models.registry import load_model
+from distributed_llms_example_tpu.parallel.sharding import shard_params
+from distributed_llms_example_tpu.train.optim import (
+    decay_mask,
+    linear_schedule_with_warmup,
+    make_optimizer,
+)
+from distributed_llms_example_tpu.train.step import (
+    create_train_state,
+    make_train_step,
+    put_batch,
+    state_shardings,
+)
+
+
+def _toy_batch(b=8, src=16, tgt=8, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    input_ids = rng.randint(2, vocab, (b, src)).astype(np.int32)
+    attn = np.ones((b, src), np.int32)
+    labels = rng.randint(2, vocab, (b, tgt)).astype(np.int32)
+    labels[:, -2:] = LABEL_PAD
+    return {"input_ids": input_ids, "attention_mask": attn, "labels": labels}
+
+
+@pytest.fixture(scope="module")
+def setup(request):
+    lm = load_model("t5-test")
+    # keep fixture params on host: device_put can alias CPU buffers, and a
+    # donating train step would delete them out from under later tests
+    params = jax.device_get(lm.init_params(0))
+    return lm, params
+
+
+def test_loss_decreases(mesh8, setup):
+    lm, params = setup
+    tx, schedule = make_optimizer(learning_rate=1e-3, warmup_steps=0, total_steps=1000)
+    build = make_train_step(lm.module, lm.config, tx, schedule, mesh8)
+    params = shard_params(params, mesh8)
+    state = create_train_state(params, tx)
+    sh = state_shardings(state, mesh8)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+    step, _ = build(state)
+    batch = put_batch(_toy_batch(), mesh8)
+    losses = []
+    for _ in range(12):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert int(jax.device_get(state.step)) == 12
+    assert float(metrics["target_tokens"]) == 8 * 6  # 2 label cols masked
+
+
+def test_grad_accum_matches_full_batch(mesh8, setup):
+    """grad_accum=4 over a batch must produce the same updated params as a
+    single full-batch step (token-weighted accumulation is exact).
+
+    Uses SGD so the param delta IS the accumulated gradient — Adam's
+    g/(|g|+eps) at step 1 amplifies fp summation-order noise for
+    near-zero gradient entries and would hide real errors behind a loose
+    tolerance.
+    """
+    import optax
+
+    lm, params = setup
+    tx = optax.sgd(1e-2)
+    schedule = lambda step: 1e-2  # noqa: E731
+    batch = _toy_batch(b=8)
+    # vary the mask so microbatches have different token counts
+    batch["labels"][0:2, 3:] = LABEL_PAD
+
+    outs = []
+    for accum in (1, 4):
+        build = make_train_step(
+            lm.module, lm.config, tx, schedule, mesh8, grad_accum_steps=accum, donate=False
+        )
+        state = create_train_state(shard_params(params, mesh8), tx)
+        sh = state_shardings(state, mesh8)
+        state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+        step, _ = build(state)
+        new_state, metrics = step(state, put_batch(batch, mesh8))
+        outs.append((jax.device_get(new_state.params), float(metrics["loss"])))
+    p1, l1 = outs[0]
+    p4, l4 = outs[1]
+    assert abs(l1 - l4) < 1e-5
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5)
+
+
+def test_schedule_shape():
+    s = linear_schedule_with_warmup(1e-4, warmup_steps=10, total_steps=110)
+    assert float(s(0)) == 0.0
+    assert float(s(10)) == pytest.approx(1e-4, rel=1e-6)  # fp32 schedule values
+    assert float(s(60)) == pytest.approx(5e-5, rel=1e-3)
+    assert float(s(110)) == pytest.approx(0.0, abs=1e-10)
+
+
+def test_decay_mask(setup):
+    lm, params = setup
+    mask = decay_mask(params)
+    assert mask["shared"]["embedding"] is True
+    blk = mask["encoder"]["block_0"]
+    assert blk["self_attn"]["q_proj"]["kernel"] is True
+    assert blk["self_attn_norm"]["scale"] is False
+
+
+def test_state_shardings_cover_opt_state(mesh8, setup):
+    lm, params = setup
+    tx, _ = make_optimizer()
+    state = create_train_state(params, tx)
+    sh = state_shardings(state, mesh8)
+    # adam moments of q_proj kernels must be sharded like the kernel itself
+    flat = jax.tree.leaves_with_path(sh)
+    qproj = [s for path, s in flat if "q_proj" in str(path)]
+    assert len(qproj) >= 3  # param + mu + nu
+    assert len({str(s) for s in qproj}) == 1
+
+
+def test_dropout_step_runs(mesh8, setup):
+    lm, params = setup
+    tx, schedule = make_optimizer(learning_rate=1e-3, warmup_steps=0, total_steps=100)
+    build = make_train_step(lm.module, lm.config, tx, schedule, mesh8, with_dropout=True)
+    state = create_train_state(shard_params(params, mesh8), tx)
+    sh = state_shardings(state, mesh8)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+    step, _ = build(state)
+    state, metrics = step(state, put_batch(_toy_batch(), mesh8), jax.random.PRNGKey(3))
+    assert np.isfinite(float(metrics["loss"]))

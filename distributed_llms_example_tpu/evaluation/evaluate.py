@@ -1,0 +1,124 @@
+"""The eval loop: jitted generation → decode → ROUGE → cross-host mean.
+
+Mirrors the reference eval pass (train-accelerator.py:237-268): per batch,
+``generate`` with beam search, pad/gather across ranks, replace label -100
+with pad, decode, feed ROUGE; then aggregate across processes.  Here the
+gather is unnecessary (each host scores its own slice and the means are
+averaged — exactly what ``synchronize_and_aggregate_metrics`` ends up
+computing in the reference), and generation is a fixed-shape jitted
+program instead of eager beam decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+
+from distributed_llms_example_tpu.data.batching import LABEL_PAD, BatchIterator
+from distributed_llms_example_tpu.data.dataset import SummarizationDataset
+from distributed_llms_example_tpu.data.tokenizer import Tokenizer
+from distributed_llms_example_tpu.evaluation import rouge as rouge_mod
+from distributed_llms_example_tpu.evaluation.generation import make_beam_search, make_greedy_generate
+from distributed_llms_example_tpu.evaluation.metrics import aggregate_mean
+from distributed_llms_example_tpu.train.step import put_batch
+
+
+def host_rows(arr: Any) -> np.ndarray:
+    """Rows of a batch-sharded global array owned by this host, as numpy.
+
+    Single-process: the whole array.  Multi-host: concatenation of this
+    host's addressable row shards (deduplicated across model-parallel
+    replicas) — the analog of the reference's ``accelerator.gather`` +
+    local slice (train-accelerator.py:257-258) without moving other hosts'
+    rows over DCN.
+    """
+    if jax.process_count() == 1:
+        return np.asarray(jax.device_get(arr))
+    by_start: dict[int, np.ndarray] = {}
+    for s in arr.addressable_shards:
+        start = s.index[0].start or 0
+        if start not in by_start:
+            by_start[start] = np.asarray(s.data)
+    return np.concatenate([by_start[k] for k in sorted(by_start)], axis=0)
+
+
+@dataclasses.dataclass
+class Evaluator:
+    model: Any
+    config: Any
+    tokenizer: Tokenizer
+    mesh: Any
+    num_beams: int = 2
+    max_new_tokens: int = 128
+    length_penalty: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.num_beams > 1:
+            gen = make_beam_search(
+                self.model, self.config, self.max_new_tokens, self.num_beams, self.length_penalty
+            )
+        else:
+            gen = make_greedy_generate(self.model, self.config, self.max_new_tokens)
+        self._generate = jax.jit(gen)
+
+    def _decode_batch(self, ids: np.ndarray) -> list[str]:
+        eos, pad = self.config.eos_token_id, self.config.pad_token_id
+        out = []
+        for row in ids:
+            toks = []
+            for t in row.tolist():
+                if t == eos:
+                    break
+                if t != pad:
+                    toks.append(t)
+            out.append(self.tokenizer.decode(toks))
+        return out
+
+    def run(
+        self,
+        params: Any,
+        ds: SummarizationDataset,
+        *,
+        global_batch: int,
+        bucket_multiple: int = 128,
+        max_source_length: int = 1024,
+    ) -> dict[str, float]:
+        it = BatchIterator(
+            ds,
+            global_batch=global_batch,
+            process_count=jax.process_count(),
+            process_index=jax.process_index(),
+            seed=0,
+            shuffle=False,
+            drop_last=False,
+            bucket_multiple=bucket_multiple,
+            max_source_length=max_source_length,
+            max_target_length=self.max_new_tokens,
+        )
+        per_host = global_batch // jax.process_count()
+        lo = jax.process_index() * per_host
+        n = len(ds)
+        preds: list[str] = []
+        refs: list[str] = []
+        seen = 0
+        for batch in it.epoch(0):
+            gb = put_batch({k: v for k, v in batch.items() if k != "labels"}, self.mesh)
+            out = self._generate(params, gb["input_ids"], gb["attention_mask"])
+            labels = batch["labels"]
+            labels = np.where(labels == LABEL_PAD, self.config.pad_token_id, labels)
+            if jax.process_count() == 1:
+                local_ids = host_rows(out)[lo : lo + per_host]
+            else:
+                local_ids = host_rows(out)
+            # final wraparound batch: trim rows that duplicate the epoch start
+            remaining = n - seen
+            valid_global = min(global_batch, remaining)
+            valid_here = int(np.clip(valid_global - lo, 0, per_host))
+            preds.extend(self._decode_batch(local_ids[:valid_here]))
+            refs.extend(self._decode_batch(labels[:valid_here]))
+            seen += global_batch
+        scores = rouge_mod.compute(preds, refs, use_stemmer=True)
+        return aggregate_mean(scores)

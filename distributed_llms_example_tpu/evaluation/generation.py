@@ -1,0 +1,166 @@
+"""Autoregressive generation under jit: greedy and beam search.
+
+The reference calls ``model.generate(max_length=128, num_beams=2)`` for its
+live eval loop (reference train-accelerator.py:239-249) and 8 beams in the
+dead test path (train-accelerator.py:95-101).  On TPU the decode loop must
+be a fixed-shape compiled program: full-length KV cache buffers are
+allocated up front, ``lax.fori_loop``/``while_loop`` steps write one token
+per iteration, and finished sequences keep "decoding" pad tokens so shapes
+never change.  Beam search keeps a flattened (batch × beams) leading dim so
+every step is one big MXU-friendly batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1.0e7
+
+
+def _init_cache(model: Any, params: Any, batch: int, max_len: int, enc: jnp.ndarray, enc_mask: jnp.ndarray):
+    """Zero cache buffers for a (batch, max_len) decode, via eval_shape (no
+    real forward pass)."""
+    dummy = jnp.zeros((batch, max_len), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda p: model.init(
+            jax.random.PRNGKey(0), dummy, enc, enc_mask, use_cache=True, max_kv_len=max_len, method="decode"
+        ),
+        params,
+    )
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+def make_greedy_generate(model: Any, config: Any, max_new_tokens: int) -> Callable:
+    """Jittable greedy decoding: (params, input_ids, attention_mask) → ids
+    of shape (batch, max_new_tokens), pad-filled after EOS."""
+
+    eos, pad, start = config.eos_token_id, config.pad_token_id, config.decoder_start_token_id
+    L = max_new_tokens
+
+    def generate(params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
+        B = input_ids.shape[0]
+        enc = model.apply({"params": params}, input_ids, attention_mask, method="encode")
+        cache = _init_cache(model, params, B, L, enc, attention_mask)
+
+        def step(t, carry):
+            cache, last, out, done = carry
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                last,
+                enc,
+                attention_mask,
+                use_cache=True,
+                cache_offset=t,
+                max_kv_len=L,
+                method="decode",
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, pad, nxt)
+            out = out.at[:, t].set(nxt)
+            done = done | (nxt == eos)
+            return mut["cache"], nxt[:, None], out, done
+
+        out = jnp.full((B, L), pad, jnp.int32)
+        last = jnp.full((B, 1), start, jnp.int32)
+        done = jnp.zeros((B,), bool)
+        _, _, out, _ = jax.lax.fori_loop(0, L, step, (cache, last, out, done))
+        return out
+
+    return generate
+
+
+def _gather_beams(tree: Any, beam_idx: jnp.ndarray, batch: int, beams: int) -> Any:
+    """Reorder the flattened (batch*beams, ...) leading dim by per-batch beam
+    indices (batch, beams)."""
+    flat_idx = (jnp.arange(batch)[:, None] * beams + beam_idx).reshape(-1)
+    return jax.tree.map(lambda x: x[flat_idx] if x.ndim > 0 else x, tree)
+
+
+def make_beam_search(
+    model: Any,
+    config: Any,
+    max_new_tokens: int,
+    num_beams: int = 2,
+    length_penalty: float = 1.0,
+) -> Callable:
+    """Jittable beam search matching HF ``generate(num_beams=K)`` semantics:
+    score = sum logprobs / (length ** length_penalty), finished beams
+    banked when EOS is chosen, best finished (or live) beam returned."""
+
+    eos, pad, start = config.eos_token_id, config.pad_token_id, config.decoder_start_token_id
+    K, L = num_beams, max_new_tokens
+
+    def generate(params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
+        B = input_ids.shape[0]
+        enc = model.apply({"params": params}, input_ids, attention_mask, method="encode")
+        # replicate encoder outputs per beam: (B*K, S, D)
+        enc_rep = jnp.repeat(enc, K, axis=0)
+        mask_rep = jnp.repeat(attention_mask, K, axis=0)
+        cache = _init_cache(model, params, B * K, L, enc_rep, mask_rep)
+
+        live_scores = jnp.tile(jnp.array([0.0] + [NEG_INF] * (K - 1), jnp.float32), (B, 1))
+        live_seqs = jnp.full((B, K, L), pad, jnp.int32)
+        fin_scores = jnp.full((B, K), NEG_INF, jnp.float32)
+        fin_seqs = jnp.full((B, K, L), pad, jnp.int32)
+        last = jnp.full((B * K, 1), start, jnp.int32)
+
+        def step(t, carry):
+            cache, last, live_scores, live_seqs, fin_scores, fin_seqs = carry
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                last,
+                enc_rep,
+                mask_rep,
+                use_cache=True,
+                cache_offset=t,
+                max_kv_len=L,
+                method="decode",
+                mutable=["cache"],
+            )
+            cache = mut["cache"]
+            logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)  # (B*K, V)
+            V = logp.shape[-1]
+            cand = live_scores[:, :, None] + logp.reshape(B, K, V)  # (B, K, V)
+            flat = cand.reshape(B, K * V)
+            top_scores, top_idx = jax.lax.top_k(flat, 2 * K)  # (B, 2K)
+            beam_idx = top_idx // V
+            token = (top_idx % V).astype(jnp.int32)
+
+            # candidate sequences with the new token written at position t
+            cand_seqs = jnp.take_along_axis(live_seqs, beam_idx[:, :, None], axis=1)  # (B, 2K, L)
+            cand_seqs = cand_seqs.at[:, :, t].set(token)
+
+            is_eos = token == eos
+            # bank finished candidates; HF normalizes by the sequence length
+            # at add-time = start token + t prior tokens = t+1
+            lp = jnp.asarray(t + 1, jnp.float32) ** length_penalty
+            fin_cand = jnp.where(is_eos, top_scores / lp, NEG_INF)
+            all_fin_scores = jnp.concatenate([fin_scores, fin_cand], axis=1)  # (B, 3K)
+            all_fin_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)  # (B, 3K, L)
+            fin_scores_new, fin_keep = jax.lax.top_k(all_fin_scores, K)
+            fin_seqs_new = jnp.take_along_axis(all_fin_seqs, fin_keep[:, :, None], axis=1)
+
+            # keep top-K live (non-eos) candidates
+            live_cand = jnp.where(is_eos, NEG_INF, top_scores)
+            live_scores_new, live_keep = jax.lax.top_k(live_cand, K)
+            live_seqs_new = jnp.take_along_axis(cand_seqs, live_keep[:, :, None], axis=1)
+            chosen_tokens = jnp.take_along_axis(token, live_keep, axis=1)  # (B, K)
+            parent_beams = jnp.take_along_axis(beam_idx, live_keep, axis=1)  # (B, K)
+
+            cache = _gather_beams(cache, parent_beams, B, K)
+            last = chosen_tokens.reshape(B * K, 1)
+            return cache, last, live_scores_new, live_seqs_new, fin_scores_new, fin_seqs_new
+
+        carry = (cache, last, live_scores, live_seqs, fin_scores, fin_seqs)
+        _, _, live_scores, live_seqs, fin_scores, fin_seqs = jax.lax.fori_loop(0, L, step, carry)
+
+        # if nothing finished for a batch row, fall back to best live beam
+        none_finished = jnp.all(fin_scores <= NEG_INF / 2, axis=1)
+        return jnp.where(none_finished[:, None], live_seqs[:, 0], fin_seqs[:, 0])
+
+    return generate

@@ -1,0 +1,34 @@
+"""Cross-process metric aggregation.
+
+Replaces the reference's two hand-rolled flavors — ``accelerator.gather`` +
+mean (train-accelerator.py:135-140) and per-key ``dist.all_gather`` into a
+tensor list + mean with ``epoch`` passed through (train-task.py:193-218) —
+with one function over JAX multihost utilities.  Single-process runs are
+the identity, so the same code path works everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import numpy as np
+
+PASSTHROUGH_KEYS = ("epoch", "step")  # parity with train-task.py:214 ('epoch' takes first)
+
+
+def aggregate_mean(metrics: Mapping[str, float]) -> dict[str, float]:
+    """Mean of each metric across processes (pass-through for epoch/step)."""
+    out = {k: float(v) for k, v in metrics.items()}
+    if jax.process_count() == 1:
+        return out
+    from jax.experimental import multihost_utils
+
+    keys = sorted(k for k in out if k not in PASSTHROUGH_KEYS)
+    if keys:
+        vec = np.asarray([out[k] for k in keys], np.float32)
+        gathered = multihost_utils.process_allgather(vec)  # (procs, n)
+        mean = np.mean(gathered, axis=0)
+        for k, v in zip(keys, mean):
+            out[k] = float(v)
+    return out

@@ -1,0 +1,126 @@
+"""ROUGE + Porter stemmer tests (hand-computed expectations)."""
+
+import pytest
+
+from distributed_llms_example_tpu.evaluation.rouge import (
+    compute,
+    porter_stem,
+    rouge_l,
+    rouge_n,
+    tokenize,
+)
+
+
+def test_porter_classic_examples():
+    # canonical examples from the Porter paper / reference implementations
+    cases = {
+        "caresses": "caress",
+        "ponies": "poni",
+        "cats": "cat",
+        "feed": "feed",
+        "agreed": "agre",
+        "plastered": "plaster",
+        "motoring": "motor",
+        "sing": "sing",
+        "conflated": "conflat",
+        "troubled": "troubl",
+        "sized": "size",
+        "hopping": "hop",
+        "falling": "fall",
+        "hissing": "hiss",
+        "failing": "fail",
+        "happy": "happi",
+        "relational": "relat",
+        "conditional": "condit",
+        "rational": "ration",
+        "digitizer": "digit",
+        "operator": "oper",
+        "feudalism": "feudal",
+        "decisiveness": "decis",
+        "hopefulness": "hope",
+        "formality": "formal",
+        "sensitivity": "sensit",
+        "triplicate": "triplic",
+        "formative": "form",
+        "formalize": "formal",
+        "electricity": "electr",
+        "electrical": "electr",
+        "hopeful": "hope",
+        "goodness": "good",
+        "revival": "reviv",
+        "allowance": "allow",
+        "inference": "infer",
+        "airliner": "airlin",
+        "adjustable": "adjust",
+        "defensible": "defens",
+        "irritant": "irrit",
+        "replacement": "replac",
+        "adjustment": "adjust",
+        "dependent": "depend",
+        "adoption": "adopt",
+        "communism": "commun",
+        "activate": "activ",
+        "angularity": "angular",
+        "homologous": "homolog",
+        "effective": "effect",
+        "bowdlerize": "bowdler",
+        "probate": "probat",
+        "rate": "rate",
+        "cease": "ceas",
+        "controll": "control",
+        "roll": "roll",
+    }
+    for w, want in cases.items():
+        assert porter_stem(w) == want, (w, porter_stem(w), want)
+
+
+def test_tokenize_stems_long_tokens_only():
+    # rouge_score stems only tokens longer than 3 chars: cats→cat, the/fast kept
+    assert tokenize("The cats RUNNING fast!") == ["the", "cat", "run", "fast"]
+    assert tokenize("cats") == ["cat"]
+    assert tokenize("cat") == ["cat"]
+    assert tokenize("runs") == ["run"]
+
+
+def test_rouge1_exact():
+    pred = tokenize("the cat sat", use_stemmer=False)
+    ref = tokenize("the cat sat on the mat", use_stemmer=False)
+    # overlap 3 (the, cat, sat); p=3/3, r=3/6 → f1 = 2*.5/1.5
+    assert rouge_n(pred, ref, 1) == pytest.approx(2 * 1.0 * 0.5 / 1.5)
+
+
+def test_rouge2_and_l():
+    pred = tokenize("a b c d", use_stemmer=False)
+    ref = tokenize("a b x c d", use_stemmer=False)
+    # bigrams pred: ab bc cd; ref: ab bx xc cd → overlap 2; p=2/3 r=2/4
+    assert rouge_n(pred, ref, 2) == pytest.approx(2 * (2 / 3) * 0.5 / ((2 / 3) + 0.5))
+    # LCS = a b c d (4); p=4/4 r=4/5
+    assert rouge_l(pred, ref) == pytest.approx(2 * 1.0 * 0.8 / 1.8)
+
+
+def test_identical_gets_one():
+    scores = compute(["the quick brown fox"], ["the quick brown fox"])
+    assert all(v == pytest.approx(1.0) for v in scores.values())
+
+
+def test_disjoint_gets_zero():
+    scores = compute(["aaa bbb"], ["ccc ddd"])
+    assert all(v == 0.0 for v in scores.values())
+
+
+def test_stemming_makes_match():
+    no_stem = compute(["running jumps"], ["run jumping"], use_stemmer=False)
+    stem = compute(["running jumps"], ["run jumping"], use_stemmer=True)
+    assert no_stem["rouge1"] == 0.0
+    assert stem["rouge1"] == pytest.approx(1.0)
+
+
+def test_rouge_lsum_newlines():
+    pred = "the cat sat\nthe dog ran"
+    ref = "the cat sat\nthe dog ran"
+    assert compute([pred], [ref])["rougeLsum"] == pytest.approx(1.0)
+
+
+def test_empty_inputs():
+    assert compute([], [])["rouge1"] == 0.0
+    assert compute([""], ["the cat"])["rouge1"] == 0.0

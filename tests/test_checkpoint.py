@@ -1,0 +1,108 @@
+"""Checkpoint/resume + Valohai sidecar tests."""
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from distributed_llms_example_tpu.io.checkpoint import Checkpointer, abstract_like
+from distributed_llms_example_tpu.io.valohai_meta import (
+    dataset_version_metadata,
+    get_run_identification,
+    save_valohai_metadata,
+)
+from distributed_llms_example_tpu.models.registry import load_model
+from distributed_llms_example_tpu.parallel.sharding import shard_params
+from distributed_llms_example_tpu.train.optim import make_optimizer
+from distributed_llms_example_tpu.train.step import create_train_state, state_shardings
+
+
+def _make_state(mesh):
+    lm = load_model("t5-test")
+    tx, _ = make_optimizer()
+    params = shard_params(jax.device_get(lm.init_params(0)), mesh)
+    state = create_train_state(params, tx)
+    sh = state_shardings(state, mesh)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh), sh
+
+
+def test_save_restore_roundtrip(tmp_path, mesh8):
+    state, sh = _make_state(mesh8)
+    ck = Checkpointer(str(tmp_path / "ckpt"), save_every_steps=10, async_save=False)
+    ck.save(10, state)
+    ck.save(20, state.replace(step=state.step + 20))
+    ck.wait()
+    assert ck.latest_step() == 20
+    restored, step = ck.restore_latest(abstract_like(state, sh))
+    assert step == 20
+    assert int(jax.device_get(restored.step)) == 20
+    a = jax.tree.leaves(jax.device_get(state.params))
+    b = jax.tree.leaves(jax.device_get(restored.params))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # restored arrays carry the mesh shardings
+    leaf = restored.params["shared"]["embedding"]
+    assert leaf.sharding == state.params["shared"]["embedding"].sharding
+    ck.close()
+
+
+def test_restore_latest_none_when_empty(tmp_path, mesh8):
+    state, sh = _make_state(mesh8)
+    ck = Checkpointer(str(tmp_path / "empty"), async_save=False)
+    assert ck.restore_latest(abstract_like(state, sh)) is None
+    ck.close()
+
+
+def test_retention(tmp_path, mesh8):
+    state, _ = _make_state(mesh8)
+    ck = Checkpointer(str(tmp_path / "keep"), save_every_steps=1, keep=2, async_save=False)
+    for s in (1, 2, 3, 4):
+        ck.save(s, state.replace(step=state.step * 0 + s))
+    ck.wait()
+    assert ck.latest_step() == 4
+    steps = sorted(ck.manager.all_steps())
+    assert steps == [3, 4]
+    ck.close()
+
+
+def test_should_save_cadence(tmp_path):
+    ck = Checkpointer(str(tmp_path / "c"), save_every_steps=50, async_save=False)
+    assert ck.should_save(50) and ck.should_save(100)
+    assert not ck.should_save(51)
+    ck.close()
+    ck2 = Checkpointer(str(tmp_path / "c2"), save_every_steps=0, async_save=False)
+    assert not ck2.should_save(50)  # end-of-training-only mode
+    ck2.close()
+
+
+def test_run_identification_fallback(tmp_path):
+    project, exec_id = get_run_identification(str(tmp_path / "missing.json"))
+    assert project == "test" and exec_id.isdigit()
+
+
+def test_run_identification_from_config(tmp_path):
+    cfg = tmp_path / "execution.json"
+    cfg.write_text(json.dumps({"valohai.project-name": "org/my-proj", "valohai.execution-id": "abc123"}))
+    assert get_run_identification(str(cfg)) == ("my-proj", "abc123")
+    md = dataset_version_metadata(str(cfg))
+    ver = md["valohai.dataset-versions"][0]
+    assert ver["uri"] == "dataset://llm-models/my-proj_abc123"
+    assert ver["valohai.tags"] == ["dev", "llm"]
+    assert ver["targeting_aliases"][0].startswith("dev-") and ver["targeting_aliases"][0].endswith("-model")
+
+
+def test_sidecars_written_and_idempotent(tmp_path):
+    out = tmp_path / "out"
+    out.mkdir()
+    (out / "model.safetensors").write_bytes(b"x")
+    (out / "config.json").write_text("{}")
+    written = save_valohai_metadata(str(out), str(tmp_path / "missing.json"))
+    assert sorted(os.path.basename(p) for p in written) == [
+        "config.json.metadata.json",
+        "model.safetensors.metadata.json",
+    ]
+    # second call must not produce .metadata.json.metadata.json
+    written2 = save_valohai_metadata(str(out), str(tmp_path / "missing.json"))
+    assert len(written2) == 2
+    assert len(list(out.iterdir())) == 4

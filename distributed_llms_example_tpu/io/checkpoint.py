@@ -42,6 +42,8 @@ class Checkpointer:
         return self.save_every_steps > 0 and step % self.save_every_steps == 0
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        if step in self.manager.all_steps():
+            return False  # e.g. re-saving the final step after a no-op resume
         return self.manager.save(step, args=ocp.args.StandardSave(state), force=force)
 
     def latest_step(self) -> int | None:

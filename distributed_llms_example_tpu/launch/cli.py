@@ -1,12 +1,22 @@
 """Unified training CLI — replaces the reference's three entry-point scripts.
 
-Placeholder for the full trainer wiring (built in a later milestone); the
-argument surface (the reference's six flags plus TPU knobs) is already final.
+One command serves all three of the reference's launch modes (SURVEY.md §7):
+
+- local / single host:   ``python -m distributed_llms_example_tpu.launch.cli
+                           --train-file train.json --val-file val.json``
+- multi-host (the train-task equivalent): same command per host; rendezvous
+  facts come from ``--coordinator-address/--num-processes/--process-id``,
+  the ``valohai.distributed`` platform config, or VH_*/torchrun env vars
+  (reference train-task.py:420-425 consumed the same triple);
+- Valohai step: dataset files resolve via ``valohai.inputs('dataset')``
+  exactly like the reference's ``run()`` functions
+  (reference train-torchrun.py:151-159) when no --train-file is given.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from distributed_llms_example_tpu.core.config import (
@@ -14,21 +24,59 @@ from distributed_llms_example_tpu.core.config import (
     add_tpu_args,
     config_from_args,
 )
+from distributed_llms_example_tpu.core.mesh import initialize_distributed
+from distributed_llms_example_tpu.data.dataset import load_json_records
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllm-train", description=__doc__)
     add_reference_args(p)
     add_tpu_args(p)
+    p.add_argument("--train-file", type=str, default="", help="path to train.json (JSON array or JSONL)")
+    p.add_argument("--val-file", type=str, default="", help="path to val.json")
+    p.add_argument("--source-column", type=str, default="")
+    p.add_argument("--target-column", type=str, default="")
+    p.add_argument("--dry-run", action="store_true", help="print resolved config and exit")
     return p
+
+
+def resolve_dataset_files(train_file: str, val_file: str) -> tuple[str, str]:
+    """Explicit paths win; otherwise resolve train.json/val.json beside the
+    first Valohai 'dataset' input (reference train-torchrun.py:152-159)."""
+    if train_file:
+        return train_file, val_file
+    try:
+        import valohai  # type: ignore
+
+        base = os.path.dirname(valohai.inputs("dataset").path())
+        return os.path.join(base, "train.json"), os.path.join(base, "val.json")
+    except Exception:
+        raise SystemExit(
+            "no --train-file given and no Valohai 'dataset' input available; "
+            "pass --train-file/--val-file"
+        ) from None
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
-    print(cfg.to_json())
-    print("error: trainer not yet wired to the CLI (work in progress)", file=sys.stderr)
-    return 2
+    if args.source_column:
+        cfg = cfg.replace(source_column=args.source_column)
+    if args.target_column:
+        cfg = cfg.replace(target_column=args.target_column)
+    if args.dry_run:
+        print(cfg.to_json())
+        return 0
+    initialize_distributed(args.coordinator_address, args.num_processes, args.process_id)
+    train_path, val_path = resolve_dataset_files(args.train_file, args.val_file)
+    train_records = load_json_records(train_path)
+    val_records = load_json_records(val_path) if val_path and os.path.exists(val_path) else None
+
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    trainer = Trainer(cfg, train_records=train_records, val_records=val_records)
+    trainer.train()
+    return 0
 
 
 if __name__ == "__main__":

@@ -203,10 +203,11 @@ class T5Block(nn.Module):
         self_bias: jnp.ndarray | None,
         encoder_hidden: jnp.ndarray | None = None,
         cross_bias: jnp.ndarray | None = None,
-        *,
         deterministic: bool = True,
         use_cache: bool = False,
     ) -> jnp.ndarray:
+        # deterministic/use_cache are positional so nn.remat can mark them
+        # static (argnums 5, 6 counting self at 0)
         h = self.self_attn(self.self_attn_norm(hidden), bias=self_bias, use_cache=use_cache)
         hidden = hidden + self.dropout(h, deterministic=deterministic)
         if self.has_cross:
@@ -233,7 +234,7 @@ class T5Stack(nn.Module):
         )
         block = T5Block
         if self.remat:
-            block = nn.remat(T5Block, static_argnums=())
+            block = nn.remat(T5Block, static_argnums=(5, 6))
         self.blocks = [
             block(cfg, causal=self.causal, has_cross=self.causal, dtype=self.dtype, name=f"block_{i}")
             for i in range(n)
@@ -285,14 +286,7 @@ class T5Stack(nn.Module):
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
         hidden = self.dropout(hidden, deterministic=deterministic)
         for blk in self.blocks:
-            hidden = blk(
-                hidden,
-                self_bias,
-                encoder_hidden,
-                cross_bias,
-                deterministic=deterministic,
-                use_cache=use_cache,
-            )
+            hidden = blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache)
         return self.dropout(self.final_norm(hidden), deterministic=deterministic)
 
 

@@ -1,0 +1,239 @@
+"""The unified Trainer — one SPMD core, three launch modes.
+
+The reference maintains three near-duplicate ~120-line ModelTrainer classes
+(reference train-torchrun.py:24, train-accelerator.py:29, train-task.py:72)
+because each distribution mechanism (torchrun-DDP / Accelerate / raw
+torch.distributed) imposes its own ceremony.  Under SPMD they are the same
+program at different mesh shapes, so this Trainer covers all three:
+
+- single process, many chips  (≈ torchrun / accelerate single host)
+- multi-host                  (≈ train-task; ``initialize_distributed``
+                                consumes the same Valohai triple)
+- single chip / CPU           (local dev)
+
+Capabilities the reference has that live here: epoch training loop with
+JSON-line loss logging (train-accelerator.py:217-232), periodic +
+end-of-epoch ROUGE eval (train-accelerator.py:237-268 — plus the
+``--evaluation-steps`` cadence the reference only honors in variant A),
+final save with Valohai sidecars (helpers.py).  Capabilities it lacks that
+live here too: periodic checkpointing with resume, bf16 policy, gradient
+accumulation everywhere, deterministic multi-host data sharding.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from distributed_llms_example_tpu.core.config import TrainConfig
+from distributed_llms_example_tpu.core.mesh import build_mesh, device_report
+from distributed_llms_example_tpu.core.precision import parse_dtype
+from distributed_llms_example_tpu.data.batching import BatchIterator
+from distributed_llms_example_tpu.data.dataset import SummarizationDataset
+from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
+from distributed_llms_example_tpu.evaluation.evaluate import Evaluator
+from distributed_llms_example_tpu.io.checkpoint import Checkpointer, abstract_like
+from distributed_llms_example_tpu.io.valohai_meta import save_valohai_metadata
+from distributed_llms_example_tpu.models.registry import load_model
+from distributed_llms_example_tpu.parallel.sharding import shard_params
+from distributed_llms_example_tpu.train.optim import make_optimizer
+from distributed_llms_example_tpu.train.step import (
+    create_train_state,
+    make_train_step,
+    put_batch,
+    state_shardings,
+)
+from distributed_llms_example_tpu.utils.jsonlog import MetricLogger, log_json
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: TrainConfig,
+        *,
+        train_records: Sequence[dict],
+        val_records: Sequence[dict] | None = None,
+        mesh: Any | None = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+        log_json({"event": "device_report", **device_report()})
+
+        self.tokenizer = get_tokenizer(cfg.tokenizer, cfg.model_ckpt)
+        compute_dtype = parse_dtype(cfg.compute_dtype)
+        self.loaded = load_model(cfg.model_ckpt, dtype=compute_dtype, remat=cfg.remat)
+        self.model, self.config = self.loaded.module, self.loaded.config
+
+        self.train_ds = SummarizationDataset(
+            train_records,
+            self.tokenizer,
+            max_source_length=cfg.max_source_length,
+            max_target_length=cfg.max_target_length,
+            source_column=cfg.source_column,
+            target_column=cfg.target_column,
+        )
+        self.val_ds = (
+            SummarizationDataset(
+                val_records,
+                self.tokenizer,
+                max_source_length=cfg.max_source_length,
+                max_target_length=cfg.max_target_length,
+                source_column=cfg.source_column,
+                target_column=cfg.target_column,
+            )
+            if val_records
+            else None
+        )
+
+        self.batches = BatchIterator(
+            self.train_ds,
+            global_batch=cfg.batch_size,
+            process_count=jax.process_count(),
+            process_index=jax.process_index(),
+            seed=cfg.shuffle_seed,
+            bucket_multiple=cfg.pad_to_multiple,
+            max_source_length=cfg.max_source_length,
+            max_target_length=cfg.max_target_length,
+        )
+        steps_per_epoch = self.batches.steps_per_epoch()
+        if steps_per_epoch == 0:
+            raise ValueError(
+                f"dataset of {len(self.train_ds)} examples is smaller than one "
+                f"global batch ({cfg.batch_size})"
+            )
+        self.total_steps = steps_per_epoch * cfg.num_epochs
+
+        self.tx, self.schedule = make_optimizer(
+            learning_rate=cfg.learning_rate,
+            weight_decay=cfg.weight_decay,
+            warmup_steps=cfg.warmup_steps,
+            total_steps=self.total_steps,
+            max_grad_norm=cfg.max_grad_norm,
+        )
+
+        params = self.loaded.params
+        if params is None:
+            params = jax.device_get(self.loaded.init_params(cfg.shuffle_seed))
+        params = shard_params(params, self.mesh)
+        self.state = create_train_state(params, self.tx)
+        self.state_sh = state_shardings(self.state, self.mesh)
+        self.state = jax.tree.map(lambda x, s: jax.device_put(x, s), self.state, self.state_sh)
+
+        self.use_dropout = self.config.dropout_rate > 0.0
+        build = make_train_step(
+            self.model,
+            self.config,
+            self.tx,
+            self.schedule,
+            self.mesh,
+            grad_accum_steps=cfg.grad_accum_steps,
+            label_smoothing=cfg.label_smoothing,
+            with_dropout=self.use_dropout,
+        )
+        self.train_step, _ = build(self.state)
+
+        self.checkpointer = Checkpointer(
+            os.path.join(cfg.output_dir, "checkpoints"),
+            save_every_steps=cfg.checkpoint.save_every_steps,
+            keep=cfg.checkpoint.keep,
+            async_save=cfg.checkpoint.async_save,
+        )
+        self.start_step = 0
+        if cfg.checkpoint.resume:
+            restored = self.checkpointer.restore_latest(abstract_like(self.state, self.state_sh))
+            if restored is not None:
+                self.state, self.start_step = restored
+                log_json({"event": "resumed", "step": self.start_step})
+
+        self.evaluator = (
+            Evaluator(
+                self.model,
+                self.config,
+                self.tokenizer,
+                self.mesh,
+                num_beams=cfg.num_beams,
+                max_new_tokens=cfg.eval_max_new_tokens,
+            )
+            if self.val_ds
+            else None
+        )
+        self._rng = jax.random.PRNGKey(cfg.shuffle_seed)
+
+    # ------------------------------------------------------------------
+
+    def evaluate(self, epoch: int | None = None) -> dict[str, float]:
+        if self.evaluator is None or self.val_ds is None:
+            return {}
+        eval_batch = self.cfg.eval_batch_size or self.cfg.batch_size
+        eval_batch = min(eval_batch, max(jax.process_count(), len(self.val_ds)))
+        scores = self.evaluator.run(
+            self.state.params,
+            self.val_ds,
+            global_batch=eval_batch,
+            bucket_multiple=self.cfg.pad_to_multiple,
+            max_source_length=self.cfg.max_source_length,
+        )
+        if epoch is not None:
+            scores["epoch"] = float(epoch)
+        log_json({"event": "eval", **scores})
+        return scores
+
+    def train(self) -> dict[str, Any]:
+        cfg = self.cfg
+        logger = MetricLogger(every=cfg.log_every_steps)
+        step = self.start_step
+        t0 = time.perf_counter()
+        last_eval: dict[str, float] = {}
+        steps_per_epoch = self.batches.steps_per_epoch()
+        start_epoch = step // steps_per_epoch
+        for epoch in range(start_epoch, cfg.num_epochs):
+            for i, batch in enumerate(self.batches.epoch(epoch)):
+                if epoch == start_epoch and i < step - start_epoch * steps_per_epoch:
+                    continue  # fast-forward within the resumed epoch
+                gb = put_batch(batch, self.mesh)
+                if self.use_dropout:
+                    self._rng, sub = jax.random.split(self._rng)
+                    self.state, metrics = self.train_step(self.state, gb, sub)
+                else:
+                    self.state, metrics = self.train_step(self.state, gb)
+                step += 1
+                tokens = int(np.sum(batch["attention_mask"])) * jax.process_count()
+                logger.step(
+                    step,
+                    float(metrics["loss"]),
+                    lr=float(metrics["learning_rate"]),
+                    tokens=tokens,
+                    epoch=epoch,
+                )
+                if self.checkpointer.should_save(step):
+                    self.checkpointer.save(step, self.state)
+                if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
+                    last_eval = self.evaluate(epoch)
+            last_eval = self.evaluate(epoch)  # per-epoch eval, reference parity
+        self.checkpointer.save(self.total_steps, self.state, force=True)
+        self.checkpointer.wait()
+        self.save_final()
+        wall = time.perf_counter() - t0
+        log_json({"event": "done", "steps": step, "wall_seconds": wall})
+        return {"steps": step, "wall_seconds": wall, "final_eval": last_eval}
+
+    def save_final(self) -> None:
+        """Final artifact export + Valohai sidecars (helpers.py parity)."""
+        out = os.path.join(self.cfg.output_dir, "model")
+        if jax.process_index() == 0:
+            os.makedirs(out, exist_ok=True)
+            with open(os.path.join(out, "config.json"), "w") as f:
+                f.write(self.cfg.to_json())
+        import orbax.checkpoint as ocp
+
+        params_dir = os.path.join(out, "params")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(params_dir), jax.device_get(self.state.params), force=True)
+        ckptr.wait_until_finished()
+        ckptr.close()
+        if jax.process_index() == 0:
+            save_valohai_metadata(out)

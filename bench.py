@@ -82,16 +82,26 @@ def main() -> None:
     step_fn, _ = build(state)
     gb = put_batch(b, mesh)
 
+    # Sync via host readbacks: on tunneled/experimental PJRT backends
+    # block_until_ready can return before execution finishes, which would
+    # report absurd throughput.  A scalar device_get of the loss plus one
+    # updated parameter element forces the full step chain.
+    def sync(state, metrics) -> float:
+        leaf = jax.tree.leaves(state.params)[0]
+        _ = jax.device_get(leaf.ravel()[0])
+        return float(jax.device_get(metrics["loss"]))
+
     # warmup/compile
     for _ in range(2):
         state, metrics = step_fn(state, gb)
-    jax.block_until_ready(metrics["loss"])
+    sync(state, metrics)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step_fn(state, gb)
-    jax.block_until_ready(metrics["loss"])
+    loss = sync(state, metrics)
     dt = time.perf_counter() - t0
+    assert loss == loss, "non-finite loss"
 
     tokens_per_step = int(np.sum(b["attention_mask"])) + int(np.sum(b["labels"] != LABEL_PAD))
     tps = tokens_per_step * steps / dt

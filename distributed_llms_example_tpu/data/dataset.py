@@ -115,6 +115,50 @@ class SummarizationDataset:
         return self.examples[i]
 
 
+@dataclasses.dataclass
+class CausalExample:
+    input_ids: list[int]  # prompt + target (+ eos)
+    labels: list[int]  # -100 over the prompt, target ids over the target
+    prompt_ids: list[int]
+    target_ids: list[int]
+
+
+class CausalLMDataset:
+    """Instruction-tuning examples for decoder-only models (BASELINE.json
+    config 5: llama-2-7b causal-LM fine-tune): source and target are
+    concatenated, the loss is masked over the prompt."""
+
+    def __init__(
+        self,
+        records: Sequence[dict],
+        tokenizer: Tokenizer,
+        *,
+        max_length: int = 1024,
+        max_target_length: int = 256,
+        source_column: str = "",
+        target_column: str = "",
+    ):
+        self.tokenizer = tokenizer
+        self.examples: list[CausalExample] = []
+        if not records:
+            return
+        src_col, tgt_col = resolve_columns(dict(records[0]), source_column, target_column)
+        eos = tokenizer.eos_id
+        for r in records:
+            tgt = tokenizer.encode(str(r[tgt_col]))[: max_target_length - 1] + [eos]
+            max_prompt = max(1, max_length - len(tgt))
+            src = tokenizer.encode(str(r[src_col]))[:max_prompt]
+            ids = src + tgt
+            labels = [-100] * len(src) + tgt
+            self.examples.append(CausalExample(ids, labels, src, tgt))
+
+    def __len__(self) -> int:
+        return len(self.examples)
+
+    def __getitem__(self, i: int) -> CausalExample:
+        return self.examples[i]
+
+
 def epoch_order(n: int, *, seed: int, epoch: int, shuffle: bool = True) -> np.ndarray:
     """Deterministic global example order for an epoch — identical on every
     host (the multi-host determinism the reference ducks, SURVEY.md §7

@@ -21,7 +21,11 @@ from distributed_llms_example_tpu.data.batching import LABEL_PAD, BatchIterator
 from distributed_llms_example_tpu.data.dataset import SummarizationDataset
 from distributed_llms_example_tpu.data.tokenizer import Tokenizer
 from distributed_llms_example_tpu.evaluation import rouge as rouge_mod
-from distributed_llms_example_tpu.evaluation.generation import make_beam_search, make_greedy_generate
+from distributed_llms_example_tpu.evaluation.generation import (
+    make_beam_search,
+    make_causal_greedy,
+    make_greedy_generate,
+)
 from distributed_llms_example_tpu.evaluation.metrics import aggregate_mean
 from distributed_llms_example_tpu.train.step import put_batch
 
@@ -54,9 +58,14 @@ class Evaluator:
     num_beams: int = 2
     max_new_tokens: int = 128
     length_penalty: float = 1.0
+    is_seq2seq: bool = True
 
     def __post_init__(self) -> None:
-        if self.num_beams > 1:
+        if not self.is_seq2seq:
+            # decoder-only models: prefill+decode greedy (beam search for
+            # causal models is future work; num_beams is ignored)
+            gen = make_causal_greedy(self.model, self.config, self.max_new_tokens)
+        elif self.num_beams > 1:
             gen = make_beam_search(
                 self.model, self.config, self.max_new_tokens, self.num_beams, self.length_penalty
             )
@@ -86,6 +95,11 @@ class Evaluator:
         bucket_multiple: int = 128,
         max_source_length: int = 1024,
     ) -> dict[str, float]:
+        if not self.is_seq2seq:
+            return self._run_causal(
+                params, ds, global_batch=global_batch, bucket_multiple=bucket_multiple,
+                max_source_length=max_source_length,
+            )
         it = BatchIterator(
             ds,
             global_batch=global_batch,
@@ -120,5 +134,45 @@ class Evaluator:
             preds.extend(self._decode_batch(local_ids[:valid_here]))
             refs.extend(self._decode_batch(labels[:valid_here]))
             seen += global_batch
+        scores = rouge_mod.compute(preds, refs, use_stemmer=True)
+        return aggregate_mean(scores)
+
+    def _run_causal(
+        self,
+        params: Any,
+        ds: Any,  # CausalLMDataset
+        *,
+        global_batch: int,
+        bucket_multiple: int = 128,
+        max_source_length: int = 1024,
+    ) -> dict[str, float]:
+        """Prompt-continuation eval for decoder-only models: generate from
+        each prompt, ROUGE vs the reference target."""
+        from distributed_llms_example_tpu.data.batching import bucket_len, pad_2d
+
+        pad_id = self.config.pad_token_id
+        per_host = global_batch // jax.process_count()
+        lo = jax.process_index() * per_host
+        n = len(ds)
+        preds: list[str] = []
+        refs: list[str] = []
+        for start in range(0, n, global_batch):
+            idx = [(start + i) % n for i in range(global_batch)]
+            prompts = [ds[i].prompt_ids for i in idx]
+            width = bucket_len(max(len(p) for p in prompts), bucket_multiple, max_source_length)
+            input_ids = pad_2d(prompts, width, pad_id)
+            mask = np.zeros_like(input_ids)
+            for r, p in enumerate(prompts):
+                mask[r, : min(len(p), width)] = 1
+            gb = put_batch({"input_ids": input_ids, "attention_mask": mask}, self.mesh)
+            out = self._generate(params, gb["input_ids"], gb["attention_mask"])
+            local_ids = host_rows(out)
+            if jax.process_count() == 1:
+                local_ids = local_ids[lo : lo + per_host]
+            valid_here = int(np.clip(min(global_batch, n - start) - lo, 0, per_host))
+            preds.extend(self._decode_batch(local_ids[:valid_here]))
+            local_targets = [ds[idx[lo + i]].target_ids for i in range(valid_here)]
+            refs.extend(self.tokenizer.decode([t for t in tgt if t != self.config.eos_token_id])
+                        for tgt in local_targets)
         scores = rouge_mod.compute(preds, refs, use_stemmer=True)
         return aggregate_mean(scores)

@@ -39,6 +39,8 @@ def make_greedy_generate(model: Any, config: Any, max_new_tokens: int) -> Callab
     of shape (batch, max_new_tokens), pad-filled after EOS."""
 
     eos, pad, start = config.eos_token_id, config.pad_token_id, config.decoder_start_token_id
+    forced_bos = getattr(config, "forced_bos_token_id", None)
+    forced_eos = getattr(config, "forced_eos_token_id", None)
     L = max_new_tokens
 
     def generate(params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
@@ -60,6 +62,10 @@ def make_greedy_generate(model: Any, config: Any, max_new_tokens: int) -> Callab
                 mutable=["cache"],
             )
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            if forced_bos is not None:  # HF forced_bos_token_id processor
+                nxt = jnp.where(t == 0, forced_bos, nxt)
+            if forced_eos is not None:  # HF forced_eos_token_id: EOS at max length
+                nxt = jnp.where(t == L - 1, forced_eos, nxt)
             nxt = jnp.where(done, pad, nxt)
             out = out.at[:, t].set(nxt)
             done = done | (nxt == eos)
@@ -69,6 +75,69 @@ def make_greedy_generate(model: Any, config: Any, max_new_tokens: int) -> Callab
         last = jnp.full((B, 1), start, jnp.int32)
         done = jnp.zeros((B,), bool)
         _, _, out, _ = jax.lax.fori_loop(0, L, step, (cache, last, out, done))
+        return out
+
+    return generate
+
+
+def make_causal_greedy(model: Any, config: Any, max_new_tokens: int) -> Callable:
+    """Greedy decoding for decoder-only (causal) models.
+
+    Prefills the prompt into the KV cache in one pass, then decodes one
+    token at a time.  Right-padded prompts are supported: the first sampled
+    token comes from each row's last *valid* position, and generated tokens
+    occupy cache slots after the full prompt width (pad slots stay masked
+    out of attention).  With uniform-length prompts this matches HF
+    ``generate`` exactly.
+    """
+    eos, pad = config.eos_token_id, config.pad_token_id
+    L = max_new_tokens
+
+    def generate(params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
+        B, P = input_ids.shape
+        width = P + L
+        # cache buffers sized for prompt + generation
+        shapes = jax.eval_shape(
+            lambda p: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((B, width), jnp.int32), use_cache=True
+            ),
+            params,
+        )
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+        full_mask = jnp.concatenate([attention_mask, jnp.zeros((B, L), jnp.int32)], axis=1)
+        # prefill
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            input_ids,
+            full_mask,
+            use_cache=True,
+            mutable=["cache"],
+        )
+        cache = mut["cache"]
+        lengths = jnp.sum(attention_mask, axis=1).astype(jnp.int32)  # valid prompt lengths
+        first = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        nxt = jnp.argmax(first, axis=-1).astype(jnp.int32)
+
+        def step(t, carry):
+            cache, full_mask, last, out, done = carry
+            out = out.at[:, t].set(last)
+            full_mask = full_mask.at[:, P + t].set(1)
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                last[:, None],
+                full_mask,
+                use_cache=True,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            done = done | (last == eos)
+            nxt = jnp.where(done, pad, nxt)
+            return mut["cache"], full_mask, nxt, out, done
+
+        out = jnp.full((B, L), pad, jnp.int32)
+        done = jnp.zeros((B,), bool)
+        _, _, _, out, _ = jax.lax.fori_loop(0, L, step, (cache, full_mask, nxt, out, done))
         return out
 
     return generate
@@ -93,6 +162,8 @@ def make_beam_search(
     banked when EOS is chosen, best finished (or live) beam returned."""
 
     eos, pad, start = config.eos_token_id, config.pad_token_id, config.decoder_start_token_id
+    forced_bos = getattr(config, "forced_bos_token_id", None)
+    forced_eos = getattr(config, "forced_eos_token_id", None)
     K, L = num_beams, max_new_tokens
 
     def generate(params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
@@ -125,6 +196,12 @@ def make_beam_search(
             cache = mut["cache"]
             logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)  # (B*K, V)
             V = logp.shape[-1]
+            if forced_bos is not None:  # HF forced_bos_token_id processor
+                forced_mask = jnp.full((V,), NEG_INF, jnp.float32).at[forced_bos].set(0.0)
+                logp = jnp.where(t == 0, logp + forced_mask[None, :], logp)
+            if forced_eos is not None:  # HF forced_eos_token_id: EOS at max length
+                eos_mask = jnp.full((V,), NEG_INF, jnp.float32).at[forced_eos].set(0.0)
+                logp = jnp.where(t == L - 1, logp + eos_mask[None, :], logp)
             cand = live_scores[:, :, None] + logp.reshape(B, K, V)  # (B, K, V)
             flat = cand.reshape(B, K * V)
             top_scores, top_idx = jax.lax.top_k(flat, 2 * K)  # (B, 2K)

@@ -1,0 +1,234 @@
+"""BART seq2seq in flax.linen — the reference's flagship model family
+(``facebook/bart-large-cnn``, reference valohai.yaml:10).
+
+Architecture facts matched against HF ``BartForConditionalGeneration``
+(verified by parity tests): post-layernorm residual blocks, learned
+positional embeddings with the +2 offset quirk, optional sqrt(d) embedding
+scale, gelu FFN, biased attention/FFN projections, tied LM head plus
+``final_logits_bias``, decoder starts at EOS with a forced BOS first token
+for the -cnn checkpoints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_llms_example_tpu.ops.attention import NEG_INF, mask_to_bias
+from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
+from distributed_llms_example_tpu.ops.norms import LayerNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class BartConfig:
+    vocab_size: int = 50265
+    d_model: int = 1024
+    encoder_layers: int = 12
+    decoder_layers: int = 12
+    encoder_attention_heads: int = 16
+    decoder_attention_heads: int = 16
+    encoder_ffn_dim: int = 4096
+    decoder_ffn_dim: int = 4096
+    max_position_embeddings: int = 1024
+    dropout_rate: float = 0.1
+    scale_embedding: bool = False
+    pad_token_id: int = 1
+    bos_token_id: int = 0
+    eos_token_id: int = 2
+    decoder_start_token_id: int = 2
+    forced_bos_token_id: Optional[int] = None
+    forced_eos_token_id: Optional[int] = 2  # HF BART default: force EOS at max length
+    layer_norm_epsilon: float = 1e-5
+
+    POSITION_OFFSET = 2  # HF BartLearnedPositionalEmbedding quirk
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.encoder_attention_heads
+
+    @property
+    def embed_scale(self) -> float:
+        return self.d_model**0.5 if self.scale_embedding else 1.0
+
+
+class BartEncoderLayer(nn.Module):
+    config: BartConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.self_attn = MultiHeadAttention(
+            num_heads=cfg.encoder_attention_heads,
+            head_dim=cfg.d_model // cfg.encoder_attention_heads,
+            model_dim=cfg.d_model,
+            use_bias=True,
+            dtype=self.dtype,
+            name="self_attn",
+        )
+        self.self_attn_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="self_attn_layer_norm")
+        self.mlp = BartMLP(cfg.encoder_ffn_dim, cfg.d_model, cfg.dropout_rate, self.dtype, name="mlp")
+        self.final_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="final_layer_norm")
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def __call__(self, hidden, bias, deterministic: bool = True):
+        residual = hidden
+        h = self.self_attn(hidden, bias=bias)
+        hidden = self.self_attn_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        residual = hidden
+        h = self.mlp(hidden, deterministic=deterministic)
+        hidden = self.final_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        return hidden
+
+
+class BartMLP(nn.Module):
+    ffn_dim: int
+    model_dim: int
+    dropout_rate: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        h = nn.gelu(nn.Dense(self.ffn_dim, dtype=self.dtype, name="fc1")(x), approximate=False)
+        h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
+        return nn.Dense(self.model_dim, dtype=self.dtype, name="fc2")(h)
+
+
+class BartDecoderLayer(nn.Module):
+    config: BartConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        cfg = self.config
+        mk_attn = lambda causal, name: MultiHeadAttention(  # noqa: E731
+            num_heads=cfg.decoder_attention_heads,
+            head_dim=cfg.d_model // cfg.decoder_attention_heads,
+            model_dim=cfg.d_model,
+            use_bias=True,
+            causal=causal,
+            dtype=self.dtype,
+            name=name,
+        )
+        self.self_attn = mk_attn(True, "self_attn")
+        self.self_attn_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="self_attn_layer_norm")
+        self.cross_attn = mk_attn(False, "cross_attn")
+        self.cross_attn_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="cross_attn_layer_norm")
+        self.mlp = BartMLP(cfg.decoder_ffn_dim, cfg.d_model, cfg.dropout_rate, self.dtype, name="mlp")
+        self.final_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="final_layer_norm")
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def __call__(
+        self,
+        hidden,
+        self_bias,
+        encoder_hidden,
+        cross_bias,
+        deterministic: bool = True,
+        use_cache: bool = False,
+    ):
+        residual = hidden
+        h = self.self_attn(hidden, bias=self_bias, use_cache=use_cache)
+        hidden = self.self_attn_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        residual = hidden
+        h = self.cross_attn(hidden, kv_hidden=encoder_hidden, bias=cross_bias)
+        hidden = self.cross_attn_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        residual = hidden
+        h = self.mlp(hidden, deterministic=deterministic)
+        hidden = self.final_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        return hidden
+
+
+class BartForConditionalGeneration(nn.Module):
+    config: BartConfig
+    dtype: jnp.dtype = jnp.float32
+    remat: bool = False
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.shared = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=self.dtype, name="shared")
+        self.encoder_embed_positions = nn.Embed(
+            cfg.max_position_embeddings + cfg.POSITION_OFFSET, cfg.d_model, dtype=self.dtype,
+            name="encoder_embed_positions",
+        )
+        self.decoder_embed_positions = nn.Embed(
+            cfg.max_position_embeddings + cfg.POSITION_OFFSET, cfg.d_model, dtype=self.dtype,
+            name="decoder_embed_positions",
+        )
+        self.encoder_layernorm_embedding = LayerNorm(
+            cfg.layer_norm_epsilon, self.dtype, name="encoder_layernorm_embedding"
+        )
+        self.decoder_layernorm_embedding = LayerNorm(
+            cfg.layer_norm_epsilon, self.dtype, name="decoder_layernorm_embedding"
+        )
+        enc_layer = nn.remat(BartEncoderLayer, static_argnums=(3,)) if self.remat else BartEncoderLayer
+        dec_layer = nn.remat(BartDecoderLayer, static_argnums=(5, 6)) if self.remat else BartDecoderLayer
+        self.encoder_blocks = [
+            enc_layer(cfg, dtype=self.dtype, name=f"encoder_block_{i}") for i in range(cfg.encoder_layers)
+        ]
+        self.decoder_blocks = [
+            dec_layer(cfg, dtype=self.dtype, name=f"decoder_block_{i}") for i in range(cfg.decoder_layers)
+        ]
+        self.final_logits_bias = self.param(
+            "final_logits_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32
+        )
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def encode(self, input_ids, attention_mask=None, *, deterministic: bool = True):
+        cfg = self.config
+        pos = jnp.arange(input_ids.shape[1]) + cfg.POSITION_OFFSET
+        hidden = self.shared(input_ids) * cfg.embed_scale + self.encoder_embed_positions(pos)[None]
+        hidden = self.dropout(self.encoder_layernorm_embedding(hidden), deterministic=deterministic)
+        bias = mask_to_bias(attention_mask) if attention_mask is not None else None
+        for blk in self.encoder_blocks:
+            hidden = blk(hidden, bias, deterministic)
+        return hidden
+
+    def decode(
+        self,
+        decoder_input_ids,
+        encoder_hidden,
+        encoder_mask=None,
+        decoder_attention_mask=None,
+        *,
+        deterministic: bool = True,
+        use_cache: bool = False,
+        cache_offset: int | jnp.ndarray = 0,
+        max_kv_len: int | None = None,
+    ):
+        cfg = self.config
+        q_len = decoder_input_ids.shape[1]
+        pos = jnp.arange(q_len) + cache_offset + cfg.POSITION_OFFSET
+        hidden = self.shared(decoder_input_ids) * cfg.embed_scale + self.decoder_embed_positions(pos)[None]
+        hidden = self.dropout(self.decoder_layernorm_embedding(hidden), deterministic=deterministic)
+        if use_cache:
+            self_bias = None  # causal/validity handled inside cached attention
+        else:
+            causal = jnp.tril(jnp.ones((q_len, q_len), dtype=bool))
+            self_bias = jnp.where(causal, 0.0, NEG_INF)[None, None]
+            if decoder_attention_mask is not None:
+                self_bias = self_bias + mask_to_bias(decoder_attention_mask)
+        cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
+        for blk in self.decoder_blocks:
+            hidden = blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache)
+        logits = hidden @ self.shared.embedding.astype(self.dtype).T
+        return logits + self.final_logits_bias.astype(logits.dtype)
+
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        decoder_input_ids=None,
+        decoder_attention_mask=None,
+        *,
+        deterministic: bool = True,
+    ):
+        enc = self.encode(input_ids, attention_mask, deterministic=deterministic)
+        return self.decode(
+            decoder_input_ids,
+            enc,
+            encoder_mask=attention_mask,
+            decoder_attention_mask=decoder_attention_mask,
+            deterministic=deterministic,
+        )

@@ -102,10 +102,116 @@ def convert_t5_state_dict(state_dict: Mapping[str, Any]) -> dict:
     return params
 
 
+# --- BART -----------------------------------------------------------------
+
+_BART_ATTN = {"q_proj": "q_proj", "k_proj": "k_proj", "v_proj": "v_proj", "out_proj": "o_proj"}
+_BART_SUB = {"self_attn": "self_attn", "encoder_attn": "cross_attn"}
+_BART_NORM = {
+    "self_attn_layer_norm": "self_attn_layer_norm",
+    "encoder_attn_layer_norm": "cross_attn_layer_norm",
+    "final_layer_norm": "final_layer_norm",
+}
+
+
+def convert_bart_state_dict(state_dict: Mapping[str, Any]) -> dict:
+    """HF ``BartForConditionalGeneration`` state_dict → our param tree."""
+    params: dict = {}
+    for name, tensor in state_dict.items():
+        arr = _to_numpy(tensor)
+        name = name.removeprefix("model.")
+        if name == "shared.weight":
+            _set(params, "shared/embedding", arr)
+            continue
+        if name in ("encoder.embed_tokens.weight", "decoder.embed_tokens.weight", "lm_head.weight"):
+            continue  # tied duplicates of shared.weight
+        if name == "final_logits_bias":
+            _set(params, "final_logits_bias", arr.reshape(-1))
+            continue
+        m = re.match(r"(encoder|decoder)\.embed_positions\.weight", name)
+        if m:
+            _set(params, f"{m.group(1)}_embed_positions/embedding", arr)
+            continue
+        m = re.match(r"(encoder|decoder)\.layernorm_embedding\.(weight|bias)", name)
+        if m:
+            leaf = "scale" if m.group(2) == "weight" else "bias"
+            _set(params, f"{m.group(1)}_layernorm_embedding/{leaf}", arr)
+            continue
+        m = re.match(r"(encoder|decoder)\.layers\.(\d+)\.(.+)", name)
+        if not m:
+            raise ValueError(f"unrecognized BART parameter: {name}")
+        stack, i, rest = m.groups()
+        prefix = f"{stack}_block_{i}"
+        m = re.match(r"(self_attn|encoder_attn)\.(q_proj|k_proj|v_proj|out_proj)\.(weight|bias)", rest)
+        if m:
+            sub, proj, kind = m.groups()
+            leaf = "kernel" if kind == "weight" else "bias"
+            val = _t(arr) if kind == "weight" else arr
+            _set(params, f"{prefix}/{_BART_SUB[sub]}/{_BART_ATTN[proj]}/{leaf}", val)
+            continue
+        m = re.match(r"(fc1|fc2)\.(weight|bias)", rest)
+        if m:
+            proj, kind = m.groups()
+            leaf = "kernel" if kind == "weight" else "bias"
+            _set(params, f"{prefix}/mlp/{proj}/{leaf}", _t(arr) if kind == "weight" else arr)
+            continue
+        m = re.match(r"(self_attn_layer_norm|encoder_attn_layer_norm|final_layer_norm)\.(weight|bias)", rest)
+        if m:
+            norm, kind = m.groups()
+            leaf = "scale" if kind == "weight" else "bias"
+            _set(params, f"{prefix}/{_BART_NORM[norm]}/{leaf}", arr)
+            continue
+        raise ValueError(f"unrecognized BART layer parameter: {name}")
+    return params
+
+
+# --- LLaMA ----------------------------------------------------------------
+
+
+def convert_llama_state_dict(state_dict: Mapping[str, Any]) -> dict:
+    """HF ``LlamaForCausalLM`` state_dict → our param tree."""
+    params: dict = {}
+    for name, tensor in state_dict.items():
+        if name.endswith("rotary_emb.inv_freq"):
+            continue  # derived buffer
+        arr = _to_numpy(tensor)
+        if name == "model.embed_tokens.weight":
+            _set(params, "embed_tokens/embedding", arr)
+            continue
+        if name == "model.norm.weight":
+            _set(params, "final_norm/scale", arr)
+            continue
+        if name == "lm_head.weight":
+            _set(params, "lm_head/kernel", _t(arr))
+            continue
+        m = re.match(r"model\.layers\.(\d+)\.(.+)", name)
+        if not m:
+            raise ValueError(f"unrecognized LLaMA parameter: {name}")
+        i, rest = m.groups()
+        prefix = f"block_{i}"
+        m = re.match(r"self_attn\.(q|k|v|o)_proj\.weight", rest)
+        if m:
+            _set(params, f"{prefix}/self_attn/{m.group(1)}_proj/kernel", _t(arr))
+            continue
+        m = re.match(r"mlp\.(gate|up|down)_proj\.weight", rest)
+        if m:
+            _set(params, f"{prefix}/mlp/{m.group(1)}_proj/kernel", _t(arr))
+            continue
+        if rest == "input_layernorm.weight":
+            _set(params, f"{prefix}/attn_norm/scale", arr)
+            continue
+        if rest == "post_attention_layernorm.weight":
+            _set(params, f"{prefix}/mlp_norm/scale", arr)
+            continue
+        raise ValueError(f"unrecognized LLaMA layer parameter: {name}")
+    return params
+
+
 # --- generic entry point --------------------------------------------------
 
 CONVERTERS: dict[str, Callable[[Mapping[str, Any]], dict]] = {
     "t5": convert_t5_state_dict,
+    "bart": convert_bart_state_dict,
+    "llama": convert_llama_state_dict,
 }
 
 

@@ -1,0 +1,127 @@
+"""LLaMA-family causal LM in flax.linen (llama-2-7b-class, BASELINE.json
+config 5: multi-host bf16 instruction fine-tuning).
+
+Architecture facts matched against HF ``LlamaForCausalLM`` (parity-tested):
+pre-RMSNorm residual blocks, rotary position embeddings in the HF
+half-rotation layout, SwiGLU MLP, bias-free projections, optional
+grouped-query attention, untied LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_llms_example_tpu.ops.attention import NEG_INF, mask_to_bias
+from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
+from distributed_llms_example_tpu.ops.norms import RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None  # None → MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    pad_token_id: int = 0
+    bos_token_id: int = 1
+    eos_token_id: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    # aliases so generation/loss code can treat all configs uniformly
+    @property
+    def decoder_start_token_id(self) -> int:
+        return self.bos_token_id
+
+    @property
+    def dropout_rate(self) -> float:
+        return 0.0
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=self.dtype, name="gate_proj")(x)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=self.dtype, name="up_proj")(x)
+        return nn.Dense(cfg.hidden_size, use_bias=False, dtype=self.dtype, name="down_proj")(
+            nn.silu(gate) * up
+        )
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.attn_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="attn_norm")
+        self.self_attn = MultiHeadAttention(
+            num_heads=cfg.num_attention_heads,
+            head_dim=cfg.head_dim,
+            model_dim=cfg.hidden_size,
+            num_kv_heads=cfg.num_key_value_heads,
+            use_bias=False,
+            causal=True,
+            use_rope=True,
+            rope_theta=cfg.rope_theta,
+            dtype=self.dtype,
+            name="self_attn",
+        )
+        self.mlp_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="mlp_norm")
+        self.mlp = LlamaMLP(cfg, dtype=self.dtype, name="mlp")
+
+    def __call__(self, hidden, bias=None, deterministic: bool = True, use_cache: bool = False):
+        hidden = hidden + self.self_attn(self.attn_norm(hidden), bias=bias, use_cache=use_cache)
+        return hidden + self.mlp(self.mlp_norm(hidden))
+
+
+class LlamaForCausalLM(nn.Module):
+    config: LlamaConfig
+    dtype: jnp.dtype = jnp.float32
+    remat: bool = False
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, name="embed_tokens")
+        block = nn.remat(LlamaBlock, static_argnums=(2, 3)) if self.remat else LlamaBlock
+        self.blocks = [block(cfg, dtype=self.dtype, name=f"block_{i}") for i in range(cfg.num_hidden_layers)]
+        self.final_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="final_norm")
+        self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")
+
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        *,
+        deterministic: bool = True,
+        use_cache: bool = False,
+        cache_offset: int | jnp.ndarray = 0,
+        max_kv_len: int | None = None,
+    ):
+        q_len = input_ids.shape[1]
+        hidden = self.embed_tokens(input_ids)
+        if use_cache:
+            bias = mask_to_bias(attention_mask) if attention_mask is not None else None
+        else:
+            causal = jnp.tril(jnp.ones((q_len, q_len), dtype=bool))
+            bias = jnp.where(causal, 0.0, NEG_INF)[None, None]
+            if attention_mask is not None:
+                bias = bias + mask_to_bias(attention_mask)
+        for blk in self.blocks:
+            hidden = blk(hidden, bias, deterministic, use_cache)
+        return self.lm_head(self.final_norm(hidden))

@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llms_example_tpu.models import t5 as t5_mod
+from distributed_llms_example_tpu.models.bart import BartConfig, BartForConditionalGeneration
 from distributed_llms_example_tpu.models.convert import convert_state_dict
+from distributed_llms_example_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from distributed_llms_example_tpu.models.t5 import T5Config, T5ForConditionalGeneration
 
 # Built-in configs sized like the public checkpoints (dims from the public
@@ -39,6 +41,35 @@ T5_CONFIGS: dict[str, T5Config] = {
         num_heads=32,
         feed_forward_proj="gated-gelu",
         tie_word_embeddings=False,
+    ),
+}
+
+BART_CONFIGS: dict[str, BartConfig] = {
+    "bart-test": BartConfig(
+        vocab_size=256, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, max_position_embeddings=128,
+        forced_bos_token_id=0,
+    ),
+    "bart-base": BartConfig(
+        d_model=768, encoder_layers=6, decoder_layers=6,
+        encoder_attention_heads=12, decoder_attention_heads=12,
+        encoder_ffn_dim=3072, decoder_ffn_dim=3072,
+    ),
+    # the reference's default model (reference valohai.yaml:10)
+    "bart-large-cnn": BartConfig(forced_bos_token_id=0),
+    "bart-large": BartConfig(),
+}
+
+LLAMA_CONFIGS: dict[str, LlamaConfig] = {
+    "llama-test": LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    ),
+    "llama-2-7b": LlamaConfig(),
+    "llama-2-13b": LlamaConfig(
+        hidden_size=5120, intermediate_size=13824, num_hidden_layers=40, num_attention_heads=40
     ),
 }
 
@@ -98,6 +129,60 @@ def _load_local_state_dict(path: str) -> dict:
     raise FileNotFoundError(f"no model.safetensors or pytorch_model.bin under {path}")
 
 
+def _bart_from_hf_config(cfg: dict) -> BartConfig:
+    return BartConfig(
+        vocab_size=cfg["vocab_size"],
+        d_model=cfg["d_model"],
+        encoder_layers=cfg["encoder_layers"],
+        decoder_layers=cfg["decoder_layers"],
+        encoder_attention_heads=cfg["encoder_attention_heads"],
+        decoder_attention_heads=cfg["decoder_attention_heads"],
+        encoder_ffn_dim=cfg["encoder_ffn_dim"],
+        decoder_ffn_dim=cfg["decoder_ffn_dim"],
+        max_position_embeddings=cfg.get("max_position_embeddings", 1024),
+        dropout_rate=cfg.get("dropout", 0.1),
+        scale_embedding=cfg.get("scale_embedding", False),
+        pad_token_id=cfg.get("pad_token_id", 1),
+        bos_token_id=cfg.get("bos_token_id", 0),
+        eos_token_id=cfg.get("eos_token_id", 2),
+        decoder_start_token_id=cfg.get("decoder_start_token_id", 2),
+        forced_bos_token_id=cfg.get("forced_bos_token_id"),
+    )
+
+
+def _llama_from_hf_config(cfg: dict) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        intermediate_size=cfg["intermediate_size"],
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=cfg["num_attention_heads"],
+        num_key_value_heads=cfg.get("num_key_value_heads"),
+        max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+        rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        pad_token_id=cfg.get("pad_token_id") or 0,
+        bos_token_id=cfg.get("bos_token_id", 1),
+        eos_token_id=cfg.get("eos_token_id", 2),
+    )
+
+
+def _build(family: str, cfg: Any, dtype: jnp.dtype, remat: bool, params: Any = None) -> LoadedModel:
+    if family == "t5":
+        module = T5ForConditionalGeneration(cfg, dtype=dtype, remat=remat)
+        return LoadedModel("t5", cfg, module, params, is_seq2seq=True)
+    if family == "bart":
+        module = BartForConditionalGeneration(cfg, dtype=dtype, remat=remat)
+        return LoadedModel("bart", cfg, module, params, is_seq2seq=True)
+    if family == "llama":
+        module = LlamaForCausalLM(cfg, dtype=dtype, remat=remat)
+        return LoadedModel("llama", cfg, module, params, is_seq2seq=False)
+    raise ValueError(f"unsupported model family {family!r}")
+
+
+_HF_CONFIG_PARSERS = {"t5": _t5_from_hf_config, "bart": _bart_from_hf_config, "llama": _llama_from_hf_config}
+
+
 def load_model(
     name_or_path: str,
     *,
@@ -110,24 +195,33 @@ def load_model(
         with open(os.path.join(name_or_path, "config.json")) as f:
             hf_cfg = json.load(f)
         model_type = hf_cfg.get("model_type", "t5")
-        if model_type == "t5":
-            cfg = _t5_from_hf_config(hf_cfg)
-            module = T5ForConditionalGeneration(cfg, dtype=dtype, remat=remat)
-            params = None
-            if load_weights:
-                params = convert_state_dict("t5", _load_local_state_dict(name_or_path))
-                params = jax.tree.map(jnp.asarray, params)
-            return LoadedModel("t5", cfg, module, params)
-        raise ValueError(f"unsupported model_type {model_type!r} at {name_or_path}")
-    # short names: strip org prefixes like "google/"
+        if model_type not in _HF_CONFIG_PARSERS:
+            raise ValueError(f"unsupported model_type {model_type!r} at {name_or_path}")
+        cfg = _HF_CONFIG_PARSERS[model_type](hf_cfg)
+        params = None
+        if load_weights:
+            params = convert_state_dict(model_type, _load_local_state_dict(name_or_path))
+            params = jax.tree.map(jnp.asarray, params)
+        return _build(model_type, cfg, dtype, remat, params)
+    # short names: strip org prefixes like "google/" or "facebook/"
     short = name_or_path.rsplit("/", 1)[-1]
     if short in T5_CONFIGS:
-        cfg = T5_CONFIGS[short]
-        module = T5ForConditionalGeneration(cfg, dtype=dtype, remat=remat)
-        return LoadedModel("t5", cfg, module, None)
+        return _build("t5", T5_CONFIGS[short], dtype, remat)
+    if short in BART_CONFIGS:
+        return _build("bart", BART_CONFIGS[short], dtype, remat)
+    if short in LLAMA_CONFIGS:
+        return _build("llama", LLAMA_CONFIGS[short], dtype, remat)
+    known = sorted(T5_CONFIGS) + sorted(BART_CONFIGS) + sorted(LLAMA_CONFIGS)
     raise ValueError(
-        f"unknown model {name_or_path!r}: not a local checkpoint dir and not one of {sorted(T5_CONFIGS)}"
+        f"unknown model {name_or_path!r}: not a local checkpoint dir and not one of {known}"
     )
 
 
-__all__ = ["LoadedModel", "load_model", "T5_CONFIGS", "t5_mod"]
+__all__ = [
+    "LoadedModel",
+    "load_model",
+    "T5_CONFIGS",
+    "BART_CONFIGS",
+    "LLAMA_CONFIGS",
+    "t5_mod",
+]

@@ -1,0 +1,122 @@
+"""Shared multi-head attention module (BART/LLaMA families).
+
+One module covers: scaled dot-product attention with optional biases in the
+projections, causal masking, fixed-shape KV caching for autoregressive
+decode, rotary position embeddings (LLaMA), and grouped-query attention
+(fewer KV heads than Q heads).  T5 keeps its own attention (unscaled
+scores + relative position bias are peculiar to it).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_llms_example_tpu.ops.attention import NEG_INF, dot_product_attention
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float = 10000.0) -> tuple:
+    """(..., head_dim) cos/sin tables for the given integer positions, in the
+    HF half-rotation layout (freqs repeated, not interleaved)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., head_dim/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: (batch, heads, seq, head_dim); cos/sin: (seq, head_dim) or
+    broadcastable."""
+    half = x.shape[-1] // 2
+    rotated = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+    return (x * cos + rotated * sin).astype(x.dtype)
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    model_dim: int
+    num_kv_heads: int | None = None  # None → == num_heads
+    use_bias: bool = True
+    causal: bool = False
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+
+    def setup(self) -> None:
+        inner_q = self.num_heads * self.head_dim
+        inner_kv = self.kv_heads * self.head_dim
+        mk = lambda feats, name: nn.Dense(feats, use_bias=self.use_bias, dtype=self.dtype, name=name)  # noqa: E731
+        self.q_proj = mk(inner_q, "q_proj")
+        self.k_proj = mk(inner_kv, "k_proj")
+        self.v_proj = mk(inner_kv, "v_proj")
+        self.o_proj = mk(self.model_dim, "o_proj")
+
+    def _split(self, x: jnp.ndarray, heads: int) -> jnp.ndarray:
+        b, s, _ = x.shape
+        return x.reshape(b, s, heads, self.head_dim).transpose(0, 2, 1, 3)
+
+    @nn.compact
+    def _cache_kv(self, key: jnp.ndarray, value: jnp.ndarray):
+        is_initialized = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros, key.shape, key.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros, value.shape, value.dtype)
+        cache_index = self.variable("cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32))
+        idx = cache_index.value
+        if is_initialized:
+            k = jax.lax.dynamic_update_slice(cached_k.value, key, (0, 0, idx, 0))
+            v = jax.lax.dynamic_update_slice(cached_v.value, value, (0, 0, idx, 0))
+            cached_k.value, cached_v.value = k, v
+            cache_index.value = idx + key.shape[2]
+        else:
+            k, v = cached_k.value, cached_v.value
+        return k, v, idx
+
+    def __call__(
+        self,
+        hidden: jnp.ndarray,
+        kv_hidden: jnp.ndarray | None = None,
+        bias: jnp.ndarray | None = None,
+        use_cache: bool = False,
+    ) -> jnp.ndarray:
+        kv_src = hidden if kv_hidden is None else kv_hidden
+        q = self._split(self.q_proj(hidden), self.num_heads)
+        k = self._split(self.k_proj(kv_src), self.kv_heads)
+        v = self._split(self.v_proj(kv_src), self.kv_heads)
+
+        offset = 0
+        if use_cache and self.causal:
+            # RoPE must see absolute positions, so rotate before caching
+            if self.use_rope:
+                # peek the index without mutating (the mutation happens in _cache_kv)
+                idx = self.get_variable("cache", "cache_index") if self.has_variable("cache", "cache_index") else 0
+                pos_q = jnp.arange(q.shape[2]) + idx
+                cos, sin = rope_cos_sin(pos_q, self.head_dim, self.rope_theta)
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+            k, v, offset = self._cache_kv(k, v)
+            kv_len, q_len = k.shape[2], q.shape[2]
+            pos = jnp.arange(kv_len)[None, None, None, :]
+            valid = pos <= (offset + q_len - 1)
+            causal = pos <= (offset + jnp.arange(q_len)[None, None, :, None])
+            step_bias = jnp.where(valid & causal, 0.0, NEG_INF)
+            bias = step_bias if bias is None else bias + step_bias
+        elif self.use_rope:
+            pos = jnp.arange(q.shape[2])
+            cos, sin = rope_cos_sin(pos, self.head_dim, self.rope_theta)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+
+        if self.kv_heads != self.num_heads:
+            rep = self.num_heads // self.kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+
+        out = dot_product_attention(q, k, v, bias, dtype=self.dtype)
+        b, h, s, d = out.shape
+        return self.o_proj(out.transpose(0, 2, 1, 3).reshape(b, s, h * d))

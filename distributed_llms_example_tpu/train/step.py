@@ -64,22 +64,38 @@ def cross_entropy_sums(
     return jnp.sum(loss * mask), jnp.sum(mask)
 
 
-def make_loss_fn(model: Any, config: Any, label_smoothing: float = 0.0) -> Callable:
-    """Seq2seq loss over a batch dict (input_ids, attention_mask, labels)."""
+def make_loss_fn(
+    model: Any, config: Any, label_smoothing: float = 0.0, is_seq2seq: bool = True
+) -> Callable:
+    """Loss over a batch dict (input_ids, attention_mask, labels).
+
+    Seq2seq: teacher-forced decoder on shift-right labels.  Causal LM:
+    ``labels`` is input-length-aligned with -100 over prompt/pad positions;
+    position t's logits predict ``labels[t+1]`` (next-token convention).
+    """
 
     def loss_sums(params: Any, batch: dict, dropout_rng: jax.Array | None = None) -> tuple:
         labels = batch["labels"]
-        decoder_input_ids = shift_right(labels, config.decoder_start_token_id, config.pad_token_id)
         rngs = {"dropout": dropout_rng} if dropout_rng is not None else {}
+        if is_seq2seq:
+            decoder_input_ids = shift_right(labels, config.decoder_start_token_id, config.pad_token_id)
+            logits = model.apply(
+                {"params": params},
+                batch["input_ids"],
+                batch["attention_mask"],
+                decoder_input_ids,
+                deterministic=dropout_rng is None,
+                rngs=rngs,
+            )
+            return cross_entropy_sums(logits, labels, label_smoothing)
         logits = model.apply(
             {"params": params},
             batch["input_ids"],
             batch["attention_mask"],
-            decoder_input_ids,
             deterministic=dropout_rng is None,
             rngs=rngs,
         )
-        return cross_entropy_sums(logits, labels, label_smoothing)
+        return cross_entropy_sums(logits[:, :-1], labels[:, 1:], label_smoothing)
 
     return loss_sums
 
@@ -106,13 +122,14 @@ def make_train_step(
     label_smoothing: float = 0.0,
     with_dropout: bool = False,
     donate: bool = True,
+    is_seq2seq: bool = True,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch[, rng]) → (state, metrics).
 
     The global batch (leading dim = global batch size) must be divisible by
     ``grad_accum_steps``; each microbatch stays sharded over (data, fsdp).
     """
-    loss_sums = make_loss_fn(model, config, label_smoothing)
+    loss_sums = make_loss_fn(model, config, label_smoothing, is_seq2seq=is_seq2seq)
     micro_sharding = NamedSharding(mesh, P(None, ("data", "fsdp"), None))
 
     def value_and_grad_sums(params: Any, batch: dict, rng: jax.Array | None) -> tuple:

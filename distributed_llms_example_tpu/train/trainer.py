@@ -33,7 +33,7 @@ from distributed_llms_example_tpu.core.config import TrainConfig
 from distributed_llms_example_tpu.core.mesh import build_mesh, device_report
 from distributed_llms_example_tpu.core.precision import parse_dtype
 from distributed_llms_example_tpu.data.batching import BatchIterator
-from distributed_llms_example_tpu.data.dataset import SummarizationDataset
+from distributed_llms_example_tpu.data.dataset import CausalLMDataset, SummarizationDataset
 from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
 from distributed_llms_example_tpu.evaluation.evaluate import Evaluator
 from distributed_llms_example_tpu.io.checkpoint import Checkpointer, abstract_like
@@ -68,27 +68,31 @@ class Trainer:
         self.loaded = load_model(cfg.model_ckpt, dtype=compute_dtype, remat=cfg.remat)
         self.model, self.config = self.loaded.module, self.loaded.config
 
-        self.train_ds = SummarizationDataset(
-            train_records,
-            self.tokenizer,
-            max_source_length=cfg.max_source_length,
-            max_target_length=cfg.max_target_length,
-            source_column=cfg.source_column,
-            target_column=cfg.target_column,
-        )
-        self.val_ds = (
-            SummarizationDataset(
-                val_records,
+        if self.loaded.is_seq2seq:
+            mk_ds = lambda recs: SummarizationDataset(  # noqa: E731
+                recs,
                 self.tokenizer,
                 max_source_length=cfg.max_source_length,
                 max_target_length=cfg.max_target_length,
                 source_column=cfg.source_column,
                 target_column=cfg.target_column,
             )
-            if val_records
-            else None
-        )
+        else:
+            # decoder-only: prompt+target concatenated, loss masked on prompt
+            mk_ds = lambda recs: CausalLMDataset(  # noqa: E731
+                recs,
+                self.tokenizer,
+                max_length=cfg.max_source_length,
+                max_target_length=cfg.max_target_length,
+                source_column=cfg.source_column,
+                target_column=cfg.target_column,
+            )
+        self.train_ds = mk_ds(train_records)
+        self.val_ds = mk_ds(val_records) if val_records else None
 
+        # For causal LM, input and labels share one width: cap both at
+        # max_source_length so the bucket widths agree.
+        tgt_cap = cfg.max_target_length if self.loaded.is_seq2seq else cfg.max_source_length
         self.batches = BatchIterator(
             self.train_ds,
             global_batch=cfg.batch_size,
@@ -97,7 +101,7 @@ class Trainer:
             seed=cfg.shuffle_seed,
             bucket_multiple=cfg.pad_to_multiple,
             max_source_length=cfg.max_source_length,
-            max_target_length=cfg.max_target_length,
+            max_target_length=tgt_cap,
         )
         steps_per_epoch = self.batches.steps_per_epoch()
         if steps_per_epoch == 0:
@@ -133,6 +137,7 @@ class Trainer:
             grad_accum_steps=cfg.grad_accum_steps,
             label_smoothing=cfg.label_smoothing,
             with_dropout=self.use_dropout,
+            is_seq2seq=self.loaded.is_seq2seq,
         )
         self.train_step, _ = build(self.state)
 
@@ -157,6 +162,7 @@ class Trainer:
                 self.mesh,
                 num_beams=cfg.num_beams,
                 max_new_tokens=cfg.eval_max_new_tokens,
+                is_seq2seq=self.loaded.is_seq2seq,
             )
             if self.val_ds
             else None

@@ -158,8 +158,13 @@ class Evaluator:
         refs: list[str] = []
         for start in range(0, n, global_batch):
             idx = [(start + i) % n for i in range(global_batch)]
-            prompts = [ds[i].prompt_ids for i in idx]
-            width = bucket_len(max(len(p) for p in prompts), bucket_multiple, max_source_length)
+            # bucket width from the GLOBAL batch (shape agreement across
+            # hosts); materialize only this host's slice, like run()
+            width = bucket_len(
+                max(len(ds[i].prompt_ids) for i in idx), bucket_multiple, max_source_length
+            )
+            local_idx = idx[lo : lo + per_host]
+            prompts = [ds[i].prompt_ids for i in local_idx]
             input_ids = pad_2d(prompts, width, pad_id)
             mask = np.zeros_like(input_ids)
             for r, p in enumerate(prompts):
@@ -167,12 +172,11 @@ class Evaluator:
             gb = put_batch({"input_ids": input_ids, "attention_mask": mask}, self.mesh)
             out = self._generate(params, gb["input_ids"], gb["attention_mask"])
             local_ids = host_rows(out)
-            if jax.process_count() == 1:
-                local_ids = local_ids[lo : lo + per_host]
             valid_here = int(np.clip(min(global_batch, n - start) - lo, 0, per_host))
             preds.extend(self._decode_batch(local_ids[:valid_here]))
-            local_targets = [ds[idx[lo + i]].target_ids for i in range(valid_here)]
-            refs.extend(self.tokenizer.decode([t for t in tgt if t != self.config.eos_token_id])
-                        for tgt in local_targets)
+            refs.extend(
+                self.tokenizer.decode([t for t in ds[i].target_ids if t != self.config.eos_token_id])
+                for i in local_idx[:valid_here]
+            )
         scores = rouge_mod.compute(preds, refs, use_stemmer=True)
         return aggregate_mean(scores)

@@ -106,16 +106,21 @@ def make_causal_greedy(model: Any, config: Any, max_new_tokens: int) -> Callable
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
         full_mask = jnp.concatenate([attention_mask, jnp.zeros((B, L), jnp.int32)], axis=1)
+        lengths = jnp.sum(attention_mask, axis=1).astype(jnp.int32)  # valid prompt lengths
+        # RoPE positions follow the true sequence, not the cache slot: pads
+        # inside the prompt get position 0-ish (cumsum), generated tokens
+        # continue at each row's own length
+        prefill_pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None)
         # prefill
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             input_ids,
             full_mask,
             use_cache=True,
+            positions=prefill_pos,
             mutable=["cache"],
         )
         cache = mut["cache"]
-        lengths = jnp.sum(attention_mask, axis=1).astype(jnp.int32)  # valid prompt lengths
         first = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
         nxt = jnp.argmax(first, axis=-1).astype(jnp.int32)
 
@@ -128,6 +133,7 @@ def make_causal_greedy(model: Any, config: Any, max_new_tokens: int) -> Callable
                 last[:, None],
                 full_mask,
                 use_cache=True,
+                positions=(lengths + t)[:, None],
                 mutable=["cache"],
             )
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)

@@ -17,7 +17,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from distributed_llms_example_tpu.ops.attention import NEG_INF, mask_to_bias
+from distributed_llms_example_tpu.ops.attention import make_causal_bias, mask_to_bias
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.norms import LayerNorm
 
@@ -205,8 +205,7 @@ class BartForConditionalGeneration(nn.Module):
         if use_cache:
             self_bias = None  # causal/validity handled inside cached attention
         else:
-            causal = jnp.tril(jnp.ones((q_len, q_len), dtype=bool))
-            self_bias = jnp.where(causal, 0.0, NEG_INF)[None, None]
+            self_bias = make_causal_bias(q_len, q_len)
             if decoder_attention_mask is not None:
                 self_bias = self_bias + mask_to_bias(decoder_attention_mask)
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None

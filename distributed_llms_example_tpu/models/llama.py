@@ -15,7 +15,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from distributed_llms_example_tpu.ops.attention import NEG_INF, mask_to_bias
+from distributed_llms_example_tpu.ops.attention import make_causal_bias, mask_to_bias
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.norms import RMSNorm
 
@@ -85,8 +85,12 @@ class LlamaBlock(nn.Module):
         self.mlp_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="mlp_norm")
         self.mlp = LlamaMLP(cfg, dtype=self.dtype, name="mlp")
 
-    def __call__(self, hidden, bias=None, deterministic: bool = True, use_cache: bool = False):
-        hidden = hidden + self.self_attn(self.attn_norm(hidden), bias=bias, use_cache=use_cache)
+    def __call__(
+        self, hidden, bias=None, deterministic: bool = True, use_cache: bool = False, positions=None
+    ):
+        hidden = hidden + self.self_attn(
+            self.attn_norm(hidden), bias=bias, use_cache=use_cache, positions=positions
+        )
         return hidden + self.mlp(self.mlp_norm(hidden))
 
 
@@ -98,7 +102,8 @@ class LlamaForCausalLM(nn.Module):
     def setup(self) -> None:
         cfg = self.config
         self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, name="embed_tokens")
-        block = nn.remat(LlamaBlock, static_argnums=(2, 3)) if self.remat else LlamaBlock
+        # static args: deterministic (3), use_cache (4) — counting self at 0
+        block = nn.remat(LlamaBlock, static_argnums=(3, 4)) if self.remat else LlamaBlock
         self.blocks = [block(cfg, dtype=self.dtype, name=f"block_{i}") for i in range(cfg.num_hidden_layers)]
         self.final_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="final_norm")
         self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")
@@ -112,16 +117,16 @@ class LlamaForCausalLM(nn.Module):
         use_cache: bool = False,
         cache_offset: int | jnp.ndarray = 0,
         max_kv_len: int | None = None,
+        positions: jnp.ndarray | None = None,
     ):
         q_len = input_ids.shape[1]
         hidden = self.embed_tokens(input_ids)
         if use_cache:
             bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         else:
-            causal = jnp.tril(jnp.ones((q_len, q_len), dtype=bool))
-            bias = jnp.where(causal, 0.0, NEG_INF)[None, None]
+            bias = make_causal_bias(q_len, q_len)
             if attention_mask is not None:
                 bias = bias + mask_to_bias(attention_mask)
         for blk in self.blocks:
-            hidden = blk(hidden, bias, deterministic, use_cache)
+            hidden = blk(hidden, bias, deterministic, use_cache, positions)
         return self.lm_head(self.final_norm(hidden))

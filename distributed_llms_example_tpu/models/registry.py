@@ -147,6 +147,7 @@ def _bart_from_hf_config(cfg: dict) -> BartConfig:
         eos_token_id=cfg.get("eos_token_id", 2),
         decoder_start_token_id=cfg.get("decoder_start_token_id", 2),
         forced_bos_token_id=cfg.get("forced_bos_token_id"),
+        forced_eos_token_id=cfg.get("forced_eos_token_id"),
     )
 
 

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from distributed_llms_example_tpu.ops.attention import (
     NEG_INF,
     dot_product_attention,
+    make_causal_bias,
     mask_to_bias,
 )
 from distributed_llms_example_tpu.ops.norms import RMSNorm
@@ -279,8 +280,7 @@ class T5Stack(nn.Module):
         else:
             self_bias = self.position_bias(q_len, q_len)
             if self.causal:
-                causal = jnp.tril(jnp.ones((q_len, q_len), dtype=bool))
-                self_bias = self_bias + jnp.where(causal, 0.0, NEG_INF)[None, None]
+                self_bias = self_bias + make_causal_bias(q_len, q_len)
             if attention_mask is not None:
                 self_bias = self_bias + mask_to_bias(attention_mask)
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None

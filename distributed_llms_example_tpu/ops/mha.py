@@ -83,7 +83,11 @@ class MultiHeadAttention(nn.Module):
         kv_hidden: jnp.ndarray | None = None,
         bias: jnp.ndarray | None = None,
         use_cache: bool = False,
+        positions: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
+        """``positions``: optional (batch, q_len) absolute positions for RoPE
+        — needed when cache slots don't equal sequence positions (right-
+        padded prompts).  Defaults to cache-index/arange positions."""
         kv_src = hidden if kv_hidden is None else kv_hidden
         q = self._split(self.q_proj(hidden), self.num_heads)
         k = self._split(self.k_proj(kv_src), self.kv_heads)
@@ -93,10 +97,16 @@ class MultiHeadAttention(nn.Module):
         if use_cache and self.causal:
             # RoPE must see absolute positions, so rotate before caching
             if self.use_rope:
-                # peek the index without mutating (the mutation happens in _cache_kv)
-                idx = self.get_variable("cache", "cache_index") if self.has_variable("cache", "cache_index") else 0
-                pos_q = jnp.arange(q.shape[2]) + idx
-                cos, sin = rope_cos_sin(pos_q, self.head_dim, self.rope_theta)
+                if positions is None:
+                    # peek the index without mutating (mutation happens in _cache_kv)
+                    idx = (
+                        self.get_variable("cache", "cache_index")
+                        if self.has_variable("cache", "cache_index")
+                        else 0
+                    )
+                    positions = (jnp.arange(q.shape[2]) + idx)[None, :]
+                cos, sin = rope_cos_sin(positions, self.head_dim, self.rope_theta)
+                cos, sin = cos[:, None], sin[:, None]  # add heads axis
                 q = apply_rope(q, cos, sin)
                 k = apply_rope(k, cos, sin)
             k, v, offset = self._cache_kv(k, v)
@@ -107,8 +117,9 @@ class MultiHeadAttention(nn.Module):
             step_bias = jnp.where(valid & causal, 0.0, NEG_INF)
             bias = step_bias if bias is None else bias + step_bias
         elif self.use_rope:
-            pos = jnp.arange(q.shape[2])
+            pos = jnp.arange(q.shape[2])[None, :] if positions is None else positions
             cos, sin = rope_cos_sin(pos, self.head_dim, self.rope_theta)
+            cos, sin = cos[:, None], sin[:, None]  # add heads axis
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
 

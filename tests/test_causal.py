@@ -48,6 +48,44 @@ def test_causal_greedy_parity_uniform_prompt():
         assert g[: ge + 1] == r[: re_ + 1], (i, g, r)
 
 
+def test_causal_greedy_right_padded_rows_match_unpadded():
+    """A batch of right-padded prompts must generate exactly what each row
+    generates alone without padding (true-sequence RoPE positions)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, max_position_embeddings=64,
+        attention_dropout=0.0, pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(33)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, max_position_embeddings=64,
+    )
+    model = LlamaForCausalLM(cfg)
+    params = convert_llama_state_dict(hf.state_dict())
+    gen = make_causal_greedy(model, cfg, 6)
+
+    rng = np.random.RandomState(7)
+    row_a = rng.randint(3, 128, 9).tolist()
+    row_b = rng.randint(3, 128, 5).tolist()
+    width = 9
+    ids = np.zeros((2, width), np.int32)
+    mask = np.zeros((2, width), np.int32)
+    ids[0, :9], mask[0, :9] = row_a, 1
+    ids[1, :5], mask[1, :5] = row_b, 1
+    batched = np.asarray(gen(params, ids, mask))
+    for r, row in enumerate((row_a, row_b)):
+        solo = np.asarray(
+            gen(params, np.asarray([row], np.int32), np.ones((1, len(row)), np.int32))
+        )[0]
+        np.testing.assert_array_equal(batched[r], solo, err_msg=f"row {r}")
+
+
 def test_causal_dataset_masks_prompt():
     tok = ByteTokenizer()
     ds = CausalLMDataset(

@@ -17,7 +17,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from distributed_llms_example_tpu.ops.attention import make_causal_bias, mask_to_bias
+from distributed_llms_example_tpu.ops.attention import mask_to_bias
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.norms import LayerNorm
 
@@ -42,6 +42,7 @@ class BartConfig:
     forced_bos_token_id: Optional[int] = None
     forced_eos_token_id: Optional[int] = 2  # HF BART default: force EOS at max length
     layer_norm_epsilon: float = 1e-5
+    attention_impl: str = "auto"  # "auto" | "flash" | "xla" (see ops/mha.py)
 
     POSITION_OFFSET = 2  # HF BartLearnedPositionalEmbedding quirk
 
@@ -66,6 +67,7 @@ class BartEncoderLayer(nn.Module):
             model_dim=cfg.d_model,
             use_bias=True,
             dtype=self.dtype,
+            attention_impl=cfg.attention_impl,
             name="self_attn",
         )
         self.self_attn_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="self_attn_layer_norm")
@@ -109,6 +111,7 @@ class BartDecoderLayer(nn.Module):
             use_bias=True,
             causal=causal,
             dtype=self.dtype,
+            attention_impl=cfg.attention_impl,
             name=name,
         )
         self.self_attn = mk_attn(True, "self_attn")
@@ -205,9 +208,13 @@ class BartForConditionalGeneration(nn.Module):
         if use_cache:
             self_bias = None  # causal/validity handled inside cached attention
         else:
-            self_bias = make_causal_bias(q_len, q_len)
-            if decoder_attention_mask is not None:
-                self_bias = self_bias + mask_to_bias(decoder_attention_mask)
+            # causal masking lives inside MultiHeadAttention (natively in the
+            # flash kernel); only the padding mask is passed as a bias
+            self_bias = (
+                mask_to_bias(decoder_attention_mask)
+                if decoder_attention_mask is not None
+                else None
+            )
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
         for blk in self.decoder_blocks:
             hidden = blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache)

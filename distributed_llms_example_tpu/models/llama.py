@@ -15,7 +15,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from distributed_llms_example_tpu.ops.attention import make_causal_bias, mask_to_bias
+from distributed_llms_example_tpu.ops.attention import mask_to_bias
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.norms import RMSNorm
 
@@ -34,6 +34,7 @@ class LlamaConfig:
     pad_token_id: int = 0
     bos_token_id: int = 1
     eos_token_id: int = 2
+    attention_impl: str = "auto"  # "auto" | "flash" | "xla" (see ops/mha.py)
 
     @property
     def head_dim(self) -> int:
@@ -80,6 +81,7 @@ class LlamaBlock(nn.Module):
             use_rope=True,
             rope_theta=cfg.rope_theta,
             dtype=self.dtype,
+            attention_impl=cfg.attention_impl,
             name="self_attn",
         )
         self.mlp_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="mlp_norm")
@@ -119,14 +121,10 @@ class LlamaForCausalLM(nn.Module):
         max_kv_len: int | None = None,
         positions: jnp.ndarray | None = None,
     ):
-        q_len = input_ids.shape[1]
         hidden = self.embed_tokens(input_ids)
-        if use_cache:
-            bias = mask_to_bias(attention_mask) if attention_mask is not None else None
-        else:
-            bias = make_causal_bias(q_len, q_len)
-            if attention_mask is not None:
-                bias = bias + mask_to_bias(attention_mask)
+        # causal masking lives inside MultiHeadAttention (applied natively by
+        # the flash kernel); only the padding mask is passed as a bias
+        bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         for blk in self.blocks:
             hidden = blk(hidden, bias, deterministic, use_cache, positions)
         return self.lm_head(self.final_norm(hidden))

@@ -1,0 +1,480 @@
+"""Pallas TPU flash attention (blockwise online-softmax), fwd + bwd.
+
+The reference consumes attention as opaque CUDA/cuDNN kernels inside every
+``model(**batch)`` call (reference train-accelerator.py:220); on TPU the
+analogous hot op is this kernel: the (S, S) score matrix is never
+materialized in HBM — Q/K/V tiles stream HBM→VMEM, QK^T and PV run on the
+MXU per (block_q, block_k) tile, and the softmax is computed online with
+running max/denominator carried in VMEM scratch across the kv grid axis.
+
+Layout/conventions
+  - q, k, v: (batch, heads, seq, head_dim); output matches q.
+  - ``bias`` is additive, fp32-convertible, with every dim either 1 or the
+    full size — e.g. a (B, 1, 1, K) padding mask from
+    ``ops.attention.mask_to_bias`` or a (1, H, Q, K) T5 relative-position
+    bias.  Size-1 dims are handled in the BlockSpec index maps, so the bias
+    is never broadcast in HBM.
+  - ``causal=True`` applies the triangular mask inside the kernel (and
+    skips fully-masked kv tiles); don't also encode causality in ``bias``.
+  - The backward pass treats ``bias`` as a constant (zero gradient).  All
+    in-tree uses are padding/causal masks; T5's *learned* relative bias
+    keeps the XLA attention path (models/t5.py).
+  - Softmax statistics (running max ``m``, denominator ``l``) live in
+    (block_q, 128) fp32 scratch — TPU vector layout wants a full 128-lane
+    last dim — and the logsumexp residual is saved as (B, H, S, 128) with
+    the value replicated across lanes (same layout the backward kernels
+    read it in).
+
+Grid semantics: the kv axis is the innermost ("arbitrary") grid dimension,
+so scratch accumulators persist across kv steps for a fixed (b, h, q-tile);
+batch/heads/q-tiles are "parallel".
+
+On CPU (tests, the 8-device virtual mesh) the kernel runs in Pallas
+interpret mode; numerics are checked against ``dot_product_attention``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128  # TPU vector lane count: last-dim unit for scratch/statistics
+MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _bias_spec(bias_shape, block_q: int, block_k: int):
+    """BlockSpec for an additive bias whose dims are each 1 or full-size."""
+    b1, h1, q1, k1 = (d == 1 for d in bias_shape)
+    block = (1, 1, 1 if q1 else block_q, bias_shape[3] if k1 else block_k)
+
+    def index_map(b, h, qi, ki):
+        return (0 if b1 else b, 0 if h1 else h, 0 if q1 else qi, 0 if k1 else ki)
+
+    return pl.BlockSpec(block, index_map)
+
+
+def _causal_mask(s, qi, ki, block_q: int, block_k: int):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, MASK_VALUE)
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(
+    *refs, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
+    has_bias: bool,
+):
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        bias_ref = None
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    # with causal masking, tiles strictly above the diagonal contribute nothing
+    diag_ok = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0, 0]  # (block_q, d)
+        k = k_ref[0, 0]  # (block_k, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= scale
+        if bias_ref is not None:
+            s += bias_ref[0, 0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+
+        m_prev = m_scr[:, :1]  # (block_q, 1)
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)  # (block_q, block_k)
+        l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:] = jax.lax.broadcast_in_dim(m_next[:, 0], m_scr.shape, (0,))
+        l_scr[:] = jax.lax.broadcast_in_dim(l_next[:, 0], l_scr.shape, (0,))
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros, not NaN
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:]))
+        lse_ref[0, 0] = jnp.where(l_scr[:] == 0.0, MASK_VALUE, lse)
+
+
+def _fwd(q, k, v, bias, *, scale, causal, block_q, block_k, interpret):
+    batch, heads, q_len, d = q.shape
+    kv_len = k.shape[2]
+    nq, nk = q_len // block_q, kv_len // block_k
+    grid = (batch, heads, nq, nk)
+
+    def q_map(b, h, qi, ki):
+        return (b, h, qi, 0)
+
+    def kv_map(b, h, qi, ki):
+        return (b, h, ki, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), q_map),
+        pl.BlockSpec((1, 1, block_k, d), kv_map),
+        pl.BlockSpec((1, 1, block_k, d), kv_map),
+    ]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias.shape, block_q, block_k))
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((batch, heads, q_len, LANES), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, block_q, d), q_map),
+        pl.BlockSpec((1, 1, block_q, LANES), q_map),
+    ]
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk, has_bias=bias is not None,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*[x for x in (q, k, v, bias) if x is not None])
+    return o, lse
+
+
+# --------------------------------------------------------------- backward
+
+
+def _bwd_dq_kernel(
+    *refs, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
+    has_bias: bool,
+):
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+        bias_ref = None
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    diag_ok = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(diag_ok)
+    def _compute():
+        q, kk, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        do = do_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= scale
+        if bias_ref is not None:
+            s += bias_ref[0, 0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])  # (block_q, block_k)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    *refs, scale: float, causal: bool, block_q: int, block_k: int, nq: int,
+    has_bias: bool,
+):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        bias_ref = None
+    ki, qi = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    diag_ok = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(diag_ok)
+    def _compute():
+        q, kk, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        do = do_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= scale
+        if bias_ref is not None:
+            s += bias_ref[0, 0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_k, interpret):
+    batch, heads, q_len, d = q.shape
+    kv_len = k.shape[2]
+    nq, nk = q_len // block_q, kv_len // block_k
+
+    # delta_i = rowsum(dO ∘ O): tiny elementwise reduce, leave it to XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jax.lax.broadcast_in_dim(
+        delta, (batch, heads, q_len, LANES), (0, 1, 2)
+    )
+
+    def q_map(b, h, qi, ki):
+        return (b, h, qi, 0)
+
+    def kv_map_q(b, h, qi, ki):
+        return (b, h, ki, 0)
+
+    bias_spec = _bias_spec(bias.shape, block_q, block_k) if bias is not None else None
+    common_in = [
+        spec
+        for spec in (
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map_q),
+            pl.BlockSpec((1, 1, block_k, d), kv_map_q),
+            bias_spec,
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_q, LANES), q_map),
+            pl.BlockSpec((1, 1, block_q, LANES), q_map),
+        )
+        if spec is not None
+    ]
+    args = [x for x in (q, k, v, bias, do, lse, delta) if x is not None]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, nk=nk, has_bias=bias is not None,
+        ),
+        grid=(batch, heads, nq, nk),
+        in_specs=common_in,
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_map),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+
+    # dk/dv: kv tiles are the outer (parallel) axis, q tiles the inner
+    def q_map_kv(b, h, ki, qi):
+        return (b, h, qi, 0)
+
+    def kv_map_kv(b, h, ki, qi):
+        return (b, h, ki, 0)
+
+    if bias is not None:
+        inner = _bias_spec(bias.shape, block_q, block_k)
+
+        def swapped(b, h, ki, qi):
+            return inner.index_map(b, h, qi, ki)
+
+        bias_spec_kv = pl.BlockSpec(inner.block_shape, swapped)
+    else:
+        bias_spec_kv = None
+    dkv_in = [
+        spec
+        for spec in (
+            pl.BlockSpec((1, 1, block_q, d), q_map_kv),
+            pl.BlockSpec((1, 1, block_k, d), kv_map_kv),
+            pl.BlockSpec((1, 1, block_k, d), kv_map_kv),
+            bias_spec_kv,
+            pl.BlockSpec((1, 1, block_q, d), q_map_kv),
+            pl.BlockSpec((1, 1, block_q, LANES), q_map_kv),
+            pl.BlockSpec((1, 1, block_q, LANES), q_map_kv),
+        )
+        if spec is not None
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, nq=nq, has_bias=bias is not None,
+        ),
+        grid=(batch, heads, nk, nq),
+        in_specs=dkv_in,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), kv_map_kv),
+            pl.BlockSpec((1, 1, block_k, d), kv_map_kv),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public API
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+)
+def _flash(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd(
+        q, k, v, bias, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return o
+
+
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(
+        q, k, v, bias, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    # the kernel replicates lse across all 128 lanes — keep one lane as the
+    # residual so HBM between fwd and bwd holds (B,H,S,1), not (B,H,S,128)
+    return o, (q, k, v, bias, o, lse[..., :1])
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, bias, o, lse_lane = res
+    lse = jax.lax.broadcast_in_dim(
+        lse_lane[..., 0], (*lse_lane.shape[:-1], LANES), (0, 1, 2)
+    )
+    dq, dk, dv = _bwd(
+        q, k, v, bias, o, lse, do, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    dbias = None if bias is None else jnp.zeros_like(bias)  # bias is a mask
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+    dtype: jnp.dtype | None = None,
+) -> jnp.ndarray:
+    """Blockwise-softmax attention; drop-in for ``dot_product_attention``.
+
+    Requires seq lens divisible by the (auto-clamped) block sizes — the
+    framework's bucketed batching guarantees this for training shapes; call
+    ``flash_supported`` first for arbitrary shapes.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    block_q = min(block_q, q.shape[2])
+    block_k = min(block_k, k.shape[2])
+    if (
+        q.shape[2] % block_q
+        or k.shape[2] % block_k
+        or block_q % 8
+        or block_k % 8
+    ):
+        raise ValueError(
+            f"seq lens {q.shape[2]}/{k.shape[2]} not divisible into 8-aligned "
+            f"blocks {block_q}/{block_k}"
+        )
+    if bias is not None:
+        for i, (bd, full) in enumerate(
+            zip(bias.shape, (q.shape[0], q.shape[1], q.shape[2], k.shape[2]))
+        ):
+            if bd not in (1, full):
+                raise ValueError(f"bias dim {i} is {bd}, must be 1 or {full}")
+    if interpret is None:
+        interpret = _default_interpret()
+    out = _flash(q, k, v, bias, float(scale), bool(causal),
+                 int(block_q), int(block_k), bool(interpret))
+    return out if dtype is None else out.astype(dtype)
+
+
+def flash_supported(q_len: int, kv_len: int, head_dim: int,
+                    block_q: int = 128, block_k: int = 128) -> bool:
+    """True when shapes are flash-eligible (divisible seqs, sane head_dim)."""
+    bq, bk = min(block_q, q_len), min(block_k, kv_len)
+    return (
+        q_len % bq == 0
+        and kv_len % bk == 0
+        and bq % 8 == 0  # TPU sublane alignment
+        and bk % 8 == 0
+        and head_dim % 8 == 0
+    )

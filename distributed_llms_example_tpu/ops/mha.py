@@ -13,7 +13,15 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from distributed_llms_example_tpu.ops.attention import NEG_INF, dot_product_attention
+from distributed_llms_example_tpu.ops.attention import (
+    NEG_INF,
+    dot_product_attention,
+    make_causal_bias,
+)
+from distributed_llms_example_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_supported,
+)
 
 
 def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float = 10000.0) -> tuple:
@@ -43,6 +51,11 @@ class MultiHeadAttention(nn.Module):
     use_rope: bool = False
     rope_theta: float = 10000.0
     dtype: jnp.dtype = jnp.float32
+    # "auto": Pallas flash attention on TPU for flash-eligible shapes,
+    # XLA attention otherwise; "flash"/"xla" force a path.  The causal
+    # mask is applied inside this module (natively by the flash kernel),
+    # so callers pass only padding/cross-attention biases.
+    attention_impl: str = "auto"
 
     @property
     def kv_heads(self) -> int:
@@ -128,6 +141,43 @@ class MultiHeadAttention(nn.Module):
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
 
-        out = dot_product_attention(q, k, v, bias, dtype=self.dtype)
+        # causal masking for the non-cached path is applied here (the cached
+        # path built step_bias above): natively by the flash kernel, or as an
+        # additive bias for the XLA path.
+        causal_here = self.causal and not use_cache
+        if self._use_flash(q.shape[2], k.shape[2], use_cache):
+            out = flash_attention(q, k, v, bias, causal=causal_here, dtype=self.dtype)
+        else:
+            if causal_here:
+                step = make_causal_bias(q.shape[2], k.shape[2])
+                bias = step if bias is None else bias + step
+            out = dot_product_attention(q, k, v, bias, dtype=self.dtype)
         b, h, s, d = out.shape
         return self.o_proj(out.transpose(0, 2, 1, 3).reshape(b, s, h * d))
+
+    def _use_flash(self, q_len: int, kv_len: int, use_cache: bool) -> bool:
+        if self.attention_impl not in ("auto", "flash", "xla"):
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r}: must be 'auto', "
+                "'flash', or 'xla'"
+            )
+        if use_cache or self.attention_impl == "xla":
+            return False
+        if not flash_supported(q_len, kv_len, self.head_dim):
+            # 'flash' means "wherever eligible": single-token decode steps
+            # (q_len=1 cross-attention during cached generation) and other
+            # non-tileable shapes silently use the XLA path
+            return False
+        if self.attention_impl == "flash":
+            return True
+        # auto: compiled kernel on TPU for non-trivial score matrices.  On
+        # CPU the interpreted kernel would be pure overhead.  Restricted to
+        # single-device processes for now: under multi-device GSPMD jit an
+        # opaque pallas call can't be partitioned, so multi-chip runs take
+        # the XLA attention path unless a shard-local caller (shard_map)
+        # forces attention_impl='flash'.
+        return (
+            jax.default_backend() == "tpu"
+            and jax.device_count() == 1
+            and q_len * kv_len >= 128 * 128
+        )

@@ -1,0 +1,115 @@
+"""Flash attention (Pallas, interpret mode on CPU) vs XLA attention.
+
+Checks forward numerics and gradients of the blockwise online-softmax
+kernel against ``dot_product_attention`` — the property the reference never
+tests for its cuDNN attention (SURVEY.md §4: no tests at all).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_example_tpu.ops.attention import (
+    dot_product_attention,
+    make_causal_bias,
+    mask_to_bias,
+)
+from distributed_llms_example_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_supported,
+)
+
+B, H, D = 2, 3, 32
+
+
+def _qkv(q_len=256, kv_len=256, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32), dtype)  # noqa: E731
+    return mk(B, H, q_len, D), mk(B, H, kv_len, D), mk(B, H, kv_len, D)
+
+
+def test_forward_matches_xla():
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_forward_causal():
+    q, k, v = _qkv(256, 256)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = dot_product_attention(q, k, v, bias=make_causal_bias(256, 256))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_forward_padding_bias():
+    q, k, v = _qkv(128, 256)
+    mask = np.ones((B, 256), np.int32)
+    mask[0, 100:] = 0
+    mask[1, 37:] = 0
+    bias = mask_to_bias(jnp.asarray(mask))  # (B, 1, 1, K)
+    out = flash_attention(q, k, v, bias, block_q=64, block_k=64)
+    ref = dot_product_attention(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_forward_full_bias_bf16():
+    q, k, v = _qkv(128, 128, dtype=jnp.bfloat16)
+    rng = np.random.RandomState(1)
+    bias = jnp.asarray(rng.randn(1, H, 128, 128).astype(np.float32))
+    out = flash_attention(q, k, v, bias, block_q=64, block_k=64)
+    ref = dot_product_attention(q, k, v, bias)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("q_len,kv_len", [(128, 128), (64, 128)])
+def test_gradients_match(causal, q_len, kv_len):
+    # the rectangular case exercises the bwd kernels with nq != nk
+    # (BART cross-attention shape)
+    q, k, v = _qkv(q_len, kv_len)
+    mask = np.ones((B, kv_len), np.int32)
+    mask[0, kv_len - 38 :] = 0
+    bias = mask_to_bias(jnp.asarray(mask))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, bias, causal=causal, block_q=32, block_k=64) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        full = bias + (make_causal_bias(q_len, kv_len) if causal else 0.0)
+        return jnp.sum(dot_product_attention(q, k, v, full) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3)
+
+
+def test_grad_under_jit_and_vmap_free_shapes():
+    q, k, v = _qkv(128, 128)
+
+    @jax.jit
+    def f(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, causal=True))
+
+    g = jax.grad(f)(q, k, v)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_flash_supported():
+    assert flash_supported(1024, 1024, 64)
+    assert flash_supported(128, 256, 64)
+    assert not flash_supported(100, 128, 64)  # not divisible
+    assert not flash_supported(4, 4, 64)  # too small
+    assert not flash_supported(128, 128, 65)  # odd head dim
+
+
+def test_rejects_bad_shapes():
+    q, k, v = _qkv(100, 100)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v)

@@ -1,0 +1,28 @@
+"""Shared environment fixup for CPU-mesh child processes.
+
+This image's sitecustomize eagerly registers a TPU PJRT plugin when
+``PALLAS_AXON_POOL_IPS`` is set, which makes ``import jax`` hang or grab
+the TPU in processes that want a virtual CPU mesh.  Every entry point that
+spawns (or re-execs into) a CPU-mesh process must apply the same fixup —
+keep the logic in exactly one place.
+
+Used by ``dllm_test_bootstrap.py`` (pytest re-exec) and
+``__graft_entry__.py`` (driver dryrun subprocess).
+"""
+
+from __future__ import annotations
+
+
+def cpu_mesh_env(env: dict, n_devices: int = 8) -> dict:
+    """A copy of ``env`` corrected for an n-device virtual CPU mesh."""
+    env = dict(env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    keep = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    env["XLA_FLAGS"] = " ".join([*keep, flag])
+    return env

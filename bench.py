@@ -21,15 +21,99 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import jax
-import numpy as np
+_BENCH_CHILD = "_DLLM_BENCH_CHILD"
+
+
+def _is_json(line: str) -> bool:
+    try:
+        json.loads(line)
+        return True
+    except ValueError:
+        return False
+
+
+def _supervise() -> int:
+    """Run the real benchmark in child processes with retry + backoff.
+
+    Round-1 failure mode: the tunneled TPU backend can fail to initialize
+    transiently (``UNAVAILABLE: TPU backend setup/compile error``), and JAX
+    caches backend-init failure per process — so retry means a fresh
+    process.  On final failure print ONE parseable JSON error line (never a
+    bare traceback) and exit 0 so the driver records a parseable artifact.
+    """
+    attempts = int(os.environ.get("BENCH_RETRIES", "3"))
+    backoff = float(os.environ.get("BENCH_BACKOFF", "10"))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "600"))
+    # hard wall-clock ceiling so a hanging backend can't outlive the
+    # driver's own timeout with no JSON printed (round-1 rc=124 mode)
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "1400"))
+    here = os.path.abspath(__file__)
+    env = dict(os.environ)
+    env[_BENCH_CHILD] = "1"
+    t_start = time.monotonic()
+    tail = ""
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, here],
+                env=env,
+                cwd=os.path.dirname(here),
+                capture_output=True,
+                text=True,
+                timeout=attempt_timeout,
+            )
+        except subprocess.TimeoutExpired as e:
+            tail = f"attempt {i + 1} timed out: {e}"
+            print(tail, file=sys.stderr)
+            transient = True
+        else:
+            if proc.returncode == 0:
+                result = next(
+                    (ln for ln in reversed(proc.stdout.strip().splitlines()) if _is_json(ln)),
+                    None,
+                )
+                if result is not None:
+                    sys.stderr.write(proc.stderr)
+                    print(result)
+                    return 0
+            tail = "\n".join((proc.stderr or proc.stdout or "").strip().splitlines()[-8:])
+            print(f"bench attempt {i + 1}/{attempts} failed rc={proc.returncode}:\n{tail}", file=sys.stderr)
+            # retry only failures that look like transient backend trouble;
+            # a deterministic crash (bad model name, shape error) won't heal
+            transient = any(s in tail for s in ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Unable to initialize"))
+        if not transient:
+            break
+        if i < attempts - 1:
+            if time.monotonic() - t_start + attempt_timeout > budget:
+                print("bench: total budget exhausted, giving up", file=sys.stderr)
+                break
+            time.sleep(backoff * (2**i))
+    print(
+        json.dumps(
+            {
+                "metric": "seq2seq fine-tune train-step throughput",
+                "value": None,
+                "unit": "tokens/sec/chip",
+                "vs_baseline": None,
+                "error": "benchmark did not produce a result (see detail)",
+                "detail": tail[-500:],
+            }
+        )
+    )
+    return 0
+
+
 
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 4000.0
 
 
 def _flagship():
+    import jax
+
     from distributed_llms_example_tpu.models.registry import load_model
 
     for name in (os.environ.get("BENCH_MODEL", ""), "bart-large-cnn", "t5-small"):
@@ -43,6 +127,9 @@ def _flagship():
 
 
 def main() -> None:
+    import jax
+    import numpy as np
+
     from distributed_llms_example_tpu.core.config import MeshConfig
     from distributed_llms_example_tpu.core.mesh import build_mesh
     from distributed_llms_example_tpu.data.batching import LABEL_PAD
@@ -119,4 +206,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_BENCH_CHILD) == "1":
+        main()
+    else:
+        raise SystemExit(_supervise())

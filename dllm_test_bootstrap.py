@@ -13,14 +13,10 @@ stdout/stderr.
 import os
 import sys
 
-_FLAG = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _dllm_env import cpu_mesh_env  # noqa: E402
 
 if os.environ.get("_DLLM_TPU_TEST_REEXEC") != "1":
-    env = dict(os.environ)
+    env = cpu_mesh_env(os.environ, n_devices=8)
     env["_DLLM_TPU_TEST_REEXEC"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    if _FLAG not in env.get("XLA_FLAGS", ""):
-        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _FLAG).strip()
-    # neutralize eager TPU-plugin registration done by sitecustomize
-    env.pop("PALLAS_AXON_POOL_IPS", None)
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)

@@ -27,6 +27,7 @@ from distributed_llms_example_tpu.evaluation.generation import (
     make_greedy_generate,
 )
 from distributed_llms_example_tpu.evaluation.metrics import aggregate_mean
+from distributed_llms_example_tpu.parallel.activation import activation_mesh
 from distributed_llms_example_tpu.train.step import put_batch
 
 
@@ -71,7 +72,15 @@ class Evaluator:
             )
         else:
             gen = make_greedy_generate(self.model, self.config, self.max_new_tokens)
-        self._generate = jax.jit(gen)
+        jitted = jax.jit(gen)
+
+        # tracing must see the mesh so the models' activation constraints
+        # bake into the compiled generation program (same as the train step)
+        def generate(*args):
+            with activation_mesh(self.mesh):
+                return jitted(*args)
+
+        self._generate = generate
 
     def _decode_batch(self, ids: np.ndarray) -> list[str]:
         eos, pad = self.config.eos_token_id, self.config.pad_token_id

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from distributed_llms_example_tpu.ops.attention import mask_to_bias
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.norms import LayerNorm
+from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,9 +184,10 @@ class BartForConditionalGeneration(nn.Module):
         pos = jnp.arange(input_ids.shape[1]) + cfg.POSITION_OFFSET
         hidden = self.shared(input_ids) * cfg.embed_scale + self.encoder_embed_positions(pos)[None]
         hidden = self.dropout(self.encoder_layernorm_embedding(hidden), deterministic=deterministic)
+        hidden = constrain_hidden(hidden)
         bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         for blk in self.encoder_blocks:
-            hidden = blk(hidden, bias, deterministic)
+            hidden = constrain_hidden(blk(hidden, bias, deterministic))
         return hidden
 
     def decode(
@@ -216,9 +218,10 @@ class BartForConditionalGeneration(nn.Module):
                 else None
             )
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
+        hidden = constrain_hidden(hidden)
         for blk in self.decoder_blocks:
-            hidden = blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache)
-        logits = hidden @ self.shared.embedding.astype(self.dtype).T
+            hidden = constrain_hidden(blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache))
+        logits = constrain_logits(hidden @ self.shared.embedding.astype(self.dtype).T)
         return logits + self.final_logits_bias.astype(logits.dtype)
 
     def __call__(

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from distributed_llms_example_tpu.ops.attention import mask_to_bias
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.norms import RMSNorm
+from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,10 +122,10 @@ class LlamaForCausalLM(nn.Module):
         max_kv_len: int | None = None,
         positions: jnp.ndarray | None = None,
     ):
-        hidden = self.embed_tokens(input_ids)
+        hidden = constrain_hidden(self.embed_tokens(input_ids))
         # causal masking lives inside MultiHeadAttention (applied natively by
         # the flash kernel); only the padding mask is passed as a bias
         bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         for blk in self.blocks:
-            hidden = blk(hidden, bias, deterministic, use_cache, positions)
-        return self.lm_head(self.final_norm(hidden))
+            hidden = constrain_hidden(blk(hidden, bias, deterministic, use_cache, positions))
+        return constrain_logits(self.lm_head(self.final_norm(hidden)))

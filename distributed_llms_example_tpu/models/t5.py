@@ -32,6 +32,7 @@ from distributed_llms_example_tpu.ops.attention import (
     mask_to_bias,
 )
 from distributed_llms_example_tpu.ops.norms import RMSNorm
+from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
 
 
 @dataclasses.dataclass(frozen=True)
@@ -286,7 +287,9 @@ class T5Stack(nn.Module):
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
         hidden = self.dropout(hidden, deterministic=deterministic)
         for blk in self.blocks:
-            hidden = blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache)
+            # re-anchor the residual stream every layer so GSPMD never
+            # propagates a param sharding (d_model over fsdp/tensor) into it
+            hidden = constrain_hidden(blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache))
         return self.dropout(self.final_norm(hidden), deterministic=deterministic)
 
 
@@ -315,15 +318,17 @@ class T5ForConditionalGeneration(nn.Module):
         self, input_ids: jnp.ndarray, attention_mask: jnp.ndarray | None = None, *, deterministic: bool = True
     ) -> jnp.ndarray:
         return self.encoder(
-            self.shared(input_ids), attention_mask=attention_mask, deterministic=deterministic
+            constrain_hidden(self.shared(input_ids)),
+            attention_mask=attention_mask,
+            deterministic=deterministic,
         )
 
     def _logits(self, hidden: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
         if cfg.tie_word_embeddings:
             hidden = hidden * (cfg.d_model**-0.5)
-            return hidden @ self.shared.embedding.astype(self.dtype).T
-        return self.lm_head(hidden)
+            return constrain_logits(hidden @ self.shared.embedding.astype(self.dtype).T)
+        return constrain_logits(self.lm_head(hidden))
 
     def decode(
         self,
@@ -337,7 +342,7 @@ class T5ForConditionalGeneration(nn.Module):
         cache_offset: int | jnp.ndarray = 0,
         max_kv_len: int | None = None,
     ) -> jnp.ndarray:
-        hidden = self.shared(decoder_input_ids)
+        hidden = constrain_hidden(self.shared(decoder_input_ids))
         if use_cache:
             hidden = self.decoder(
                 hidden,

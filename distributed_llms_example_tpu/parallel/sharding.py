@@ -43,7 +43,11 @@ class ShardingRules:
     The default rule set implements FSDP+TP for the transformer layouts in
     ``models/``:
 
-    - embeddings:            (tensor, fsdp)  — vocab sharded over tensor
+    - embeddings:            ((tensor, fsdp), None) — vocab sharded over
+                             both axes, d_model replicated (a d_model/fsdp
+                             split here would push a d-sharded layout into
+                             the batch-sharded residual stream — see the
+                             rule comment below)
     - attention q/k/v/(o):   column/row split over ``tensor``, remainder
                              over ``fsdp`` (ZeRO-3 style)
     - MLP in/out:            column/row split over ``tensor``
@@ -74,8 +78,13 @@ def _clip_spec(spec: P, ndim: int) -> P:
 
 # Matches the parameter naming used by models/ (flax.linen module paths).
 DEFAULT_RULES: list[tuple[str, P]] = [
-    # token / position embeddings: (vocab, d_model)
-    (r"(shared|embed_tokens|embed_positions|lm_head)/embedding", P("tensor", "fsdp")),
+    # token / position embeddings: (vocab, d_model) — vocab over BOTH tensor
+    # and fsdp, d_model replicated.  Sharding d_model over fsdp here pushes a
+    # d-sharded layout into the batch-sharded residual stream through the
+    # gather, which GSPMD reconciles by involuntary full rematerialization
+    # (replicate + repartition) on every lookup/scatter; vocab-only sharding
+    # keeps the same per-device memory without that cliff.
+    (r"(shared|embed_tokens|embed_positions|lm_head)/embedding", P(("tensor", "fsdp"), None)),
     (r"lm_head/kernel", P("fsdp", "tensor")),
     # attention projections: q/k/v are column-parallel (d_model, heads*head_dim),
     # o is row-parallel (heads*head_dim, d_model)

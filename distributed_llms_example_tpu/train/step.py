@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_llms_example_tpu.data.batching import LABEL_PAD
 from distributed_llms_example_tpu.models.t5 import shift_right
+from distributed_llms_example_tpu.parallel.activation import activation_mesh
 from distributed_llms_example_tpu.parallel.sharding import (
     ShardingRules,
     batch_sharding,
@@ -188,18 +189,27 @@ def make_train_step(
         metrics_sh = {k: repl for k in ("loss", "learning_rate", "grad_norm", "target_tokens")}
         in_shardings = (state_sh, {"input_ids": bsh, "attention_mask": bsh, "labels": bsh})
         if with_dropout:
-            return jax.jit(
+            jitted = jax.jit(
                 step_fn,
                 in_shardings=(*in_shardings, repl),
                 out_shardings=(state_sh, metrics_sh),
                 donate_argnums=(0,) if donate else (),
             )
-        return jax.jit(
-            lambda s, b: step_fn(s, b, None),
-            in_shardings=in_shardings,
-            out_shardings=(state_sh, metrics_sh),
-            donate_argnums=(0,) if donate else (),
-        )
+        else:
+            jitted = jax.jit(
+                lambda s, b: step_fn(s, b, None),
+                in_shardings=in_shardings,
+                out_shardings=(state_sh, metrics_sh),
+                donate_argnums=(0,) if donate else (),
+            )
+
+        # tracing must see the mesh so the models' activation constraints
+        # (parallel/activation.py) bake into the compiled program
+        def run(*args):
+            with activation_mesh(mesh):
+                return jitted(*args)
+
+        return run
 
     def build(state: TrainState) -> tuple[Callable, Any]:
         sh = state_shardings(state, mesh, rules)

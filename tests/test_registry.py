@@ -59,6 +59,6 @@ def test_sharded_init_on_mesh(mesh8):
     lm = load_model("t5-test")
     params = lm.init_params(0)
     sharded = shard_params(params, mesh8)
-    emb = sharded["shared"]["embedding"]  # (256, 64) over (tensor=2, fsdp=2)
-    assert {s.data.shape for s in emb.addressable_shards} == {(128, 32)}
+    emb = sharded["shared"]["embedding"]  # (256, 64): vocab over tensor*fsdp=4, d replicated
+    assert {s.data.shape for s in emb.addressable_shards} == {(64, 64)}
     assert sorted(T5_CONFIGS) == ["flan-t5-xl", "t5-base", "t5-large", "t5-small", "t5-test"]

@@ -35,7 +35,7 @@ def _fake_params():
 def test_rule_specs():
     rules = default_rules()
     specs = rules.tree_specs(_fake_params())
-    assert specs["shared"]["embedding"] == P("tensor", "fsdp")
+    assert specs["shared"]["embedding"] == P(("tensor", "fsdp"), None)
     blk = specs["encoder"]["block_0"]
     assert blk["self_attn"]["q_proj"]["kernel"] == P("fsdp", "tensor")
     assert blk["self_attn"]["o_proj"]["kernel"] == P("tensor", "fsdp")
@@ -57,9 +57,9 @@ def test_shard_params_places_arrays(mesh8):
     params = _fake_params()
     sharded = shard_params(params, mesh8)
     emb = sharded["shared"]["embedding"]
-    # tensor axis = 2, fsdp axis = 2 → embedding split 2x2
+    # vocab dim split over tensor*fsdp = 4, d_model replicated
     shard_shapes = {s.data.shape for s in emb.addressable_shards}
-    assert shard_shapes == {(32, 16)}
+    assert shard_shapes == {(16, 32)}
     # replicated norm scale: every shard is the full array
     scale = sharded["encoder"]["block_0"]["norm"]["scale"]
     assert {s.data.shape for s in scale.addressable_shards} == {(32,)}

@@ -95,6 +95,44 @@ def test_grad_accum_matches_full_batch(mesh8, setup):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5)
 
 
+def test_sharded_step_equals_single_device(mesh8, setup):
+    """A tensor=2/fsdp=2/data=2 train step must produce the same loss,
+    grad-norm, and updated params as the identical step on a 1-device mesh
+    — the test that catches wrong sharding rules (a bad spec changes
+    numerics through mis-reduced collectives, not just performance)."""
+    import optax
+
+    from distributed_llms_example_tpu.core.config import MeshConfig
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+
+    lm, params = setup
+    tx = optax.sgd(1e-2)
+    schedule = lambda step: 1e-2  # noqa: E731
+    batch = _toy_batch(b=8)
+    batch["labels"][0:2, 3:] = LABEL_PAD  # uneven token counts across shards
+
+    mesh1 = build_mesh(MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1])
+    outs = {}
+    for name, mesh in (("sharded", mesh8), ("single", mesh1)):
+        build = make_train_step(lm.module, lm.config, tx, schedule, mesh, donate=False)
+        state = create_train_state(shard_params(params, mesh), tx)
+        sh = state_shardings(state, mesh)
+        state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+        step, _ = build(state)
+        new_state, metrics = step(state, put_batch(batch, mesh))
+        outs[name] = (
+            jax.device_get(new_state.params),
+            float(metrics["loss"]),
+            float(metrics["grad_norm"]),
+        )
+    p_sh, loss_sh, gn_sh = outs["sharded"]
+    p_1, loss_1, gn_1 = outs["single"]
+    assert loss_sh == pytest.approx(loss_1, rel=1e-5)
+    assert gn_sh == pytest.approx(gn_1, rel=1e-4)
+    for a, b in zip(jax.tree.leaves(p_sh), jax.tree.leaves(p_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5)
+
+
 def test_schedule_shape():
     s = linear_schedule_with_warmup(1e-4, warmup_steps=10, total_steps=110)
     assert float(s(0)) == 0.0

@@ -190,15 +190,32 @@ def load_model(
     dtype: jnp.dtype = jnp.float32,
     remat: bool = False,
     load_weights: bool = True,
+    attention_impl: str | None = None,
 ) -> LoadedModel:
-    """Resolve a model name or local HF checkpoint dir into a LoadedModel."""
+    """Resolve a model name or local HF checkpoint dir into a LoadedModel.
+
+    ``attention_impl`` overrides the config's attention path ("auto" /
+    "flash" / "xla", see ops/mha.py) for families that support it; T5 keeps
+    XLA attention (its learned relative-position bias would get a silent
+    zero gradient from the flash kernel).
+    """
+    if attention_impl not in (None, "auto", "flash", "xla"):
+        raise ValueError(
+            f"attention_impl={attention_impl!r}: must be 'auto', 'flash', or 'xla'"
+        )
+
+    def _apply_impl(cfg):
+        if attention_impl is not None and hasattr(cfg, "attention_impl"):
+            return dataclasses.replace(cfg, attention_impl=attention_impl)
+        return cfg
+
     if os.path.isdir(name_or_path):
         with open(os.path.join(name_or_path, "config.json")) as f:
             hf_cfg = json.load(f)
         model_type = hf_cfg.get("model_type", "t5")
         if model_type not in _HF_CONFIG_PARSERS:
             raise ValueError(f"unsupported model_type {model_type!r} at {name_or_path}")
-        cfg = _HF_CONFIG_PARSERS[model_type](hf_cfg)
+        cfg = _apply_impl(_HF_CONFIG_PARSERS[model_type](hf_cfg))
         params = None
         if load_weights:
             params = convert_state_dict(model_type, _load_local_state_dict(name_or_path))
@@ -207,11 +224,11 @@ def load_model(
     # short names: strip org prefixes like "google/" or "facebook/"
     short = name_or_path.rsplit("/", 1)[-1]
     if short in T5_CONFIGS:
-        return _build("t5", T5_CONFIGS[short], dtype, remat)
+        return _build("t5", _apply_impl(T5_CONFIGS[short]), dtype, remat)
     if short in BART_CONFIGS:
-        return _build("bart", BART_CONFIGS[short], dtype, remat)
+        return _build("bart", _apply_impl(BART_CONFIGS[short]), dtype, remat)
     if short in LLAMA_CONFIGS:
-        return _build("llama", LLAMA_CONFIGS[short], dtype, remat)
+        return _build("llama", _apply_impl(LLAMA_CONFIGS[short]), dtype, remat)
     known = sorted(T5_CONFIGS) + sorted(BART_CONFIGS) + sorted(LLAMA_CONFIGS)
     raise ValueError(
         f"unknown model {name_or_path!r}: not a local checkpoint dir and not one of {known}"

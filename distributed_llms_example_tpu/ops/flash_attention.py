@@ -439,7 +439,26 @@ def flash_attention(
     Requires seq lens divisible by the (auto-clamped) block sizes — the
     framework's bucketed batching guarantees this for training shapes; call
     ``flash_supported`` first for arbitrary shapes.
+
+    Contract notes (both enforced or documented because this is a public
+    drop-in API, not just an internal kernel):
+
+    - ``bias`` is treated as a CONSTANT mask: its gradient is zero.  Do not
+      route a *learned* additive bias (ALiBi slopes, T5 relative-position
+      tables) through it — that bias would silently stop training.  All
+      in-tree callers pass padding/causal masks only; T5's learned bias
+      keeps the XLA attention path (models/t5.py).
+    - ``causal=True`` requires ``q_len == kv_len``.  The mask is top-left
+      aligned (q_pos >= k_pos with no kv offset), which is only meaningful
+      for square self-attention; decode-style bottom-right alignment with
+      cached keys is the KV-cache path's job, not this kernel's.
     """
+    if causal and q.shape[2] != k.shape[2]:
+        raise ValueError(
+            f"causal=True requires square self-attention, got q_len={q.shape[2]} "
+            f"!= kv_len={k.shape[2]} (the mask is top-left aligned; a causal "
+            "prefix over cached keys needs the KV-cache path instead)"
+        )
     if scale is None:
         scale = q.shape[-1] ** -0.5
     block_q = min(block_q, q.shape[2])

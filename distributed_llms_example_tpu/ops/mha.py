@@ -9,9 +9,12 @@ scores + relative position bias are peculiar to it).
 
 from __future__ import annotations
 
+import math
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_llms_example_tpu.ops.attention import (
     NEG_INF,
@@ -22,6 +25,76 @@ from distributed_llms_example_tpu.ops.flash_attention import (
     flash_attention,
     flash_supported,
 )
+from distributed_llms_example_tpu.parallel.activation import BATCH_AXES, current_mesh
+from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+_IMPL_LOGGED: set[tuple] = set()
+
+
+def _log_impl_once(impl: str, reason: str) -> None:
+    """One-time JSON line saying which attention path a module selected —
+    so "flash is wired in" claims are verifiable from any run log."""
+    key = (impl, reason)
+    if key not in _IMPL_LOGGED:
+        _IMPL_LOGGED.add(key)
+        log_json({"event": "attention_impl", "impl": impl, "reason": reason})
+
+
+def _mesh_batch_shards(mesh: Mesh) -> int:
+    return math.prod(mesh.shape.get(a, 1) for a in BATCH_AXES)
+
+
+def select_attention_impl(
+    attention_impl: str,
+    *,
+    batch: int,
+    heads: int,
+    head_dim: int,
+    q_len: int,
+    kv_len: int,
+    use_cache: bool,
+    mesh: Mesh | None,
+    backend: str,
+    device_count: int,
+) -> tuple[str, str]:
+    """(impl, reason) — pure selection logic, unit-testable without TPUs.
+
+    ``auto`` picks the Pallas flash kernel on TPU for non-trivial score
+    matrices; under a multi-device mesh it additionally requires the batch
+    and head counts to split evenly over the (data×fsdp) and ``tensor``
+    axes, because multi-device flash runs per-shard under ``shard_map``
+    (an opaque pallas call can't be partitioned by GSPMD itself).
+    """
+    if attention_impl not in ("auto", "flash", "xla"):
+        raise ValueError(
+            f"attention_impl={attention_impl!r}: must be 'auto', 'flash', or 'xla'"
+        )
+    if attention_impl == "xla":
+        return "xla", "forced"
+    if use_cache:
+        return "xla", "kv-cache decode step"
+    if not flash_supported(q_len, kv_len, head_dim):
+        # 'flash' means "wherever eligible": single-token decode steps and
+        # other non-tileable shapes silently use the XLA path
+        return "xla", f"shape not tileable (q={q_len}, kv={kv_len}, d={head_dim})"
+    multi_device = device_count > 1
+    if multi_device:
+        if mesh is None:
+            return "xla", "multi-device jit without a mesh context"
+        tensor = mesh.shape.get("tensor", 1)
+        shards = _mesh_batch_shards(mesh)
+        if heads % tensor or batch % shards:
+            return "xla", (
+                f"uneven split: heads={heads} over tensor={tensor}, "
+                f"batch={batch} over {shards} data/fsdp shards"
+            )
+    if attention_impl == "flash":
+        return "flash", "forced"
+    if backend != "tpu":
+        return "xla", f"auto: backend={backend} (interpreted kernel is pure overhead)"
+    if q_len * kv_len < 128 * 128:
+        return "xla", "auto: score matrix too small to tile"
+    return "flash", "auto: TPU" + (" (shard_map per-shard)" if multi_device else "")
 
 
 def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float = 10000.0) -> tuple:
@@ -145,8 +218,22 @@ class MultiHeadAttention(nn.Module):
         # path built step_bias above): natively by the flash kernel, or as an
         # additive bias for the XLA path.
         causal_here = self.causal and not use_cache
-        if self._use_flash(q.shape[2], k.shape[2], use_cache):
-            out = flash_attention(q, k, v, bias, causal=causal_here, dtype=self.dtype)
+        mesh = current_mesh()
+        impl, reason = select_attention_impl(
+            self.attention_impl,
+            batch=q.shape[0],
+            heads=self.num_heads,
+            head_dim=self.head_dim,
+            q_len=q.shape[2],
+            kv_len=k.shape[2],
+            use_cache=use_cache,
+            mesh=mesh,
+            backend=jax.default_backend(),
+            device_count=jax.device_count(),
+        )
+        _log_impl_once(impl, reason)
+        if impl == "flash":
+            out = self._flash_run(q, k, v, bias, causal_here, mesh)
         else:
             if causal_here:
                 step = make_causal_bias(q.shape[2], k.shape[2])
@@ -155,29 +242,41 @@ class MultiHeadAttention(nn.Module):
         b, h, s, d = out.shape
         return self.o_proj(out.transpose(0, 2, 1, 3).reshape(b, s, h * d))
 
-    def _use_flash(self, q_len: int, kv_len: int, use_cache: bool) -> bool:
-        if self.attention_impl not in ("auto", "flash", "xla"):
-            raise ValueError(
-                f"attention_impl={self.attention_impl!r}: must be 'auto', "
-                "'flash', or 'xla'"
+    def _flash_run(
+        self,
+        q: jnp.ndarray,
+        k: jnp.ndarray,
+        v: jnp.ndarray,
+        bias: jnp.ndarray | None,
+        causal: bool,
+        mesh: Mesh | None,
+    ) -> jnp.ndarray:
+        """Run the Pallas kernel — directly on one device, per-shard under
+        ``shard_map`` on a mesh (batch over data×fsdp, heads over tensor;
+        attention itself never mixes batches or heads, so the kernel body
+        needs no collectives)."""
+        if mesh is None or math.prod(mesh.devices.shape) == 1:
+            return flash_attention(q, k, v, bias, causal=causal, dtype=self.dtype)
+        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
+        head_axis = "tensor" if "tensor" in mesh.shape else None
+        qkv_spec = P(batch_axes or None, head_axis, None, None)
+
+        def run(q, k, v, *rest):
+            return flash_attention(
+                q, k, v, rest[0] if rest else None, causal=causal, dtype=self.dtype
             )
-        if use_cache or self.attention_impl == "xla":
-            return False
-        if not flash_supported(q_len, kv_len, self.head_dim):
-            # 'flash' means "wherever eligible": single-token decode steps
-            # (q_len=1 cross-attention during cached generation) and other
-            # non-tileable shapes silently use the XLA path
-            return False
-        if self.attention_impl == "flash":
-            return True
-        # auto: compiled kernel on TPU for non-trivial score matrices.  On
-        # CPU the interpreted kernel would be pure overhead.  Restricted to
-        # single-device processes for now: under multi-device GSPMD jit an
-        # opaque pallas call can't be partitioned, so multi-chip runs take
-        # the XLA attention path unless a shard-local caller (shard_map)
-        # forces attention_impl='flash'.
-        return (
-            jax.default_backend() == "tpu"
-            and jax.device_count() == 1
-            and q_len * kv_len >= 128 * 128
-        )
+
+        args = (q, k, v)
+        in_specs = (qkv_spec, qkv_spec, qkv_spec)
+        if bias is not None:
+            bias_spec = P(
+                (batch_axes or None) if bias.shape[0] != 1 else None,
+                head_axis if bias.shape[1] != 1 else None,
+                None,
+                None,
+            )
+            args = (*args, bias)
+            in_specs = (*in_specs, bias_spec)
+        return jax.shard_map(
+            run, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
+        )(*args)

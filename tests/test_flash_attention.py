@@ -65,11 +65,17 @@ def test_forward_full_bias_bf16():
     )
 
 
-@pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("q_len,kv_len", [(128, 128), (64, 128)])
+@pytest.mark.parametrize(
+    "causal,q_len,kv_len",
+    [
+        (False, 128, 128),
+        (True, 128, 128),
+        # the rectangular case exercises the bwd kernels with nq != nk (BART
+        # cross-attention shape); causal+rectangular is rejected by contract
+        (False, 64, 128),
+    ],
+)
 def test_gradients_match(causal, q_len, kv_len):
-    # the rectangular case exercises the bwd kernels with nq != nk
-    # (BART cross-attention shape)
     q, k, v = _qkv(q_len, kv_len)
     mask = np.ones((B, kv_len), np.int32)
     mask[0, kv_len - 38 :] = 0
@@ -88,6 +94,14 @@ def test_gradients_match(causal, q_len, kv_len):
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3)
+
+
+def test_causal_requires_square():
+    """causal=True with q_len != kv_len is ambiguous (top-left vs decode
+    bottom-right alignment) and must be rejected, not silently mis-masked."""
+    q, k, v = _qkv(64, 128)
+    with pytest.raises(ValueError, match="square self-attention"):
+        flash_attention(q, k, v, causal=True, block_q=32, block_k=64)
 
 
 def test_grad_under_jit_and_vmap_free_shapes():

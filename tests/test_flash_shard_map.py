@@ -1,0 +1,142 @@
+"""Flash attention under GSPMD: per-shard Pallas via shard_map.
+
+VERDICT round-1 item 2: multi-chip training silently fell back to XLA
+attention because an opaque pallas call can't be partitioned.  These tests
+prove the shard_map wiring — the kernel runs per (data×fsdp, tensor) shard
+on the 8-device mesh with forward+gradient parity against XLA attention —
+and that ``attention_impl="auto"`` selects flash on TPU meshes.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llms_example_tpu.models.llama import LlamaForCausalLM
+from distributed_llms_example_tpu.models.registry import LLAMA_CONFIGS
+from distributed_llms_example_tpu.ops.mha import select_attention_impl
+from distributed_llms_example_tpu.parallel.activation import activation_mesh
+from distributed_llms_example_tpu.parallel.sharding import batch_sharding
+
+
+def test_flash_shard_map_parity_fwd_grad(mesh8):
+    """llama-test on the 2x2x2 mesh: flash (per-shard, interpreted) must
+    match XLA attention in both logits-loss and gradients."""
+    cfg = LLAMA_CONFIGS["llama-test"]
+    assert cfg.num_attention_heads % mesh8.shape["tensor"] == 0
+    mods = {
+        impl: LlamaForCausalLM(dataclasses.replace(cfg, attention_impl=impl))
+        for impl in ("xla", "flash")
+    }
+    rng = np.random.RandomState(0)
+    bsh = batch_sharding(mesh8)
+    ids = jax.device_put(rng.randint(3, cfg.vocab_size, (8, 64)).astype(np.int32), bsh)
+    mask = np.ones((8, 64), np.int32)
+    mask[0, 50:] = 0
+    mask = jax.device_put(mask, bsh)
+    params = mods["xla"].init(jax.random.PRNGKey(0), ids, mask)["params"]
+
+    results = {}
+    for impl, m in mods.items():
+        def f(p, m=m):
+            logits = m.apply({"params": p}, ids, mask)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        with activation_mesh(mesh8):
+            loss, grads = jax.jit(jax.value_and_grad(f))(params)
+        results[impl] = (float(loss), jax.device_get(grads))
+
+    l_x, g_x = results["xla"]
+    l_f, g_f = results["flash"]
+    np.testing.assert_allclose(l_x, l_f, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_x), jax.tree.leaves(g_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3)
+
+
+def test_auto_selects_flash_on_tpu_mesh(mesh8):
+    """The selection logic (pure function): auto → flash on a TPU mesh with
+    even head/batch splits; xla whenever flash can't run."""
+    base = dict(
+        batch=8, heads=4, head_dim=16, q_len=256, kv_len=256,
+        use_cache=False, mesh=mesh8, backend="tpu", device_count=8,
+    )
+    impl, reason = select_attention_impl("auto", **base)
+    assert impl == "flash" and "shard_map" in reason
+
+    # single chip: no mesh needed
+    impl, _ = select_attention_impl("auto", **{**base, "mesh": None, "device_count": 1})
+    assert impl == "flash"
+
+    # CPU backend: interpreted kernel is pure overhead
+    impl, _ = select_attention_impl("auto", **{**base, "backend": "cpu"})
+    assert impl == "xla"
+
+    # multi-device jit without a mesh context can't partition the kernel
+    impl, _ = select_attention_impl("auto", **{**base, "mesh": None})
+    assert impl == "xla"
+
+    # heads don't split over tensor=2
+    impl, _ = select_attention_impl("auto", **{**base, "heads": 3})
+    assert impl == "xla"
+
+    # batch doesn't split over data*fsdp=4
+    impl, _ = select_attention_impl("auto", **{**base, "batch": 2})
+    assert impl == "xla"
+
+    # decode steps always take the cache path
+    impl, _ = select_attention_impl("auto", **{**base, "use_cache": True})
+    assert impl == "xla"
+
+    # tiny score matrices aren't worth the kernel
+    impl, _ = select_attention_impl("auto", **{**base, "q_len": 32, "kv_len": 32})
+    assert impl == "xla"
+
+    # forced flash overrides the backend heuristic (but not eligibility)
+    impl, _ = select_attention_impl("flash", **{**base, "backend": "cpu"})
+    assert impl == "flash"
+    impl, _ = select_attention_impl("flash", **{**base, "backend": "cpu", "use_cache": True})
+    assert impl == "xla"
+
+
+def test_flash_shard_map_in_train_step(mesh8):
+    """End to end: a full sharded train step with attention_impl='flash'
+    produces the same loss/grad-norm as the XLA-attention step."""
+    import optax
+
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.parallel.sharding import shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    rng = np.random.RandomState(3)
+    batch = {
+        "input_ids": rng.randint(3, 250, (8, 64)).astype(np.int32),
+        "attention_mask": np.ones((8, 64), np.int32),
+        "labels": rng.randint(3, 250, (8, 64)).astype(np.int32),
+    }
+    batch["labels"][:, :16] = -100
+    tx = optax.sgd(1e-2)
+    sched = lambda s: 1e-2  # noqa: E731
+
+    metrics_by_impl = {}
+    for impl in ("xla", "flash"):
+        lm = load_model("llama-test", attention_impl=impl)
+        params = jax.device_get(lm.init_params(0))
+        build = make_train_step(
+            lm.module, lm.config, tx, sched, mesh8, donate=False, is_seq2seq=False
+        )
+        state = create_train_state(shard_params(params, mesh8), tx)
+        sh = state_shardings(state, mesh8)
+        state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+        step, _ = build(state)
+        _, metrics = step(state, put_batch(batch, mesh8))
+        metrics_by_impl[impl] = (float(metrics["loss"]), float(metrics["grad_norm"]))
+
+    (l_x, g_x), (l_f, g_f) = metrics_by_impl["xla"], metrics_by_impl["flash"]
+    np.testing.assert_allclose(l_x, l_f, rtol=1e-5)
+    np.testing.assert_allclose(g_x, g_f, rtol=1e-3)

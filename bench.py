@@ -148,7 +148,7 @@ def main() -> None:
 
     src_len, tgt_len = 1024, 128
     batch = int(os.environ.get("BENCH_BATCH", "8")) * n_chips
-    steps = int(os.environ.get("BENCH_STEPS", "5"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "5")))
 
     rng = np.random.RandomState(0)
     vocab = lm.config.vocab_size
@@ -178,21 +178,51 @@ def main() -> None:
         _ = jax.device_get(leaf.ravel()[0])
         return float(jax.device_get(metrics["loss"]))
 
+    tokens_per_step = int(np.sum(b["attention_mask"])) + int(np.sum(b["labels"] != LABEL_PAD))
+    n_params = int(sum(x.size for x in jax.tree.leaves(params)))
+
+    # Per-step FLOPs: compiler cost analysis of the actual program when the
+    # backend reports it, else the standard 6*N*tokens training estimate
+    # (fwd 2N + bwd 4N matmul FLOPs per token; attention excluded, so MFU
+    # is slightly conservative relative to true utilization).
+    from distributed_llms_example_tpu.parallel.activation import activation_mesh
+
+    flops_per_step = 0.0
+    try:
+        # HLO-level analysis on the Lowered stage: no second backend compile.
+        # Must lower under the mesh context — jit caches the traced jaxpr,
+        # and a trace made without the ambient mesh would bake constraint
+        # no-ops into the very program the benchmark then measures.
+        with activation_mesh(step_fn.mesh):
+            ca = step_fn.jitted.lower(state, gb).cost_analysis()
+        if isinstance(ca, list):  # some backends return one dict per device
+            ca = ca[0] if ca else {}
+        flops_per_step = float(ca.get("flops", 0.0))
+    except Exception as e:
+        print(f"bench: cost_analysis unavailable ({e}); using 6*N*tokens", file=sys.stderr)
+    if flops_per_step <= 0.0:
+        flops_per_step = 6.0 * n_params * tokens_per_step
+
     # warmup/compile
     for _ in range(2):
         state, metrics = step_fn(state, gb)
     sync(state, metrics)
 
-    t0 = time.perf_counter()
+    times = []
+    loss = float("nan")
     for _ in range(steps):
+        t0 = time.perf_counter()
         state, metrics = step_fn(state, gb)
-    loss = sync(state, metrics)
-    dt = time.perf_counter() - t0
+        loss = sync(state, metrics)
+        times.append(time.perf_counter() - t0)
+    dt = sum(times)
     assert loss == loss, "non-finite loss"
 
-    tokens_per_step = int(np.sum(b["attention_mask"])) + int(np.sum(b["labels"] != LABEL_PAD))
+    peak_flops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12  # v5e bf16
+    order = sorted(times)
     tps = tokens_per_step * steps / dt
     tps_chip = tps / n_chips
+    mfu = flops_per_step * steps / dt / (n_chips * peak_flops)
     print(
         json.dumps(
             {
@@ -200,6 +230,17 @@ def main() -> None:
                 "value": round(tps_chip, 1),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(tps_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3),
+                "mfu": round(mfu, 4),
+                "model_flops_per_token": round(flops_per_step / tokens_per_step),
+                "params": n_params,
+                "chips": n_chips,
+                "backend": jax.default_backend(),
+                "step_time_ms": {
+                    "p50": round(order[len(order) // 2] * 1e3, 1),
+                    "p90": round(order[min(len(order) - 1, int(0.9 * len(order)))] * 1e3, 1),
+                    "min": round(order[0] * 1e3, 1),
+                    "max": round(order[-1] * 1e3, 1),
+                },
             }
         )
     )

@@ -109,6 +109,11 @@ class TrainConfig:
     # --- logging (reference cadences: 10/300/100 steps; we default to 100) ---
     log_every_steps: int = 100
 
+    # --- profiling (SURVEY.md §7 step 8: jax.profiler hooks; the reference's
+    #     only "profiling" is an nvidia-smi report at startup) ---
+    profile_dir: str = ""  # "" = profiling off; else write a trace here
+    profile_steps: int = 3  # trace this many steps after the first (compiled) one
+
     # --- nested ---
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
@@ -151,6 +156,8 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num-beams", type=int, default=_D.num_beams)
     p.add_argument("--log-every-steps", type=int, default=_D.log_every_steps)
     p.add_argument("--tokenizer", type=str, default=_D.tokenizer)
+    p.add_argument("--profile-dir", type=str, default=_D.profile_dir)
+    p.add_argument("--profile-steps", type=int, default=_D.profile_steps)
     p.add_argument("--save-every-steps", type=int, default=_D.checkpoint.save_every_steps)
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--mesh", type=str, default="data=-1", help="comma list axis=size, e.g. data=2,fsdp=4,tensor=1")

@@ -209,6 +209,8 @@ def make_train_step(
             with activation_mesh(mesh):
                 return jitted(*args)
 
+        run.jitted = jitted  # AOT access (bench.py cost analysis, memory audits)
+        run.mesh = mesh
         return run
 
     def build(state: TrainState) -> tuple[Callable, Any]:

@@ -32,7 +32,7 @@ import numpy as np
 from distributed_llms_example_tpu.core.config import TrainConfig
 from distributed_llms_example_tpu.core.mesh import build_mesh, device_report
 from distributed_llms_example_tpu.core.precision import parse_dtype
-from distributed_llms_example_tpu.data.batching import BatchIterator
+from distributed_llms_example_tpu.data.batching import LABEL_PAD, BatchIterator
 from distributed_llms_example_tpu.data.dataset import CausalLMDataset, SummarizationDataset
 from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
 from distributed_llms_example_tpu.evaluation.evaluate import Evaluator
@@ -188,6 +188,16 @@ class Trainer:
         log_json({"event": "eval", **scores})
         return scores
 
+    def _batch_tokens(self, batch: dict) -> int:
+        """Non-pad tokens processed in one host-local batch — source plus
+        target for seq2seq; for causal LM the attention mask already covers
+        prompt+target, so counting labels again would double-count.  Must
+        stay consistent with bench.py so "tokens/sec" means one thing."""
+        tokens = int(np.sum(batch["attention_mask"]))
+        if self.loaded.is_seq2seq:
+            tokens += int(np.sum(batch["labels"] != LABEL_PAD))
+        return tokens
+
     def train(self) -> dict[str, Any]:
         cfg = self.cfg
         logger = MetricLogger(every=cfg.log_every_steps)
@@ -196,10 +206,20 @@ class Trainer:
         last_eval: dict[str, float] = {}
         steps_per_epoch = self.batches.steps_per_epoch()
         start_epoch = step // steps_per_epoch
+        profile_stop_step = 0
+        profiling_active = False
+        if cfg.profile_dir and cfg.profile_steps > 0:
+            # skip step 1 (compilation) so the trace holds steady-state steps;
+            # the traced window is [start, start + profile_steps - 1] inclusive
+            profile_start_step = self.start_step + 2
+            profile_stop_step = profile_start_step + cfg.profile_steps - 1
         for epoch in range(start_epoch, cfg.num_epochs):
             for i, batch in enumerate(self.batches.epoch(epoch)):
                 if epoch == start_epoch and i < step - start_epoch * steps_per_epoch:
                     continue  # fast-forward within the resumed epoch
+                if profile_stop_step and step + 1 == profile_start_step:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling_active = True
                 gb = put_batch(batch, self.mesh)
                 if self.use_dropout:
                     self._rng, sub = jax.random.split(self._rng)
@@ -207,7 +227,12 @@ class Trainer:
                 else:
                     self.state, metrics = self.train_step(self.state, gb)
                 step += 1
-                tokens = int(np.sum(batch["attention_mask"])) * jax.process_count()
+                if profiling_active and step == profile_stop_step:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    log_json({"event": "profile_trace", "dir": cfg.profile_dir, "steps": cfg.profile_steps})
+                    profiling_active = False
+                tokens = self._batch_tokens(batch) * jax.process_count()
                 logger.step(
                     step,
                     float(metrics["loss"]),
@@ -220,6 +245,12 @@ class Trainer:
                 if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
                     last_eval = self.evaluate(epoch)
             last_eval = self.evaluate(epoch)  # per-epoch eval, reference parity
+        if profiling_active:
+            # training ended inside the trace window — close it so the trace
+            # (however short) is flushed rather than lost
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            log_json({"event": "profile_trace", "dir": cfg.profile_dir, "truncated": True})
         self.checkpointer.save(self.total_steps, self.state, force=True)
         self.checkpointer.wait()
         self.save_final()

@@ -73,6 +73,39 @@ def test_trainer_resume(tiny_cfg):
     assert result["steps"] == trainer.total_steps  # nothing re-run
 
 
+def test_trainer_profile_trace(tmp_path, capsys):
+    """--profile-dir wiring: a short run must produce a jax.profiler trace
+    (SURVEY.md §7 step 8) and log a profile_trace event."""
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model_ckpt="t5-test",
+        output_dir=str(tmp_path / "out"),
+        batch_size=8,
+        num_epochs=1,
+        warmup_steps=1,
+        evaluation_steps=0,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        log_every_steps=1,
+        mesh=MeshConfig(data=-1),
+        checkpoint=CheckpointConfig(resume=False, async_save=False),
+        tokenizer="byte",
+        profile_dir=str(tmp_path / "trace"),
+        profile_steps=2,
+    )
+    Trainer(cfg, train_records=_records()).train()
+    lines = [json.loads(ln) for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")]
+    assert any(p.get("event") == "profile_trace" for p in lines)
+    trace_files = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(cfg.profile_dir)
+        for f in fs
+    ]
+    assert trace_files, f"no trace files under {cfg.profile_dir}"
+
+
 def test_trainer_batch_too_large():
     from distributed_llms_example_tpu.train.trainer import Trainer
 

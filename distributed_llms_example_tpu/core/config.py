@@ -94,6 +94,7 @@ class TrainConfig:
     target_column: str = "summary"  # with "highlights" fallback
     shuffle_seed: int = 1234  # reference DataPartitioner seed (train-task.py:46)
     pad_to_multiple: int = 128  # TPU-idiomatic version of pad_to_multiple_of=8
+    prefetch_batches: int = 2  # host batches assembled ahead of the device; 0 = off
 
     # --- precision / memory ---
     param_dtype: str = "float32"
@@ -156,6 +157,7 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num-beams", type=int, default=_D.num_beams)
     p.add_argument("--log-every-steps", type=int, default=_D.log_every_steps)
     p.add_argument("--tokenizer", type=str, default=_D.tokenizer)
+    p.add_argument("--prefetch-batches", type=int, default=_D.prefetch_batches)
     p.add_argument("--profile-dir", type=str, default=_D.profile_dir)
     p.add_argument("--profile-steps", type=int, default=_D.profile_steps)
     p.add_argument("--save-every-steps", type=int, default=_D.checkpoint.save_every_steps)

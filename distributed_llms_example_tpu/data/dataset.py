@@ -84,8 +84,15 @@ class Example:
 
 
 class SummarizationDataset:
-    """Tokenized summarization examples with truncation (no padding here —
-    padding is the batcher's job so shapes can be bucketed)."""
+    """Summarization examples, tokenized LAZILY with truncation (no padding
+    here — padding is the batcher's job so shapes can be bucketed).
+
+    The reference tokenizes the entire corpus up front on every rank
+    (``dataset.map`` before the loop, train-accelerator.py:144-153); round-1
+    of this framework copied that in ``__init__``, serializing minutes of
+    host work before step 1.  Tokenization now happens on first access per
+    example (memoized), so startup cost is one batch and the rest overlaps
+    training via the prefetcher."""
 
     def __init__(
         self,
@@ -98,21 +105,27 @@ class SummarizationDataset:
         target_column: str = "",
     ):
         self.tokenizer = tokenizer
-        self.examples: list[Example] = []
-        if not records:
-            return
-        src_col, tgt_col = resolve_columns(dict(records[0]), source_column, target_column)
-        eos = tokenizer.eos_id
-        for r in records:
-            src = tokenizer.encode(str(r[src_col]))[: max_source_length - 1] + [eos]
-            tgt = tokenizer.encode(str(r[tgt_col]))[: max_target_length - 1] + [eos]
-            self.examples.append(Example(src, tgt))
+        self._records = records
+        self._max_source_length = max_source_length
+        self._max_target_length = max_target_length
+        self._cache: list[Example | None] = [None] * len(records)
+        if records:
+            self._src_col, self._tgt_col = resolve_columns(
+                dict(records[0]), source_column, target_column
+            )
 
     def __len__(self) -> int:
-        return len(self.examples)
+        return len(self._records)
 
     def __getitem__(self, i: int) -> Example:
-        return self.examples[i]
+        ex = self._cache[i]
+        if ex is None:
+            r = self._records[i]
+            eos = self.tokenizer.eos_id
+            src = self.tokenizer.encode(str(r[self._src_col]))[: self._max_source_length - 1] + [eos]
+            tgt = self.tokenizer.encode(str(r[self._tgt_col]))[: self._max_target_length - 1] + [eos]
+            ex = self._cache[i] = Example(src, tgt)
+        return ex
 
 
 @dataclasses.dataclass
@@ -139,24 +152,28 @@ class CausalLMDataset:
         target_column: str = "",
     ):
         self.tokenizer = tokenizer
-        self.examples: list[CausalExample] = []
-        if not records:
-            return
-        src_col, tgt_col = resolve_columns(dict(records[0]), source_column, target_column)
-        eos = tokenizer.eos_id
-        for r in records:
-            tgt = tokenizer.encode(str(r[tgt_col]))[: max_target_length - 1] + [eos]
-            max_prompt = max(1, max_length - len(tgt))
-            src = tokenizer.encode(str(r[src_col]))[:max_prompt]
-            ids = src + tgt
-            labels = [-100] * len(src) + tgt
-            self.examples.append(CausalExample(ids, labels, src, tgt))
+        self._records = records
+        self._max_length = max_length
+        self._max_target_length = max_target_length
+        self._cache: list[CausalExample | None] = [None] * len(records)
+        if records:
+            self._src_col, self._tgt_col = resolve_columns(
+                dict(records[0]), source_column, target_column
+            )
 
     def __len__(self) -> int:
-        return len(self.examples)
+        return len(self._records)
 
     def __getitem__(self, i: int) -> CausalExample:
-        return self.examples[i]
+        ex = self._cache[i]
+        if ex is None:
+            r = self._records[i]
+            eos = self.tokenizer.eos_id
+            tgt = self.tokenizer.encode(str(r[self._tgt_col]))[: self._max_target_length - 1] + [eos]
+            max_prompt = max(1, self._max_length - len(tgt))
+            src = self.tokenizer.encode(str(r[self._src_col]))[:max_prompt]
+            ex = self._cache[i] = CausalExample(src + tgt, [-100] * len(src) + tgt, src, tgt)
+        return ex
 
 
 def epoch_order(n: int, *, seed: int, epoch: int, shuffle: bool = True) -> np.ndarray:

@@ -1,0 +1,93 @@
+"""Background-thread batch prefetching.
+
+SURVEY.md §7 hard-part 7: hitting the throughput target needs input work
+(tokenize, pad, bucket) overlapped with device steps — the reference builds
+every batch on the critical path between optimizer steps (its DataLoaders
+run with default num_workers=0).  A thread is the right tool here: batch
+assembly is numpy/tokenizer work that releases the GIL for its hot parts,
+and the consumer blocks in XLA dispatch anyway.
+
+``Prefetcher`` wraps any iterator: a daemon thread fills a bounded queue
+``depth`` items ahead; producer exceptions re-raise in the consumer at the
+point of failure; early consumer exit (``close()``, GC, or ``with``) stops
+the producer promptly instead of leaking the thread on an unbounded queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator
+
+_DONE = object()
+
+
+class Prefetcher:
+    def __init__(self, it: Iterable, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: BaseException | None = None
+        self._finished = False  # latched: never block on the queue again
+        self._thread = threading.Thread(target=self._fill, args=(iter(it),), daemon=True)
+        self._thread.start()
+
+    def _fill(self, it: Iterator) -> None:
+        try:
+            for item in it:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._err = e
+        # _err is visible before the consumer sees _DONE (queue is a barrier)
+        while not self._stop.is_set():
+            try:
+                self._q.put(_DONE, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        # latched terminal state: the producer thread is gone, so another
+        # q.get() would block forever (after exhaustion, a producer error
+        # the consumer caught and retried past, or close())
+        if self._finished:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        item = self._q.get()
+        if item is _DONE:
+            self._finished = True
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._finished = True
+        self._stop.set()
+        # drain so a blocked producer can observe the stop event
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        self.close()

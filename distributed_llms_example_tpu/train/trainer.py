@@ -34,6 +34,7 @@ from distributed_llms_example_tpu.core.mesh import build_mesh, device_report
 from distributed_llms_example_tpu.core.precision import parse_dtype
 from distributed_llms_example_tpu.data.batching import LABEL_PAD, BatchIterator
 from distributed_llms_example_tpu.data.dataset import CausalLMDataset, SummarizationDataset
+from distributed_llms_example_tpu.data.prefetch import Prefetcher
 from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
 from distributed_llms_example_tpu.evaluation.evaluate import Evaluator
 from distributed_llms_example_tpu.io.checkpoint import Checkpointer, abstract_like
@@ -214,36 +215,47 @@ class Trainer:
             profile_start_step = self.start_step + 2
             profile_stop_step = profile_start_step + cfg.profile_steps - 1
         for epoch in range(start_epoch, cfg.num_epochs):
-            for i, batch in enumerate(self.batches.epoch(epoch)):
-                if epoch == start_epoch and i < step - start_epoch * steps_per_epoch:
-                    continue  # fast-forward within the resumed epoch
-                if profile_stop_step and step + 1 == profile_start_step:
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    profiling_active = True
-                gb = put_batch(batch, self.mesh)
-                if self.use_dropout:
-                    self._rng, sub = jax.random.split(self._rng)
-                    self.state, metrics = self.train_step(self.state, gb, sub)
-                else:
-                    self.state, metrics = self.train_step(self.state, gb)
-                step += 1
-                if profiling_active and step == profile_stop_step:
-                    jax.block_until_ready(metrics["loss"])
-                    jax.profiler.stop_trace()
-                    log_json({"event": "profile_trace", "dir": cfg.profile_dir, "steps": cfg.profile_steps})
-                    profiling_active = False
-                tokens = self._batch_tokens(batch) * jax.process_count()
-                logger.step(
-                    step,
-                    float(metrics["loss"]),
-                    lr=float(metrics["learning_rate"]),
-                    tokens=tokens,
-                    epoch=epoch,
-                )
-                if self.checkpointer.should_save(step):
-                    self.checkpointer.save(step, self.state)
-                if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
-                    last_eval = self.evaluate(epoch)
+            # assemble host batches (tokenize/pad/bucket) on a background
+            # thread, prefetch_batches ahead, so input work overlaps the
+            # device step instead of sitting on the critical path
+            epoch_batches = self.batches.epoch(epoch)
+            if cfg.prefetch_batches > 0:
+                epoch_batches = Prefetcher(epoch_batches, depth=cfg.prefetch_batches)
+            try:
+                for i, batch in enumerate(epoch_batches):
+                    if epoch == start_epoch and i < step - start_epoch * steps_per_epoch:
+                        continue  # fast-forward within the resumed epoch
+                    if profile_stop_step and step + 1 == profile_start_step:
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        profiling_active = True
+                    gb = put_batch(batch, self.mesh)
+                    if self.use_dropout:
+                        self._rng, sub = jax.random.split(self._rng)
+                        self.state, metrics = self.train_step(self.state, gb, sub)
+                    else:
+                        self.state, metrics = self.train_step(self.state, gb)
+                    step += 1
+                    if profiling_active and step == profile_stop_step:
+                        jax.block_until_ready(metrics["loss"])
+                        jax.profiler.stop_trace()
+                        log_json({"event": "profile_trace", "dir": cfg.profile_dir, "steps": cfg.profile_steps})
+                        profiling_active = False
+                    tokens = self._batch_tokens(batch) * jax.process_count()
+                    logger.step(
+                        step,
+                        float(metrics["loss"]),
+                        lr=float(metrics["learning_rate"]),
+                        tokens=tokens,
+                        epoch=epoch,
+                    )
+                    if self.checkpointer.should_save(step):
+                        self.checkpointer.save(step, self.state)
+                    if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
+                        last_eval = self.evaluate(epoch)
+            finally:
+                # stop the producer thread even when the loop body raises
+                if isinstance(epoch_batches, Prefetcher):
+                    epoch_batches.close()
             last_eval = self.evaluate(epoch)  # per-epoch eval, reference parity
         if profiling_active:
             # training ended inside the trace window — close it so the trace

@@ -1,0 +1,110 @@
+"""Input-pipeline overlap: Prefetcher semantics + lazy tokenization.
+
+VERDICT round-1 item 5: the constructor tokenized the whole corpus on every
+host and each batch was assembled on the critical path.  These tests pin
+the new behavior: zero tokenizer calls at construction, memoized access,
+prefetch preserving order/exceptions, and actual producer/consumer overlap.
+"""
+
+import time
+
+import pytest
+
+from distributed_llms_example_tpu.data.dataset import CausalLMDataset, SummarizationDataset
+from distributed_llms_example_tpu.data.prefetch import Prefetcher
+from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
+
+
+class CountingTokenizer:
+    """Wraps the byte tokenizer, counting encode calls."""
+
+    def __init__(self):
+        self._tok = get_tokenizer("byte", "")
+        self.encode_calls = 0
+
+    def encode(self, text):
+        self.encode_calls += 1
+        return self._tok.encode(text)
+
+    def __getattr__(self, name):
+        return getattr(self._tok, name)
+
+
+RECORDS = [{"dialogue": f"dialogue number {i}", "summary": f"sum {i}"} for i in range(16)]
+
+
+def test_dataset_tokenizes_lazily_and_memoizes():
+    tok = CountingTokenizer()
+    ds = SummarizationDataset(RECORDS, tok)
+    assert tok.encode_calls == 0, "construction must not tokenize the corpus"
+    ex = ds[3]
+    assert tok.encode_calls == 2  # source + target
+    assert ds[3] is ex, "second access must hit the memo, not re-tokenize"
+    assert tok.encode_calls == 2
+    assert ex.input_ids[-1] == tok.eos_id
+
+
+def test_causal_dataset_tokenizes_lazily():
+    tok = CountingTokenizer()
+    ds = CausalLMDataset(RECORDS, tok, max_length=64)
+    assert tok.encode_calls == 0
+    ex = ds[0]
+    assert tok.encode_calls == 2
+    assert ex.labels[: len(ex.prompt_ids)] == [-100] * len(ex.prompt_ids)
+    ds[0]
+    assert tok.encode_calls == 2
+
+
+def test_prefetcher_preserves_order():
+    with Prefetcher(iter(range(100)), depth=3) as pf:
+        assert list(pf) == list(range(100))
+
+
+def test_prefetcher_propagates_exception():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("producer blew up")
+
+    pf = Prefetcher(gen(), depth=2)
+    assert next(pf) == 1
+    assert next(pf) == 2
+    with pytest.raises(RuntimeError, match="producer blew up"):
+        next(pf)
+
+
+def test_prefetcher_overlaps_producer_and_consumer():
+    """With production and consumption each taking ~t per item, overlap
+    means total wall time ≈ max(producer, consumer), not their sum."""
+    n, t = 10, 0.03
+
+    def slow_producer():
+        for i in range(n):
+            time.sleep(t)
+            yield i
+
+    start = time.perf_counter()
+    for _ in Prefetcher(slow_producer(), depth=2):
+        time.sleep(t)  # consumer work
+    elapsed = time.perf_counter() - start
+    serial = 2 * n * t
+    # generous margin for CI jitter: must still clearly beat serial execution
+    assert elapsed < serial * 0.8, f"no overlap: {elapsed:.3f}s vs serial {serial:.3f}s"
+
+
+def test_prefetcher_close_stops_producer():
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    pf = Prefetcher(gen(), depth=2)
+    assert next(pf) == 0
+    pf.close()
+    time.sleep(0.2)
+    n_after_close = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n_after_close, "producer kept running after close()"
+    assert n_after_close < 1000

@@ -16,8 +16,11 @@ Parity targets in the reference:
   (its variant C re-uses one fixed shard forever and every rank loads the
   whole file, train-task.py:373-380).
 
-A C++ loader for large JSONL files lives in ``native/``; this module is the
-always-available Python path with the same semantics.
+JSONL files are parsed by the C++ loader in ``native/`` (compiled on
+demand; parse + string-unescape happen outside the interpreter and records
+materialize lazily); the pure-Python ``json.loads`` path below is the
+always-available fallback with identical semantics and also handles the
+non-JSONL layouts (JSON array, {"data": [...]} wrapper).
 """
 
 from __future__ import annotations
@@ -35,19 +38,44 @@ SOURCE_COLUMNS = ("dialogue", "article", "document", "text")
 TARGET_COLUMNS = ("summary", "highlights", "target")
 
 
-def load_json_records(path: str) -> list[dict]:
-    """Load a JSON array / JSONL / {"data": [...]} file into records."""
+def load_json_records(path: str) -> Sequence[dict]:
+    """Load a JSON array / JSONL / {"data": [...]} file into records.
+
+    JSONL goes through the native C++ loader when it is available (returns
+    a lazy zero-copy sequence); anything the native parser rejects — and
+    the non-line-delimited layouts — takes the Python path."""
+    import os
+
+    from distributed_llms_example_tpu import native
+
     with open(path, "r", encoding="utf-8") as f:
         head = f.read(1)
         f.seek(0)
+        if head == "{" and native.available() and os.environ.get("DLLM_NATIVE_JSONL", "1") != "0":
+            try:
+                recs = native.load_jsonl(path)
+            except ValueError:
+                pass  # multi-line object / data-wrapper → Python path below
+            else:
+                if len(recs) == 1 and isinstance(recs[0].get("data"), list):
+                    return recs[0]["data"]  # single-line {"data": [...]} wrapper
+                return recs
         if head == "[":
             return json.load(f)
         if head == "{":
-            first = json.loads(f.readline())
-            rest = [json.loads(line) for line in f if line.strip()]
-            if not rest and isinstance(first.get("data"), list):
-                return first["data"]
-            return [first, *rest]
+            try:
+                records = [json.loads(line) for line in f if line.strip()]
+            except json.JSONDecodeError:
+                # not line-delimited (e.g. a pretty-printed {"data": [...]}
+                # wrapper): parse the whole file as one JSON value
+                f.seek(0)
+                whole = json.load(f)
+                if isinstance(whole.get("data"), list):
+                    return whole["data"]
+                return [whole]
+            if len(records) == 1 and isinstance(records[0].get("data"), list):
+                return records[0]["data"]
+            return records
         raise ValueError(f"{path}: not a JSON array, JSONL, or data-wrapper file")
 
 

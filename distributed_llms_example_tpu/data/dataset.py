@@ -51,14 +51,22 @@ def load_json_records(path: str) -> Sequence[dict]:
     with open(path, "r", encoding="utf-8") as f:
         head = f.read(1)
         f.seek(0)
-        if head == "{" and native.available() and os.environ.get("DLLM_NATIVE_JSONL", "1") != "0":
+        use_native = (
+            head == "{"
+            # env check first: opting out must not trigger the g++ build
+            and os.environ.get("DLLM_NATIVE_JSONL", "1") != "0"
+            and native.available()
+        )
+        if use_native:
             try:
                 recs = native.load_jsonl(path)
             except ValueError:
                 pass  # multi-line object / data-wrapper → Python path below
             else:
-                if len(recs) == 1 and isinstance(recs[0].get("data"), list):
-                    return recs[0]["data"]  # single-line {"data": [...]} wrapper
+                if len(recs) == 1:
+                    only = recs[0]  # materialize once: json.loads runs on access
+                    if isinstance(only.get("data"), list):
+                        return only["data"]  # single-line {"data": [...]} wrapper
                 return recs
         if head == "[":
             return json.load(f)

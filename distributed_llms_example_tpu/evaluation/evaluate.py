@@ -23,6 +23,7 @@ from distributed_llms_example_tpu.data.tokenizer import Tokenizer
 from distributed_llms_example_tpu.evaluation import rouge as rouge_mod
 from distributed_llms_example_tpu.evaluation.generation import (
     make_beam_search,
+    make_causal_beam_search,
     make_causal_greedy,
     make_greedy_generate,
 )
@@ -63,9 +64,14 @@ class Evaluator:
 
     def __post_init__(self) -> None:
         if not self.is_seq2seq:
-            # decoder-only models: prefill+decode greedy (beam search for
-            # causal models is future work; num_beams is ignored)
-            gen = make_causal_greedy(self.model, self.config, self.max_new_tokens)
+            # decoder-only models: prompt prefill + cached decode, beam or
+            # greedy per num_beams (reference live contract: beams=2)
+            if self.num_beams > 1:
+                gen = make_causal_beam_search(
+                    self.model, self.config, self.max_new_tokens, self.num_beams, self.length_penalty
+                )
+            else:
+                gen = make_causal_greedy(self.model, self.config, self.max_new_tokens)
         elif self.num_beams > 1:
             gen = make_beam_search(
                 self.model, self.config, self.max_new_tokens, self.num_beams, self.length_penalty

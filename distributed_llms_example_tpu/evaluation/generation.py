@@ -80,14 +80,47 @@ def make_greedy_generate(model: Any, config: Any, max_new_tokens: int) -> Callab
     return generate
 
 
+def _causal_prefill(
+    model: Any, params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray, new_tokens: int
+):
+    """One-pass prompt prefill for decoder-only decode.
+
+    Allocates cache buffers for prompt + generation, runs the prompt
+    through once, and returns ``(cache, full_mask, lengths, first_logits)``
+    where ``first_logits`` is each row's logits at its last *valid* prompt
+    position.  Right-padded prompts are supported: RoPE positions follow
+    the true sequence (cumsum over the mask), not the cache slot, and pad
+    slots stay masked out of attention."""
+    B, P = input_ids.shape
+    width = P + new_tokens
+    shapes = jax.eval_shape(
+        lambda p: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((B, width), jnp.int32), use_cache=True
+        ),
+        params,
+    )
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+    full_mask = jnp.concatenate([attention_mask, jnp.zeros((B, new_tokens), jnp.int32)], axis=1)
+    lengths = jnp.sum(attention_mask, axis=1).astype(jnp.int32)
+    prefill_pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None)
+    logits, mut = model.apply(
+        {"params": params, "cache": cache},
+        input_ids,
+        full_mask,
+        use_cache=True,
+        positions=prefill_pos,
+        mutable=["cache"],
+    )
+    first = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    return mut["cache"], full_mask, lengths, first
+
+
 def make_causal_greedy(model: Any, config: Any, max_new_tokens: int) -> Callable:
     """Greedy decoding for decoder-only (causal) models.
 
     Prefills the prompt into the KV cache in one pass, then decodes one
-    token at a time.  Right-padded prompts are supported: the first sampled
-    token comes from each row's last *valid* position, and generated tokens
-    occupy cache slots after the full prompt width (pad slots stay masked
-    out of attention).  With uniform-length prompts this matches HF
+    token at a time.  Right-padded prompts are supported (see
+    ``_causal_prefill``).  With uniform-length prompts this matches HF
     ``generate`` exactly.
     """
     eos, pad = config.eos_token_id, config.pad_token_id
@@ -95,33 +128,9 @@ def make_causal_greedy(model: Any, config: Any, max_new_tokens: int) -> Callable
 
     def generate(params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
         B, P = input_ids.shape
-        width = P + L
-        # cache buffers sized for prompt + generation
-        shapes = jax.eval_shape(
-            lambda p: model.init(
-                jax.random.PRNGKey(0), jnp.zeros((B, width), jnp.int32), use_cache=True
-            ),
-            params,
+        cache, full_mask, lengths, first = _causal_prefill(
+            model, params, input_ids, attention_mask, L
         )
-        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
-
-        full_mask = jnp.concatenate([attention_mask, jnp.zeros((B, L), jnp.int32)], axis=1)
-        lengths = jnp.sum(attention_mask, axis=1).astype(jnp.int32)  # valid prompt lengths
-        # RoPE positions follow the true sequence, not the cache slot: pads
-        # inside the prompt get position 0-ish (cumsum), generated tokens
-        # continue at each row's own length
-        prefill_pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None)
-        # prefill
-        logits, mut = model.apply(
-            {"params": params, "cache": cache},
-            input_ids,
-            full_mask,
-            use_cache=True,
-            positions=prefill_pos,
-            mutable=["cache"],
-        )
-        cache = mut["cache"]
-        first = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
         nxt = jnp.argmax(first, axis=-1).astype(jnp.int32)
 
         def step(t, carry):
@@ -149,11 +158,165 @@ def make_causal_greedy(model: Any, config: Any, max_new_tokens: int) -> Callable
     return generate
 
 
+def make_causal_beam_search(
+    model: Any,
+    config: Any,
+    max_new_tokens: int,
+    num_beams: int = 2,
+    length_penalty: float = 1.0,
+) -> Callable:
+    """Beam search for decoder-only models (the reference's live eval
+    contract is ``num_beams=2``, train-accelerator.py:247 — the round-1
+    causal path was greedy-only).
+
+    The prompt is prefilled once at batch ``B`` (beams share the prefix,
+    so prefill compute is NOT multiplied by K); the cache is then
+    replicated to the flattened (B*K) beam batch and decode steps follow
+    the same banked-finished-beams selection as the seq2seq version.
+    Right-padded prompts are supported exactly as in ``make_causal_greedy``
+    (true-sequence RoPE positions, pad slots masked)."""
+    eos, pad = config.eos_token_id, config.pad_token_id
+    K, L = num_beams, max_new_tokens
+
+    def generate(params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
+        B, P = input_ids.shape
+        cache, full_mask, lengths, first = _causal_prefill(
+            model, params, input_ids, attention_mask, L
+        )
+        logp0 = jax.nn.log_softmax(first.astype(jnp.float32), axis=-1)  # (B, V)
+
+        # beams share the prefilled prompt: replicate cache rows K-ways
+        cache = jax.tree.map(lambda x: jnp.repeat(x, K, axis=0) if x.ndim > 0 else x, cache)
+        full_mask = jnp.repeat(full_mask, K, axis=0)  # (B*K, width)
+        lengths_rep = jnp.repeat(lengths, K, axis=0)  # (B*K,)
+
+        # token index 0: run the shared selection on the prefill logits —
+        # with live_scores initialized to [0, -inf, ...] only beam 0's
+        # distribution contributes, which is exactly the first HF step
+        state = _beam_init(B, K, L, pad)
+        state, chosen, parents = _beam_step_select(
+            jnp.repeat(logp0, K, axis=0), 0, state,
+            eos=eos, K=K, length_penalty=length_penalty, len_offset=P - 1,
+        )
+        cache = _gather_beams(cache, parents, B, K)  # parents all 0: no-op reorder
+        last = chosen.reshape(B * K, 1)
+
+        def step(t, carry):
+            cache, last, full_mask, state = carry
+            # `last` is token index t-1; it occupies cache slot P + t - 1
+            full_mask = full_mask.at[:, P + t - 1].set(1)
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                last,
+                full_mask,
+                use_cache=True,
+                positions=(lengths_rep + t - 1)[:, None],
+                mutable=["cache"],
+            )
+            logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+            state, chosen, parents = _beam_step_select(
+                logp, t, state, eos=eos, K=K, length_penalty=length_penalty, len_offset=P - 1
+            )
+            cache = _gather_beams(mut["cache"], parents, B, K)
+            return cache, chosen.reshape(B * K, 1), full_mask, state
+
+        _, _, _, state = jax.lax.fori_loop(1, L, step, (cache, last, full_mask, state))
+        return _beam_finalize(state, P + L, length_penalty)
+
+    return generate
+
+
 def _gather_beams(tree: Any, beam_idx: jnp.ndarray, batch: int, beams: int) -> Any:
     """Reorder the flattened (batch*beams, ...) leading dim by per-batch beam
     indices (batch, beams)."""
     flat_idx = (jnp.arange(batch)[:, None] * beams + beam_idx).reshape(-1)
     return jax.tree.map(lambda x: x[flat_idx] if x.ndim > 0 else x, tree)
+
+
+def _beam_step_select(
+    logp: jnp.ndarray,
+    t: jnp.ndarray,
+    state: tuple,
+    *,
+    eos: int,
+    K: int,
+    length_penalty: float,
+    len_offset: int = 0,
+) -> tuple:
+    """One beam-search selection step from per-beam next-token logprobs.
+
+    Shared by the seq2seq and causal searches so the HF-parity semantics
+    live in exactly one place.  ``state`` is ``(live_scores, live_seqs,
+    fin_scores, fin_seqs, row_done)``; ``logp`` is (B*K, V); ``t`` is the
+    token index being chosen.  Matches HF BeamSearchScorer.process:
+
+    - only EOS candidates ranked < num_beams among the top-2K are banked
+      (``is_beam_token_worse_than_top_num_beams``);
+    - a row is "done" (early_stopping=False) once it holds K banked
+      hypotheses whose worst beats the best attainable continuation at the
+      current length normalization; done rows stop banking;
+    - the normalization length is ``t + 1 + len_offset``: HF divides by the
+      full ``input_ids`` length, which for seq2seq is the decoder length
+      (offset 0: start token + t generated) and for decoder-only includes
+      the prompt (offset P - 1, so the length is P + t).
+    """
+    live_scores, live_seqs, fin_scores, fin_seqs, row_done = state
+    B = live_scores.shape[0]
+    V = logp.shape[-1]
+    cand = live_scores[:, :, None] + logp.reshape(B, K, V)
+    flat = cand.reshape(B, K * V)
+    top_scores, top_idx = jax.lax.top_k(flat, 2 * K)  # (B, 2K)
+    beam_idx = top_idx // V
+    token = (top_idx % V).astype(jnp.int32)
+
+    cand_seqs = jnp.take_along_axis(live_seqs, beam_idx[:, :, None], axis=1)  # (B, 2K, L)
+    cand_seqs = cand_seqs.at[:, :, t].set(token)
+
+    is_eos = token == eos
+    rank_ok = jnp.arange(2 * K)[None, :] < K
+    lp = jnp.asarray(t + 1 + len_offset, jnp.float32) ** length_penalty
+    bankable = is_eos & rank_ok & ~row_done[:, None]
+    fin_cand = jnp.where(bankable, top_scores / lp, NEG_INF)
+    all_fin_scores = jnp.concatenate([fin_scores, fin_cand], axis=1)  # (B, 3K)
+    all_fin_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)
+    fin_scores_new, fin_keep = jax.lax.top_k(all_fin_scores, K)
+    fin_seqs_new = jnp.take_along_axis(all_fin_seqs, fin_keep[:, :, None], axis=1)
+
+    live_cand = jnp.where(is_eos, NEG_INF, top_scores)
+    live_scores_new, live_keep = jax.lax.top_k(live_cand, K)
+    live_seqs_new = jnp.take_along_axis(cand_seqs, live_keep[:, :, None], axis=1)
+    chosen_tokens = jnp.take_along_axis(token, live_keep, axis=1)  # (B, K)
+    parent_beams = jnp.take_along_axis(beam_idx, live_keep, axis=1)  # (B, K)
+
+    has_k_banked = fin_scores_new[:, K - 1] > NEG_INF / 2
+    # HF is_done uses the best overall candidate sum (next_scores.max(),
+    # eos candidates included), not the best surviving live beam
+    attainable = top_scores[:, 0] / lp
+    row_done_new = row_done | (has_k_banked & (fin_scores_new[:, K - 1] >= attainable))
+
+    new_state = (live_scores_new, live_seqs_new, fin_scores_new, fin_seqs_new, row_done_new)
+    return new_state, chosen_tokens, parent_beams
+
+
+def _beam_init(batch: int, K: int, L: int, pad: int) -> tuple:
+    live_scores = jnp.tile(jnp.array([0.0] + [NEG_INF] * (K - 1), jnp.float32), (batch, 1))
+    live_seqs = jnp.full((batch, K, L), pad, jnp.int32)
+    fin_scores = jnp.full((batch, K), NEG_INF, jnp.float32)
+    fin_seqs = jnp.full((batch, K, L), pad, jnp.int32)
+    row_done = jnp.zeros((batch,), bool)
+    return live_scores, live_seqs, fin_scores, fin_seqs, row_done
+
+
+def _beam_finalize(state: tuple, final_len: int, length_penalty: float) -> jnp.ndarray:
+    """Best sequence per row, HF finalize semantics: rows not yet done also
+    consider their best live beam at max length, normalized by the full
+    final sequence length (decoder length for seq2seq; prompt + generated
+    for decoder-only)."""
+    live_scores, live_seqs, fin_scores, fin_seqs, row_done = state
+    none_finished = jnp.all(fin_scores <= NEG_INF / 2, axis=1)
+    live_final = live_scores[:, 0] / (jnp.asarray(final_len, jnp.float32) ** length_penalty)
+    take_live = ~row_done & (none_finished | (live_final > fin_scores[:, 0]))
+    return jnp.where(take_live[:, None], live_seqs[:, 0], fin_seqs[:, 0])
 
 
 def make_beam_search(
@@ -180,14 +343,11 @@ def make_beam_search(
         mask_rep = jnp.repeat(attention_mask, K, axis=0)
         cache = _init_cache(model, params, B * K, L, enc_rep, mask_rep)
 
-        live_scores = jnp.tile(jnp.array([0.0] + [NEG_INF] * (K - 1), jnp.float32), (B, 1))
-        live_seqs = jnp.full((B, K, L), pad, jnp.int32)
-        fin_scores = jnp.full((B, K), NEG_INF, jnp.float32)
-        fin_seqs = jnp.full((B, K, L), pad, jnp.int32)
+        state = _beam_init(B, K, L, pad)
         last = jnp.full((B * K, 1), start, jnp.int32)
 
         def step(t, carry):
-            cache, last, live_scores, live_seqs, fin_scores, fin_seqs = carry
+            cache, last, state = carry
             logits, mut = model.apply(
                 {"params": params, "cache": cache},
                 last,
@@ -199,7 +359,6 @@ def make_beam_search(
                 method="decode",
                 mutable=["cache"],
             )
-            cache = mut["cache"]
             logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)  # (B*K, V)
             V = logp.shape[-1]
             if forced_bos is not None:  # HF forced_bos_token_id processor
@@ -208,42 +367,15 @@ def make_beam_search(
             if forced_eos is not None:  # HF forced_eos_token_id: EOS at max length
                 eos_mask = jnp.full((V,), NEG_INF, jnp.float32).at[forced_eos].set(0.0)
                 logp = jnp.where(t == L - 1, logp + eos_mask[None, :], logp)
-            cand = live_scores[:, :, None] + logp.reshape(B, K, V)  # (B, K, V)
-            flat = cand.reshape(B, K * V)
-            top_scores, top_idx = jax.lax.top_k(flat, 2 * K)  # (B, 2K)
-            beam_idx = top_idx // V
-            token = (top_idx % V).astype(jnp.int32)
+            state, chosen, parents = _beam_step_select(
+                logp, t, state, eos=eos, K=K, length_penalty=length_penalty
+            )
+            cache = _gather_beams(mut["cache"], parents, B, K)
+            return cache, chosen.reshape(B * K, 1), state
 
-            # candidate sequences with the new token written at position t
-            cand_seqs = jnp.take_along_axis(live_seqs, beam_idx[:, :, None], axis=1)  # (B, 2K, L)
-            cand_seqs = cand_seqs.at[:, :, t].set(token)
-
-            is_eos = token == eos
-            # bank finished candidates; HF normalizes by the sequence length
-            # at add-time = start token + t prior tokens = t+1
-            lp = jnp.asarray(t + 1, jnp.float32) ** length_penalty
-            fin_cand = jnp.where(is_eos, top_scores / lp, NEG_INF)
-            all_fin_scores = jnp.concatenate([fin_scores, fin_cand], axis=1)  # (B, 3K)
-            all_fin_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)  # (B, 3K, L)
-            fin_scores_new, fin_keep = jax.lax.top_k(all_fin_scores, K)
-            fin_seqs_new = jnp.take_along_axis(all_fin_seqs, fin_keep[:, :, None], axis=1)
-
-            # keep top-K live (non-eos) candidates
-            live_cand = jnp.where(is_eos, NEG_INF, top_scores)
-            live_scores_new, live_keep = jax.lax.top_k(live_cand, K)
-            live_seqs_new = jnp.take_along_axis(cand_seqs, live_keep[:, :, None], axis=1)
-            chosen_tokens = jnp.take_along_axis(token, live_keep, axis=1)  # (B, K)
-            parent_beams = jnp.take_along_axis(beam_idx, live_keep, axis=1)  # (B, K)
-
-            cache = _gather_beams(cache, parent_beams, B, K)
-            last = chosen_tokens.reshape(B * K, 1)
-            return cache, last, live_scores_new, live_seqs_new, fin_scores_new, fin_seqs_new
-
-        carry = (cache, last, live_scores, live_seqs, fin_scores, fin_seqs)
-        _, _, live_scores, live_seqs, fin_scores, fin_seqs = jax.lax.fori_loop(0, L, step, carry)
-
-        # if nothing finished for a batch row, fall back to best live beam
-        none_finished = jnp.all(fin_scores <= NEG_INF / 2, axis=1)
-        return jnp.where(none_finished[:, None], live_seqs[:, 0], fin_seqs[:, 0])
+        _, _, state = jax.lax.fori_loop(0, L, step, (cache, last, state))
+        # final decoder length = start token + L generated (banking at step t
+        # uses t+1; the live-beam convention must match)
+        return _beam_finalize(state, L + 1, length_penalty)
 
     return generate

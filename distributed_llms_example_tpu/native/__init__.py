@@ -137,6 +137,8 @@ class JsonlRecords(Sequence):
         return key, json.loads(raw)
 
     def __getitem__(self, i: int) -> dict:
+        if i < 0:
+            i += self._n  # list-parity negative indexing
         if not 0 <= i < self._n:
             raise IndexError(i)
         c = self._h.contents

@@ -72,6 +72,50 @@ void utf8_append(std::string& arena, uint32_t cp) {
   }
 }
 
+// Strict UTF-8 well-formedness (RFC 3629: continuation ranges, no
+// overlongs, no surrogates, max U+10FFFF).  Invalid bytes must fail at
+// PARSE time — past load, the Python fallback can no longer engage and a
+// bad byte would surface as UnicodeDecodeError at record-access time.
+bool utf8_valid(const unsigned char* s, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    unsigned char b = s[i];
+    if (b < 0x80) {
+      i++;
+    } else if (b >= 0xC2 && b <= 0xDF) {
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return false;
+      i += 2;
+    } else if (b == 0xE0) {
+      if (i + 2 >= n || s[i + 1] < 0xA0 || s[i + 1] > 0xBF || (s[i + 2] & 0xC0) != 0x80) return false;
+      i += 3;
+    } else if ((b >= 0xE1 && b <= 0xEC) || b == 0xEE || b == 0xEF) {
+      if (i + 2 >= n || (s[i + 1] & 0xC0) != 0x80 || (s[i + 2] & 0xC0) != 0x80) return false;
+      i += 3;
+    } else if (b == 0xED) {  // exclude surrogates U+D800..U+DFFF
+      if (i + 2 >= n || s[i + 1] < 0x80 || s[i + 1] > 0x9F || (s[i + 2] & 0xC0) != 0x80) return false;
+      i += 3;
+    } else if (b == 0xF0) {
+      if (i + 3 >= n || s[i + 1] < 0x90 || s[i + 1] > 0xBF || (s[i + 2] & 0xC0) != 0x80 ||
+          (s[i + 3] & 0xC0) != 0x80)
+        return false;
+      i += 4;
+    } else if (b >= 0xF1 && b <= 0xF3) {
+      if (i + 3 >= n || (s[i + 1] & 0xC0) != 0x80 || (s[i + 2] & 0xC0) != 0x80 ||
+          (s[i + 3] & 0xC0) != 0x80)
+        return false;
+      i += 4;
+    } else if (b == 0xF4) {  // cap at U+10FFFF
+      if (i + 3 >= n || s[i + 1] < 0x80 || s[i + 1] > 0x8F || (s[i + 2] & 0xC0) != 0x80 ||
+          (s[i + 3] & 0xC0) != 0x80)
+        return false;
+      i += 4;
+    } else {
+      return false;  // 0x80-0xC1 (stray continuation / overlong), 0xF5+
+    }
+  }
+  return true;
+}
+
 int hex_val(char ch) {
   if (ch >= '0' && ch <= '9') return ch - '0';
   if (ch >= 'a' && ch <= 'f') return ch - 'a' + 10;
@@ -103,6 +147,12 @@ bool parse_string(Cursor& c, Parsed& out, int64_t* off, int64_t* len) {
     if (ch == '"') {
       c.p++;
       *len = static_cast<int64_t>(out.arena.size()) - *off;
+      // escape-decoded bytes are valid by construction; raw bytes copied
+      // from the input may not be — validate the completed value once
+      if (!utf8_valid(
+              reinterpret_cast<const unsigned char*>(out.arena.data()) + *off,
+              static_cast<size_t>(*len)))
+        return fail(out, c, "invalid UTF-8 in string");
       return true;
     }
     if (ch == '\\') {
@@ -198,6 +248,8 @@ bool parse_raw(Cursor& c, Parsed& out, int64_t* off, int64_t* len) {
     char ch = *c.p;
     if (in_str) {
       if (ch == '\\') {
+        if (c.p + 2 > c.end) return fail(out, c, "truncated escape");
+        if (c.p[1] == '\n') return fail(out, c, "unescaped newline inside string");
         c.p += 2;
         continue;
       }

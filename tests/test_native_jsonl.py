@@ -73,6 +73,22 @@ def test_lone_surrogates_rejected_at_parse(tmp_path):
             native.load_jsonl(str(p))
 
 
+def test_invalid_utf8_rejected_at_parse(tmp_path):
+    """A stray non-UTF-8 byte in a string value must fail at LOAD time
+    (clean fallback window), not as UnicodeDecodeError at access time."""
+    p = tmp_path / "latin1.jsonl"
+    p.write_bytes(b'{"a": "caf\xe9"}\n')  # latin-1 e-acute, invalid UTF-8
+    with pytest.raises(ValueError, match="UTF-8"):
+        native.load_jsonl(str(p))
+
+
+def test_negative_indexing_matches_list(jsonl_file):
+    recs = native.load_jsonl(jsonl_file)
+    assert recs[-1] == RECORDS[-1]
+    with pytest.raises(IndexError):
+        recs[-len(RECORDS) - 1]
+
+
 def test_malformed_reports_line(tmp_path):
     p = tmp_path / "bad.jsonl"
     p.write_text('{"a": "ok"}\n{"a": nope}\n', encoding="utf-8")

@@ -1,0 +1,77 @@
+"""Per-device memory audit: BASELINE configs 4-5 must fit v5e HBM.
+
+The analytic path (exact sharded state/grad bytes + structural remat
+activation model) runs in seconds from abstract shapes — no 7B params are
+ever materialized.  The compiled-path plumbing (AOT lower + XLA
+memory_analysis) is exercised on the tiny config.
+"""
+
+from distributed_llms_example_tpu.core.config import MeshConfig
+from distributed_llms_example_tpu.utils.memory_audit import (
+    HBM_BYTES_V5E,
+    audit_train_step_memory,
+)
+
+
+def test_flan_t5_xl_fits_8way_fsdp():
+    """BASELINE config 4: flan-t5-xl, FSDP-style sharding."""
+    r = audit_train_step_memory(
+        "flan-t5-xl",
+        mesh_config=MeshConfig(data=1, fsdp=8, sequence=1, tensor=1),
+        global_batch=8,
+        remat=True,
+        compile=False,
+    )
+    assert r["params"] > 2.8e9
+    assert r["fits_v5e_hbm"], f"peak {r['peak_gib']} GiB"
+    assert r["fits_v5e_hbm_conservative"]
+    assert r["peak_bytes"] < 0.6 * HBM_BYTES_V5E  # comfortable margin
+
+
+def test_llama_2_7b_fits_8way_fsdp():
+    """llama-2-7b on a single v5e-8: fp32 Adam state dominates (12
+    bytes/param over 8 chips ≈ 10.1 GiB).  Fits under the optimistic
+    (fused grad accumulation) bound — tight; the conservative bound needs
+    the multi-host shape below, which is what BASELINE config 5 specifies."""
+    r = audit_train_step_memory(
+        "llama-2-7b",
+        mesh_config=MeshConfig(data=1, fsdp=8, sequence=1, tensor=1),
+        global_batch=8,
+        remat=True,
+        grad_accum_steps=8,
+        compile=False,
+    )
+    assert r["params"] > 6.7e9
+    assert r["fits_v5e_hbm"], f"peak {r['peak_gib']} GiB"
+
+
+def test_llama_2_7b_multihost_fits_conservatively():
+    """BASELINE config 5 is multi-host: on fsdp=16 (two v5e-8 hosts) even
+    the conservative gradient-liveness bound must fit with real headroom."""
+    r = audit_train_step_memory(
+        "llama-2-7b",
+        mesh_config=MeshConfig(data=1, fsdp=16, sequence=1, tensor=1),
+        global_batch=16,
+        remat=True,
+        grad_accum_steps=8,
+        compile=False,
+    )
+    assert r["fits_v5e_hbm_conservative"]
+    assert r["analytic_peak_conservative_bytes"] < 0.75 * HBM_BYTES_V5E
+
+
+def test_compiled_path_runs_on_tiny_config():
+    """The AOT compile + memory_analysis plumbing, on a model small enough
+    to compile in CI."""
+    r = audit_train_step_memory(
+        "t5-test",
+        mesh_config=MeshConfig(data=2, fsdp=2, sequence=1, tensor=2),
+        global_batch=8,
+        src_len=64,
+        tgt_len=16,
+        remat=True,
+        compile=True,
+    )
+    assert r["compiled_arguments_bytes"] > 0
+    assert r["compiled_peak_bytes"] > 0
+    assert r["analytic_peak_bytes"] > 0

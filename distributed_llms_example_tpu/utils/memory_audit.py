@@ -50,15 +50,18 @@ def _shard_bytes(tree: Any, shardings: Any) -> int:
     return total
 
 
-def _activation_bytes(config: Any, b_loc: int, src: int, tgt: int, dtype_bytes: int) -> dict:
-    """Structural remat activation model, per device.
+def _activation_bytes(
+    config: Any, b_loc: int, src: int, tgt: int, dtype_bytes: int, remat: bool,
+) -> dict:
+    """Structural activation model, per device.
 
     Under block-level remat the backward holds: every block's boundary
     activation (batch, seq, d_model), ONE block's recomputed internals
     (attention scores in fp32 — assume the XLA path, which is conservative
     vs the flash kernel — plus MLP inner), and the fp32 logits/loss
-    buffers.  Batch is sharded over (data, fsdp) so ``b_loc`` is the
-    per-device batch."""
+    buffers.  Without remat EVERY block's internals are saved residuals, so
+    the working-set term multiplies by the layer count.  Batch is sharded
+    over (data, fsdp) so ``b_loc`` is the per-device batch."""
     name = type(config).__name__
     if name == "LlamaConfig":
         h, inter, layers = config.hidden_size, config.intermediate_size, config.num_hidden_layers
@@ -66,7 +69,10 @@ def _activation_bytes(config: Any, b_loc: int, src: int, tgt: int, dtype_bytes: 
         boundaries = layers * b_loc * src * h * dtype_bytes
         scores = b_loc * heads * src * src * 4
         mlp_inner = 3 * b_loc * src * inter * dtype_bytes  # gate, up, silu*up
-        block_ws = 2 * max(scores, mlp_inner)  # recomputed fwd + its bwd temps
+        if remat:
+            block_ws = 2 * max(scores, mlp_inner)  # recomputed fwd + its bwd temps
+        else:
+            block_ws = layers * (scores + mlp_inner)  # all residuals saved
         logits = 2 * b_loc * src * vocab * 4  # fp32 logits + softmax-grad temp
     else:  # T5/BART seq2seq: encoder + decoder with cross attention
         h = getattr(config, "d_model", None)
@@ -82,7 +88,10 @@ def _activation_bytes(config: Any, b_loc: int, src: int, tgt: int, dtype_bytes: 
             b_loc * heads * tgt * src * 4,  # cross
         )
         mlp_inner = 2 * b_loc * max(src, tgt) * inter * dtype_bytes
-        block_ws = 2 * max(scores, mlp_inner)
+        if remat:
+            block_ws = 2 * max(scores, mlp_inner)
+        else:
+            block_ws = (layers_e + layers_d) * (scores + mlp_inner)
         logits = 2 * b_loc * tgt * vocab * 4
     return {
         "boundaries_bytes": int(boundaries),
@@ -154,21 +163,25 @@ def audit_train_step_memory(
     a_state = jax.eval_shape(lambda p: create_train_state(p, tx), a_params)
     sh = state_shardings(a_state, mesh)
     a_state = jax.tree.map(
-        lambda s, shd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shd), a_state, sh
+        lambda s, shd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shd), a_state, sh,
     )
-    bsh = batch_sharding(mesh)
-    shapes = {
-        "input_ids": (global_batch, src_len),
-        "attention_mask": (global_batch, src_len),
-        "labels": (global_batch, tgt_len if lm.is_seq2seq else src_len),
-    }
-    a_batch = {k: jax.ShapeDtypeStruct(v, jnp.int32, sharding=bsh) for k, v in shapes.items()}
-
     ma = None
     if compile:
+        bsh = batch_sharding(mesh)
+        shapes = {
+            "input_ids": (global_batch, src_len),
+            "attention_mask": (global_batch, src_len),
+            "labels": (global_batch, tgt_len if lm.is_seq2seq else src_len),
+        }
+        a_batch = {k: jax.ShapeDtypeStruct(v, jnp.int32, sharding=bsh) for k, v in shapes.items()}
         build = make_train_step(
-            lm.module, lm.config, tx, schedule, mesh,
-            grad_accum_steps=grad_accum_steps, is_seq2seq=lm.is_seq2seq,
+            lm.module,
+            lm.config,
+            tx,
+            schedule,
+            mesh,
+            grad_accum_steps=grad_accum_steps,
+            is_seq2seq=lm.is_seq2seq,
         )
         step_fn, _ = build(a_state)
         with activation_mesh(mesh):
@@ -181,7 +194,7 @@ def audit_train_step_memory(
     # optimizer update, alongside a comparable fused-update temporary)
     params_sh = state_shardings(a_params, mesh)
     grads_b = _shard_bytes(
-        jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, jnp.float32), a_params), params_sh
+        jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, jnp.float32), a_params), params_sh,
     )
     micro_batch = global_batch // max(1, grad_accum_steps)
     batch_shards = 1
@@ -190,7 +203,7 @@ def audit_train_step_memory(
     b_loc = max(1, micro_batch // batch_shards)
     dtype_bytes = jnp.dtype(parse_dtype(dtype)).itemsize
     act = _activation_bytes(
-        lm.config, b_loc, src_len, tgt_len if lm.is_seq2seq else src_len, dtype_bytes
+        lm.config, b_loc, src_len, tgt_len if lm.is_seq2seq else src_len, dtype_bytes, remat,
     )
     # Gradient liveness bounds the verdict from both sides:
     # - optimistic (1.25x): XLA fuses each layer's gradient into the scan

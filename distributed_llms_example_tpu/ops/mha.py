@@ -25,6 +25,7 @@ from distributed_llms_example_tpu.ops.flash_attention import (
     flash_attention,
     flash_supported,
 )
+from distributed_llms_example_tpu.ops.ring_attention import ring_attention_sharded
 from distributed_llms_example_tpu.parallel.activation import BATCH_AXES, current_mesh
 from distributed_llms_example_tpu.utils.jsonlog import log_json
 
@@ -44,6 +45,19 @@ def _mesh_batch_shards(mesh: Mesh) -> int:
     return math.prod(mesh.shape.get(a, 1) for a in BATCH_AXES)
 
 
+def _uneven_split_blocker(mesh: Mesh, *, heads: int, batch: int) -> str | None:
+    """Both shard_map paths (flash per-shard, ring) need batch and heads to
+    split evenly over (data×fsdp) and ``tensor``; None when they do."""
+    tensor = mesh.shape.get("tensor", 1)
+    shards = _mesh_batch_shards(mesh)
+    if heads % tensor or batch % shards:
+        return (
+            f"uneven split: heads={heads} over tensor={tensor}, "
+            f"batch={batch} over {shards} data/fsdp shards"
+        )
+    return None
+
+
 def select_attention_impl(
     attention_impl: str,
     *,
@@ -56,23 +70,45 @@ def select_attention_impl(
     mesh: Mesh | None,
     backend: str,
     device_count: int,
+    causal: bool = False,
+    bias_kv_only: bool | None = None,
 ) -> tuple[str, str]:
     """(impl, reason) — pure selection logic, unit-testable without TPUs.
 
-    ``auto`` picks the Pallas flash kernel on TPU for non-trivial score
-    matrices; under a multi-device mesh it additionally requires the batch
-    and head counts to split evenly over the (data×fsdp) and ``tensor``
-    axes, because multi-device flash runs per-shard under ``shard_map``
-    (an opaque pallas call can't be partitioned by GSPMD itself).
+    ``auto`` picks, in priority order: **ring attention** when the mesh has
+    a ``sequence`` axis of size > 1 and the shapes split evenly over it
+    (sequence/context parallelism — the Pallas/XLA single-shard paths
+    would force GSPMD to all-gather the sequence); the **Pallas flash
+    kernel** on TPU for non-trivial score matrices — under a multi-device
+    mesh it additionally requires the batch and head counts to split
+    evenly over the (data×fsdp) and ``tensor`` axes, because multi-device
+    flash runs per-shard under ``shard_map`` (an opaque pallas call can't
+    be partitioned by GSPMD itself); **XLA attention** otherwise.
+
+    ``bias_kv_only``: None = no bias, True = (b|1, 1, 1, K) padding-style
+    bias (the only form the ring can rotate), False = anything wider.
     """
-    if attention_impl not in ("auto", "flash", "xla"):
+    if attention_impl not in ("auto", "flash", "ring", "xla"):
         raise ValueError(
-            f"attention_impl={attention_impl!r}: must be 'auto', 'flash', or 'xla'"
+            f"attention_impl={attention_impl!r}: must be 'auto', 'flash', 'ring', or 'xla'"
         )
     if attention_impl == "xla":
         return "xla", "forced"
     if use_cache:
         return "xla", "kv-cache decode step"
+    seq_shards = mesh.shape.get("sequence", 1) if mesh is not None else 1
+    if attention_impl == "ring" or (attention_impl == "auto" and seq_shards > 1):
+        why = _ring_blocker(
+            seq_shards, batch=batch, heads=heads, q_len=q_len, kv_len=kv_len,
+            causal=causal, bias_kv_only=bias_kv_only, mesh=mesh,
+        )
+        if why is None:
+            return "ring", ("forced" if attention_impl == "ring" else "auto: sequence-parallel mesh")
+        if attention_impl == "ring":
+            raise ValueError(f"attention_impl='ring' but {why}")
+        # a sequence-sharded mesh where ring can't run: XLA attention is
+        # correct (GSPMD gathers the sequence) but loses the SP memory win
+        return "xla", f"sequence axis present but {why}"
     if not flash_supported(q_len, kv_len, head_dim):
         # 'flash' means "wherever eligible": single-token decode steps and
         # other non-tileable shapes silently use the XLA path
@@ -81,13 +117,9 @@ def select_attention_impl(
     if multi_device:
         if mesh is None:
             return "xla", "multi-device jit without a mesh context"
-        tensor = mesh.shape.get("tensor", 1)
-        shards = _mesh_batch_shards(mesh)
-        if heads % tensor or batch % shards:
-            return "xla", (
-                f"uneven split: heads={heads} over tensor={tensor}, "
-                f"batch={batch} over {shards} data/fsdp shards"
-            )
+        why = _uneven_split_blocker(mesh, heads=heads, batch=batch)
+        if why is not None:
+            return "xla", why
     if attention_impl == "flash":
         return "flash", "forced"
     if backend != "tpu":
@@ -95,6 +127,31 @@ def select_attention_impl(
     if q_len * kv_len < 128 * 128:
         return "xla", "auto: score matrix too small to tile"
     return "flash", "auto: TPU" + (" (shard_map per-shard)" if multi_device else "")
+
+
+def _ring_blocker(
+    seq_shards: int,
+    *,
+    batch: int,
+    heads: int,
+    q_len: int,
+    kv_len: int,
+    causal: bool,
+    bias_kv_only: bool | None,
+    mesh: Mesh | None,
+) -> str | None:
+    """None if ring attention can run, else a human-readable blocker."""
+    if mesh is None:
+        return "no mesh context"
+    if seq_shards <= 1:
+        return "mesh has no sequence axis > 1"
+    if q_len % seq_shards or kv_len % seq_shards:
+        return f"q_len={q_len}/kv_len={kv_len} not divisible by sequence={seq_shards}"
+    if causal and q_len != kv_len:
+        return f"causal ring needs square attention, got q={q_len} kv={kv_len}"
+    if bias_kv_only is False:
+        return "bias is not K-only (ring rotates only (b,1,1,K) biases)"
+    return _uneven_split_blocker(mesh, heads=heads, batch=batch)
 
 
 def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float = 10000.0) -> tuple:
@@ -124,10 +181,11 @@ class MultiHeadAttention(nn.Module):
     use_rope: bool = False
     rope_theta: float = 10000.0
     dtype: jnp.dtype = jnp.float32
-    # "auto": Pallas flash attention on TPU for flash-eligible shapes,
-    # XLA attention otherwise; "flash"/"xla" force a path.  The causal
-    # mask is applied inside this module (natively by the flash kernel),
-    # so callers pass only padding/cross-attention biases.
+    # "auto": ring attention on sequence-parallel meshes, Pallas flash
+    # attention on TPU for flash-eligible shapes, XLA attention otherwise;
+    # "ring"/"flash"/"xla" force a path.  The causal mask is applied inside
+    # this module (natively by the flash/ring kernels), so callers pass
+    # only padding/cross-attention biases.
     attention_impl: str = "auto"
 
     @property
@@ -230,9 +288,15 @@ class MultiHeadAttention(nn.Module):
             mesh=mesh,
             backend=jax.default_backend(),
             device_count=jax.device_count(),
+            causal=causal_here,
+            bias_kv_only=None if bias is None else (bias.shape[1] == 1 and bias.shape[2] == 1),
         )
         _log_impl_once(impl, reason)
-        if impl == "flash":
+        if impl == "ring":
+            out = ring_attention_sharded(
+                q, k, v, bias, mesh=mesh, causal=causal_here, dtype=self.dtype
+            )
+        elif impl == "flash":
             out = self._flash_run(q, k, v, bias, causal_here, mesh)
         else:
             if causal_here:

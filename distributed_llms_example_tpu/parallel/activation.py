@@ -65,11 +65,25 @@ def constrain(x: jax.Array, spec: P) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def _seq_axis(x: jax.Array, dim: int = 1) -> str | None:
+    """``"sequence"`` when the ambient mesh runs sequence parallelism and
+    the seq dim splits evenly (decode-time length-1 slices stay unsharded),
+    else None — so non-SP meshes compile to exactly the old graphs."""
+    mesh = current_mesh()
+    if mesh is None:
+        return None
+    n = mesh.shape.get("sequence", 1)
+    size = x.shape[dim]
+    return "sequence" if n > 1 and size and size % n == 0 else None
+
+
 def constrain_hidden(x: jax.Array) -> jax.Array:
-    """(batch, seq, d_model) residual-stream activations."""
-    return constrain(x, P(BATCH_AXES, None, None))
+    """(batch, seq, d_model) residual-stream activations; seq over
+    ``sequence`` under context parallelism."""
+    return constrain(x, P(BATCH_AXES, _seq_axis(x), None))
 
 
 def constrain_logits(x: jax.Array) -> jax.Array:
-    """(batch, seq, vocab) logits — vocab sharded over ``tensor``."""
-    return constrain(x, P(BATCH_AXES, None, "tensor"))
+    """(batch, seq, vocab) logits — vocab sharded over ``tensor``, seq over
+    ``sequence`` under context parallelism."""
+    return constrain(x, P(BATCH_AXES, _seq_axis(x), "tensor"))

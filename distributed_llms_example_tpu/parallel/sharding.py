@@ -84,7 +84,10 @@ DEFAULT_RULES: list[tuple[str, P]] = [
     # gather, which GSPMD reconciles by involuntary full rematerialization
     # (replicate + repartition) on every lookup/scatter; vocab-only sharding
     # keeps the same per-device memory without that cliff.
-    (r"(shared|embed_tokens|embed_positions|lm_head)/embedding", P(("tensor", "fsdp"), None)),
+    # learned position tables are tiny (BART: (max_positions+2, d_model) —
+    # 1026 rows for bart-large, not divisible by tensor×fsdp) → replicate
+    (r"embed_positions/embedding", P()),
+    (r"(shared|embed_tokens|lm_head)/embedding", P(("tensor", "fsdp"), None)),
     (r"lm_head/kernel", P("fsdp", "tensor")),
     # attention projections: q/k/v are column-parallel (d_model, heads*head_dim),
     # o is row-parallel (heads*head_dim, d_model)
@@ -104,11 +107,64 @@ def default_rules() -> ShardingRules:
     return ShardingRules(rules=DEFAULT_RULES)
 
 
+def divisible_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Drop spec entries whose mesh-axes product doesn't divide the dim.
+
+    Ragged dims are real: bart-large-cnn's vocab is 50265 (odd), so a
+    ``(tensor, fsdp)`` split can't apply on even meshes — ``device_put``
+    would refuse outright.  Replicating just that dim (the JAX sharding
+    model has no padded shards) keeps the rule set model-agnostic; the
+    big divisible tables (t5 32128, llama 32000) still shard fully.
+    """
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape.get(a, 1)
+        out.append(entry if i < len(shape) and shape[i] % n == 0 else None)
+    return P(*out)
+
+
+_RAGGED_LOGGED: set = set()
+
+
+def resolve_shardings(tree: Any, mesh: Mesh, rules: ShardingRules | None = None) -> Any:
+    """Pytree of NamedSharding for any pytree (params, TrainState, ...):
+    path-regex rules → specs, clipped to rank and to mesh divisibility.
+    Dropped (ragged) entries are logged once per (spec, shape): replicating
+    e.g. a 50265-row vocab table instead of sharding it is a real
+    per-device memory change an operator must be able to see in the run log.
+    """
+    from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+    rules = rules or default_rules()
+    specs = rules.tree_specs(tree)
+
+    def resolve(s: P, x: Any) -> NamedSharding:
+        shape = tuple(getattr(x, "shape", ()))
+        got = divisible_spec(s, shape, mesh)
+        if got != _clip_spec(s, len(shape)):
+            key = (str(s), shape)
+            if key not in _RAGGED_LOGGED:
+                _RAGGED_LOGGED.add(key)
+                log_json({
+                    "event": "sharding_fallback",
+                    "reason": f"shape {shape} not divisible by spec {s} on mesh "
+                              f"{dict(mesh.shape)}; ragged dims replicated",
+                    "spec": str(got),
+                })
+        return NamedSharding(mesh, got)
+
+    return jax.tree.map(resolve, specs, tree, is_leaf=lambda x: isinstance(x, P))
+
+
 def infer_param_shardings(params: Any, mesh: Mesh, rules: ShardingRules | None = None) -> Any:
     """Pytree of NamedSharding matching ``params``."""
-    rules = rules or default_rules()
-    specs = rules.tree_specs(params)
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P))
+    return resolve_shardings(params, mesh, rules)
 
 
 def batch_sharding(mesh: Mesh, *, sequence_sharded: bool = False) -> NamedSharding:

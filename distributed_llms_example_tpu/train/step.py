@@ -35,6 +35,7 @@ from distributed_llms_example_tpu.parallel.sharding import (
     ShardingRules,
     batch_sharding,
     default_rules,
+    resolve_shardings,
 )
 
 
@@ -106,9 +107,7 @@ def state_shardings(state: Any, mesh: Mesh, rules: ShardingRules | None = None) 
     to every leaf path — optimizer moments mirror the param tree (their
     paths end with the param path, which the regex rules match), scalars
     fall through to replicated."""
-    rules = rules or default_rules()
-    specs = rules.tree_specs(state)
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P))
+    return resolve_shardings(state, mesh, rules)
 
 
 def make_train_step(
@@ -124,14 +123,28 @@ def make_train_step(
     with_dropout: bool = False,
     donate: bool = True,
     is_seq2seq: bool = True,
+    sequence_sharded: bool | None = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch[, rng]) → (state, metrics).
 
     The global batch (leading dim = global batch size) must be divisible by
     ``grad_accum_steps``; each microbatch stays sharded over (data, fsdp).
+    ``sequence_sharded``: also split batch lengths over the ``sequence``
+    axis (context parallelism).  None = on whenever the mesh has a
+    sequence axis > 1; callers whose batch lengths may not divide that
+    axis (Trainer checks its bucket widths) must pass False explicitly —
+    a sharding over a non-divisible length is a dispatch-time error, not
+    a graceful fallback.
     """
     loss_sums = make_loss_fn(model, config, label_smoothing, is_seq2seq=is_seq2seq)
-    micro_sharding = NamedSharding(mesh, P(None, ("data", "fsdp"), None))
+    seq_sharded = (
+        sequence_sharded
+        if sequence_sharded is not None
+        else mesh.shape.get("sequence", 1) > 1
+    )
+    micro_sharding = NamedSharding(
+        mesh, P(None, ("data", "fsdp"), "sequence" if seq_sharded else None)
+    )
 
     def value_and_grad_sums(params: Any, batch: dict, rng: jax.Array | None) -> tuple:
         def wrapped(p):
@@ -180,9 +193,10 @@ def make_train_step(
         }
         return new_state, metrics
 
-    # shardings: state per rules; batch over (data, fsdp); rng replicated
+    # shardings: state per rules; batch over (data, fsdp) with lengths over
+    # sequence under context parallelism; rng replicated
     rules = rules or default_rules()
-    bsh = batch_sharding(mesh)
+    bsh = batch_sharding(mesh, sequence_sharded=seq_sharded)
     repl = NamedSharding(mesh, P())
 
     def jit_it(state_sh: Any) -> Callable:
@@ -220,14 +234,17 @@ def make_train_step(
     return build
 
 
-def put_batch(batch: dict, mesh: Mesh) -> dict:
+def put_batch(batch: dict, mesh: Mesh, *, sequence_sharded: bool = False) -> dict:
     """Host-local numpy batch → global sharded arrays.
 
     Single-process: a plain device_put onto the (data, fsdp) sharding.
     Multi-host: ``make_array_from_process_local_data`` assembles the global
     array from each host's slice (the analog of DDP's per-rank loaders).
+    ``sequence_sharded``: also split lengths over the ``sequence`` axis
+    (train batches under context parallelism; generation keeps lengths
+    whole because decode steps are length-1).
     """
-    sh = batch_sharding(mesh)
+    sh = batch_sharding(mesh, sequence_sharded=sequence_sharded)
     if jax.process_count() == 1:
         return {k: jax.device_put(v, sh) for k, v in batch.items()}
     return {k: jax.make_array_from_process_local_data(sh, v) for k, v in batch.items()}

@@ -128,6 +128,25 @@ class Trainer:
         self.state_sh = state_shardings(self.state, self.mesh)
         self.state = jax.tree.map(lambda x, s: jax.device_put(x, s), self.state, self.state_sh)
 
+        # Sequence (context) parallelism needs every bucket width divisible
+        # by the axis: widths are multiples of pad_to_multiple capped at the
+        # max lengths, so checking those three covers all batch shapes.  A
+        # non-divisible setup falls back to unsharded lengths (the model
+        # then picks XLA attention per shape) instead of crashing in
+        # device_put/jit dispatch.
+        seq_axis = self.mesh.shape.get("sequence", 1)
+        self.sequence_sharded = seq_axis > 1 and all(
+            dim % seq_axis == 0
+            for dim in (cfg.pad_to_multiple, cfg.max_source_length, tgt_cap)
+        )
+        if seq_axis > 1 and not self.sequence_sharded:
+            log_json({
+                "event": "sequence_sharding_disabled",
+                "reason": f"pad_to_multiple={cfg.pad_to_multiple}/"
+                          f"max_source_length={cfg.max_source_length}/"
+                          f"target_cap={tgt_cap} not all divisible by sequence={seq_axis}",
+            })
+
         self.use_dropout = self.config.dropout_rate > 0.0
         build = make_train_step(
             self.model,
@@ -139,6 +158,7 @@ class Trainer:
             label_smoothing=cfg.label_smoothing,
             with_dropout=self.use_dropout,
             is_seq2seq=self.loaded.is_seq2seq,
+            sequence_sharded=self.sequence_sharded,
         )
         self.train_step, _ = build(self.state)
 
@@ -228,7 +248,7 @@ class Trainer:
                     if profile_stop_step and step + 1 == profile_start_step:
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling_active = True
-                    gb = put_batch(batch, self.mesh)
+                    gb = put_batch(batch, self.mesh, sequence_sharded=self.sequence_sharded)
                     if self.use_dropout:
                         self._rng, sub = jax.random.split(self._rng)
                         self.state, metrics = self.train_step(self.state, gb, sub)

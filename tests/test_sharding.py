@@ -74,3 +74,21 @@ def test_batch_sharding_runs_collective(mesh8):
     xs = jax.device_put(x, bs)
     got = jax.jit(lambda a: jnp.mean(a * 2.0))(xs)
     np.testing.assert_allclose(np.asarray(got), (x * 2.0).mean(), rtol=1e-6)
+
+
+def test_ragged_dim_falls_back_to_replicated(mesh8):
+    """bart-large-cnn's vocab is 50265 (odd): the (tensor, fsdp) vocab split
+    can't divide it, so spec resolution must drop that dim to replicated
+    instead of letting device_put crash (divisible dims still shard)."""
+    from distributed_llms_example_tpu.parallel.sharding import (
+        divisible_spec,
+        infer_param_shardings,
+    )
+
+    assert divisible_spec(P(("tensor", "fsdp"), None), (50265, 1024), mesh8) == P(None, None)
+    assert divisible_spec(P(("tensor", "fsdp"), None), (50264, 1024), mesh8) == P(("tensor", "fsdp"), None)
+    assert divisible_spec(P("fsdp", "tensor"), (6, 8), mesh8) == P("fsdp", "tensor")
+
+    params = {"shared": {"embedding": np.zeros((15, 32), np.float32)}}
+    sh = infer_param_shardings(params, mesh8)
+    assert sh["shared"]["embedding"].spec == P(None, None)

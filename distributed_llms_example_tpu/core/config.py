@@ -28,8 +28,12 @@ class MeshConfig:
 
     Axis semantics (order is physical-locality order; ``tensor`` is the
     innermost / fastest-varying axis so tensor-parallel collectives ride the
-    shortest ICI links):
+    shortest ICI links, and ``stage`` is outermost so pipeline hops — the
+    least latency-sensitive traffic — can cross DCN between slices):
 
+    - ``stage``:    pipeline (GPipe-style) model parallelism — decoder
+                    layers split into stages, microbatches streamed through
+                    (parallel/pipeline.py)
     - ``data``:     pure data parallelism (batch sharding, params replicated)
     - ``fsdp``:     data parallelism with parameters/optimizer sharded
                     (ZeRO-3 equivalent; batch is also sharded over this axis)
@@ -44,9 +48,11 @@ class MeshConfig:
     fsdp: int = 1
     sequence: int = 1
     tensor: int = 1
+    stage: int = 1
 
     def axis_sizes(self) -> dict[str, int]:
         return {
+            "stage": self.stage,
             "data": self.data,
             "fsdp": self.fsdp,
             "sequence": self.sequence,
@@ -100,6 +106,9 @@ class TrainConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: bool = False  # jax.checkpoint the transformer blocks
+    # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
+    # bubble fraction is (stages-1)/(microbatches+stages-1)
+    pipeline_microbatches: int = 0
 
     # --- eval/generation (reference live path: beams=2, max_length=128,
     #     train-accelerator.py:239-242) ---
@@ -154,6 +163,7 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--param-dtype", type=str, default=_D.param_dtype)
     p.add_argument("--compute-dtype", type=str, default=_D.compute_dtype)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)
     p.add_argument("--num-beams", type=int, default=_D.num_beams)
     p.add_argument("--log-every-steps", type=int, default=_D.log_every_steps)
     p.add_argument("--tokenizer", type=str, default=_D.tokenizer)
@@ -176,7 +186,7 @@ def parse_mesh_arg(spec: str) -> MeshConfig:
         for part in spec.split(","):
             k, _, v = part.partition("=")
             k = k.strip()
-            if k not in ("data", "fsdp", "sequence", "tensor"):
+            if k not in ("stage", "data", "fsdp", "sequence", "tensor"):
                 raise ValueError(f"unknown mesh axis {k!r}")
             kw[k] = int(v)
     # MeshConfig defaults data to -1 (wildcard); if the user put the wildcard

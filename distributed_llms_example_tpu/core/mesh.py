@@ -31,7 +31,7 @@ from distributed_llms_example_tpu.core.config import MeshConfig
 
 logger = logging.getLogger(__name__)
 
-AXES: tuple[str, ...] = ("data", "fsdp", "sequence", "tensor")
+AXES: tuple[str, ...] = ("stage", "data", "fsdp", "sequence", "tensor")
 
 DEFAULT_COORDINATOR_PORT = 1234  # parity with reference train-task.py:420
 
@@ -44,18 +44,20 @@ class MeshSpec:
     fsdp: int
     sequence: int
     tensor: int
+    stage: int = 1
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.sequence * self.tensor
+        return self.stage * self.data * self.fsdp * self.sequence * self.tensor
 
     @property
     def batch_shards(self) -> int:
         """Number of ways the global batch is split (data × fsdp)."""
         return self.data * self.fsdp
 
-    def as_tuple(self) -> tuple[int, int, int, int]:
-        return (self.data, self.fsdp, self.sequence, self.tensor)
+    def as_tuple(self) -> tuple[int, int, int, int, int]:
+        """Axis sizes in mesh-axis order (AXES)."""
+        return (self.stage, self.data, self.fsdp, self.sequence, self.tensor)
 
 
 def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> MeshSpec:

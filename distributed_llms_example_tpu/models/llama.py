@@ -97,6 +97,72 @@ class LlamaBlock(nn.Module):
         return hidden + self.mlp(self.mlp_norm(hidden))
 
 
+class PipelinedLlama:
+    """Train-time ``apply()`` adapter running the LLaMA block stack as a
+    GPipe pipeline over the ``stage`` mesh axis (parallel/pipeline.py).
+
+    Drop-in for ``LlamaForCausalLM.apply`` in the train step's loss fn
+    (same call signature/logits), but the param tree holds the blocks
+    *stacked*: ``{embed_tokens, stacked_blocks, final_norm, lm_head}``
+    (``stack_blocks`` of the standard tree; checkpoints/eval use
+    ``unstack_blocks`` to return to the per-layer layout).  Embedding,
+    final norm, and LM head run replicated across stages outside the
+    pipeline body; each stage applies its layer slice with single-shard
+    (XLA) attention — ``stage`` composes with data/fsdp batch axes but not
+    with ``tensor``/``sequence`` (validated).  Training only: no KV-cache
+    generation path (unstack for eval/decoding).
+    """
+
+    def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32, num_microbatches: int = 0):
+        from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply  # noqa: F401 (validated here, used in apply)
+
+        for ax in ("tensor", "sequence"):
+            if mesh.shape.get(ax, 1) > 1:
+                raise ValueError(
+                    f"pipeline (stage>1) does not compose with {ax} parallelism"
+                )
+        stages = mesh.shape.get("stage", 1)
+        if config.num_hidden_layers % max(stages, 1):
+            raise ValueError(
+                f"{config.num_hidden_layers} layers not divisible into {stages} stages"
+            )
+        self.config = config
+        self.mesh = mesh
+        self.dtype = dtype
+        self.num_microbatches = num_microbatches or max(stages, 1)
+        self._embed = nn.Embed(config.vocab_size, config.hidden_size, dtype=dtype)
+        self._block = LlamaBlock(config, dtype=dtype)
+        self._norm = RMSNorm(config.rms_norm_eps, dtype)
+        self._head = nn.Dense(config.vocab_size, use_bias=False, dtype=dtype)
+
+    def apply(self, variables, input_ids, attention_mask=None, *,
+              deterministic: bool = True, rngs=None):
+        from distributed_llms_example_tpu.parallel.activation import activation_mesh
+        from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
+
+        params = variables["params"]
+        hidden = constrain_hidden(self._embed.apply({"params": params["embed_tokens"]}, input_ids))
+        bias = mask_to_bias(attention_mask) if attention_mask is not None else None
+        extras = {"bias": bias} if bias is not None else {}
+
+        def layer_fn(p, h, ex):
+            # no ambient mesh inside the pipeline body: attention runs its
+            # single-shard path per stage (no nested shard_map)
+            with activation_mesh(None):
+                return self._block.apply({"params": p}, h, ex.get("bias"))
+
+        hidden = pipeline_apply(
+            layer_fn,
+            params["stacked_blocks"],
+            hidden,
+            extras,
+            mesh=self.mesh,
+            num_microbatches=self.num_microbatches,
+        )
+        hidden = self._norm.apply({"params": params["final_norm"]}, hidden)
+        return constrain_logits(self._head.apply({"params": params["lm_head"]}, hidden))
+
+
 class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
     dtype: jnp.dtype = jnp.float32

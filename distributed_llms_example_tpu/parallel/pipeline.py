@@ -1,0 +1,188 @@
+"""GPipe-style pipeline parallelism over the ``stage`` mesh axis.
+
+The reference has no model parallelism of any kind (SURVEY.md §2: tensor/
+pipeline parallel "No"); this module goes past parity so decoder stacks
+too deep for one chip's HBM can be split *by layer* across chips — the
+complement of FSDP (which shards within each tensor) and the standard way
+to scale across slices, since stage hops are point-to-point and tolerate
+DCN latency (the ``stage`` axis is outermost in the mesh for exactly that
+reason, core/mesh.py).
+
+Design — a spatial pipeline expressed as one SPMD program, TPU-first:
+
+- Layer parameters are *stacked*: every transformer block's param tree
+  gets a leading layer dim (L, ...) sharded over ``stage``, so each device
+  group holds L/S contiguous layers and total param memory scales 1/S.
+- ``shard_map`` over the mesh runs the scheduling loop per-shard: a
+  ``lax.scan`` over M + S - 1 ticks.  Each tick, stage 0 feeds the next
+  microbatch in, every stage applies its layers (an inner ``lax.scan``
+  over the local layer stack, optionally ``jax.checkpoint``-ed), and
+  activations hop to the next stage with a single ``lax.ppermute`` —
+  neighbor-to-neighbor traffic XLA can overlap with the next tick's
+  compute.  The last stage collects finished microbatches.
+- The backward pass is pure autodiff: ``scan`` reverses the schedule and
+  the ``ppermute`` transpose carries activation-gradients backwards
+  through the ring — the 1F1B-shaped reverse traffic for free.
+- Bubble: (S-1)/(M+S-1) of ticks compute garbage that is discarded (and
+  contributes zero gradient).  Raise ``num_microbatches`` to amortize.
+
+Composition rules (v1): ``stage`` composes with the batch axes
+(``data``/``fsdp`` — both act as pure data parallelism here, since
+pipelined params are sharded by layer, not within tensors) but not with
+``tensor`` or ``sequence``; the adapter validates this.  Inside the
+pipeline body there is no ambient GSPMD mesh, so attention runs its
+single-shard path per stage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_blocks(params: dict, prefix: str = "block_") -> dict:
+    """Standard per-layer tree ({block_0: t, block_1: t, ...}) → pipelined
+    tree ({stacked_blocks: tree-of-(L, ...) arrays, ...rest}).  The inverse
+    of ``unstack_blocks``; checkpoints and HF conversion stay in the
+    per-layer layout, this transform is applied at training-setup time."""
+    names = sorted(
+        (k for k in params if k.startswith(prefix)),
+        key=lambda k: int(k[len(prefix):]),
+    )
+    if not names:
+        raise ValueError(f"no {prefix}* subtrees in params")
+    if names != [f"{prefix}{i}" for i in range(len(names))]:
+        raise ValueError(f"layer indices not contiguous from 0: {names}")
+    rest = {k: v for k, v in params.items() if k not in names}
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *(params[n] for n in names))
+    return {**rest, "stacked_blocks": stacked}
+
+
+def unstack_blocks(params: dict, prefix: str = "block_") -> dict:
+    """Pipelined tree → standard per-layer tree (for checkpoints/eval)."""
+    stacked = params["stacked_blocks"]
+    rest = {k: v for k, v in params.items() if k != "stacked_blocks"}
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    out = dict(rest)
+    for i in range(n):
+        out[f"{prefix}{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    return out
+
+
+def _full_spec(leading, ndim: int) -> P:
+    return P(leading, *([None] * (ndim - 1)))
+
+
+def pipeline_apply(
+    layer_fn: Callable[[Any, jnp.ndarray, Any], jnp.ndarray],
+    stacked_params: Any,
+    hidden: jnp.ndarray,
+    extras: Any = None,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "stage",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    checkpoint: bool = True,
+) -> jnp.ndarray:
+    """Run ``hidden`` through the stacked layers as a pipelined schedule.
+
+    ``layer_fn(layer_params, h, extras_microbatch) -> h`` applies ONE
+    layer.  ``hidden``: (B, ...) global batch; ``extras``: optional pytree
+    of per-example arrays (leading dim B, e.g. an attention padding bias)
+    or per-call constants (leading dim != B, replicated to every stage).
+    Requires L % stages == 0 and (local batch) % num_microbatches == 0.
+    Output is bit-identical to applying the layers sequentially (the
+    schedule only reorders microbatches, never the math within one).
+    """
+    S = mesh.shape.get(axis_name, 1)
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    M = num_microbatches
+    if L % S:
+        raise ValueError(f"{L} layers not divisible into {S} pipeline stages")
+    B = hidden.shape[0]
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
+    batch_shards = 1
+    for a in batch_axes:
+        batch_shards *= mesh.shape[a]
+    if B % (batch_shards * M):
+        raise ValueError(
+            f"global batch {B} not divisible by {batch_shards} batch shards "
+            f"× {M} microbatches"
+        )
+
+    one_layer = jax.checkpoint(layer_fn) if checkpoint else layer_fn
+
+    def run_stage(local_params: Any, x: jnp.ndarray, ex: Any) -> jnp.ndarray:
+        def step(carry, p):
+            return one_layer(p, carry, ex), None
+
+        y, _ = jax.lax.scan(step, x, local_params)
+        return y
+
+    if S == 1:
+        # no pipeline: plain scan over the full stack under GSPMD
+        return run_stage(stacked_params, hidden, extras)
+
+    batch_spec = batch_axes or None
+    hidden_spec = _full_spec(batch_spec, hidden.ndim)
+    # which extras are per-example (to be microbatched) vs per-call
+    # constants (replicated): decided from GLOBAL shapes, outside the body
+    is_batched = jax.tree.map(lambda m: m.ndim > 0 and m.shape[0] == B, extras)
+
+    def body(local_params: Any, h: jnp.ndarray, ex: Any) -> jnp.ndarray:
+        s_idx = jax.lax.axis_index(axis_name)
+        mb = h.shape[0] // M
+        micro = h.reshape(M, mb, *h.shape[1:])
+        micro_ex = jax.tree.map(
+            lambda m, batched: m.reshape(M, m.shape[0] // M, *m.shape[1:]) if batched else m,
+            ex,
+            is_batched,
+        )
+        buf = jnp.zeros((mb, *h.shape[1:]), h.dtype)
+        outputs = jnp.zeros((M, mb, *h.shape[1:]), h.dtype)
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(carry, t):
+            buf, outputs = carry
+            # stage s processes microbatch (t - s); clamp covers bubble ticks
+            m_idx = jnp.clip(t - s_idx, 0, M - 1)
+            x0 = jax.lax.dynamic_index_in_dim(micro, m_idx, 0, keepdims=False)
+            ex_t = jax.tree.map(
+                lambda m, batched: jax.lax.dynamic_index_in_dim(m, m_idx, 0, keepdims=False)
+                if batched else m,
+                micro_ex,
+                is_batched,
+            )
+            inp = jnp.where(s_idx == 0, x0, buf)
+            y = run_stage(local_params, inp, ex_t)
+            nxt = jax.lax.ppermute(y, axis_name, perm)
+            write = (s_idx == S - 1) & (t >= S - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(outputs, y, m_idx, 0)
+            outputs = jnp.where(write, upd, outputs)
+            return (nxt, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(tick, (buf, outputs), jnp.arange(M + S - 1))
+        # only the last stage holds real results; replicate them to every
+        # stage so downstream (final norm / head / loss) is stage-uniform
+        outputs = jax.lax.psum(
+            jnp.where(s_idx == S - 1, outputs, jnp.zeros_like(outputs)), axis_name
+        )
+        return outputs.reshape(h.shape)
+
+    param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
+    extras_specs = jax.tree.map(
+        lambda m, batched: _full_spec(batch_spec, m.ndim) if batched else P(),
+        extras,
+        is_batched,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, hidden_spec, extras_specs),
+        out_specs=hidden_spec,
+        check_vma=False,
+    )(stacked_params, hidden, extras)

@@ -107,6 +107,18 @@ def default_rules() -> ShardingRules:
     return ShardingRules(rules=DEFAULT_RULES)
 
 
+# Pipelined (stage>1) param layout: stacked block trees shard their leading
+# layer dim over ``stage``; everything else (embed/norms/head) replicates —
+# stage composes with batch axes only, so no tensor/fsdp splits here.
+PIPELINE_RULES: list[tuple[str, P]] = [
+    (r"stacked_blocks/", P("stage")),
+]
+
+
+def pipeline_rules() -> ShardingRules:
+    return ShardingRules(rules=PIPELINE_RULES)
+
+
 def divisible_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
     """Drop spec entries whose mesh-axes product doesn't divide the dim.
 

@@ -123,9 +123,36 @@ class Trainer:
         params = self.loaded.params
         if params is None:
             params = jax.device_get(self.loaded.init_params(cfg.shuffle_seed))
-        params = shard_params(params, self.mesh)
+
+        # Pipeline parallelism: stage>1 swaps in the GPipe adapter — blocks
+        # stacked (leading layer dim sharded over ``stage``), train-only.
+        self.pipelined = self.mesh.shape.get("stage", 1) > 1
+        self._rules = None  # None → default FSDP/TP rules everywhere below
+        if self.pipelined:
+            if self.loaded.family != "llama":
+                raise ValueError(
+                    "pipeline parallelism (stage>1) currently supports the "
+                    f"LLaMA family only, got {self.loaded.family!r}"
+                )
+            from distributed_llms_example_tpu.models.llama import PipelinedLlama
+            from distributed_llms_example_tpu.parallel.pipeline import stack_blocks
+            from distributed_llms_example_tpu.parallel.sharding import pipeline_rules
+
+            params = stack_blocks(params)
+            self.model = PipelinedLlama(
+                self.config, self.mesh, dtype=compute_dtype,
+                num_microbatches=cfg.pipeline_microbatches,
+            )
+            self._rules = pipeline_rules()
+            log_json({
+                "event": "pipeline_enabled",
+                "stages": self.mesh.shape["stage"],
+                "num_microbatches": self.model.num_microbatches,
+            })
+
+        params = shard_params(params, self.mesh, self._rules)
         self.state = create_train_state(params, self.tx)
-        self.state_sh = state_shardings(self.state, self.mesh)
+        self.state_sh = state_shardings(self.state, self.mesh, self._rules)
         self.state = jax.tree.map(lambda x, s: jax.device_put(x, s), self.state, self.state_sh)
 
         # Sequence (context) parallelism needs every bucket width divisible
@@ -159,6 +186,7 @@ class Trainer:
             with_dropout=self.use_dropout,
             is_seq2seq=self.loaded.is_seq2seq,
             sequence_sharded=self.sequence_sharded,
+            rules=self._rules,
         )
         self.train_step, _ = build(self.state)
 
@@ -185,9 +213,16 @@ class Trainer:
                 max_new_tokens=cfg.eval_max_new_tokens,
                 is_seq2seq=self.loaded.is_seq2seq,
             )
-            if self.val_ds
+            if self.val_ds and not self.pipelined
             else None
         )
+        if self.pipelined and self.val_ds:
+            log_json({
+                "event": "eval_disabled",
+                "reason": "pipeline (stage>1) is train-only; export writes the "
+                          "standard per-layer layout — run eval from it on a "
+                          "non-stage mesh",
+            })
         self._rng = jax.random.PRNGKey(cfg.shuffle_seed)
 
     # ------------------------------------------------------------------
@@ -300,8 +335,15 @@ class Trainer:
         import orbax.checkpoint as ocp
 
         params_dir = os.path.join(out, "params")
+        final_params = jax.device_get(self.state.params)
+        if self.pipelined:
+            # export in the standard per-layer layout so the artifact loads
+            # anywhere (eval, conversion, non-pipelined resume)
+            from distributed_llms_example_tpu.parallel.pipeline import unstack_blocks
+
+            final_params = unstack_blocks(final_params)
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.abspath(params_dir), jax.device_get(self.state.params), force=True)
+        ckptr.save(os.path.abspath(params_dir), final_params, force=True)
         ckptr.wait_until_finished()
         ckptr.close()
         if jax.process_index() == 0:

@@ -131,8 +131,15 @@ def _flagship():
         n_params = sum(int(math.prod(x.shape)) for x in jax.tree.leaves(shapes))
         remat_env = os.environ.get("BENCH_REMAT", "")
         remat = (n_params > 1_000_000_000) if remat_env == "" else remat_env != "0"
-        if remat:  # reload only when the flag differs from the first load
-            lm = load_model(name, dtype=jax.numpy.bfloat16, remat=True)
+        if remat:
+            # rebuild just the module with remat on — the already-loaded
+            # weights (if any) don't depend on the flag, so no second
+            # checkpoint read/convert for the 7B-class models
+            import dataclasses
+
+            lm = dataclasses.replace(
+                lm, module=type(lm.module)(lm.config, dtype=jax.numpy.bfloat16, remat=True)
+            )
         return name, lm, remat
     raise SystemExit("no benchmarkable model in registry")
 

@@ -113,7 +113,8 @@ class PipelinedLlama:
     generation path (unstack for eval/decoding).
     """
 
-    def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32, num_microbatches: int = 0):
+    def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32,
+                 num_microbatches: int = 0, remat: bool = True):
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply  # noqa: F401 (validated here, used in apply)
 
         for ax in ("tensor", "sequence"):
@@ -130,6 +131,7 @@ class PipelinedLlama:
         self.mesh = mesh
         self.dtype = dtype
         self.num_microbatches = num_microbatches or max(stages, 1)
+        self.remat = remat  # per-layer jax.checkpoint inside the pipeline
         self._embed = nn.Embed(config.vocab_size, config.hidden_size, dtype=dtype)
         self._block = LlamaBlock(config, dtype=dtype)
         self._norm = RMSNorm(config.rms_norm_eps, dtype)
@@ -158,6 +160,7 @@ class PipelinedLlama:
             extras,
             mesh=self.mesh,
             num_microbatches=self.num_microbatches,
+            checkpoint=self.remat,
         )
         hidden = self._norm.apply({"params": params["final_norm"]}, hidden)
         return constrain_logits(self._head.apply({"params": params["lm_head"]}, hidden))

@@ -57,7 +57,14 @@ def stack_blocks(params: dict, prefix: str = "block_") -> dict:
     if names != [f"{prefix}{i}" for i in range(len(names))]:
         raise ValueError(f"layer indices not contiguous from 0: {names}")
     rest = {k: v for k, v in params.items() if k not in names}
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *(params[n] for n in names))
+    # host-side stack: jnp.stack would commit the whole stacked tree to the
+    # default device before the P('stage') sharding is ever applied — OOM
+    # for exactly the too-big-for-one-chip models this module exists for
+    import numpy as np
+
+    stacked = jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *(params[n] for n in names)
+    )
     return {**rest, "stacked_blocks": stacked}
 
 

@@ -32,8 +32,16 @@ def linear_schedule_with_warmup(lr: float, warmup_steps: int, total_steps: int) 
 
 
 def decay_mask(params: Any) -> Any:
-    """True (decay) for matrices/embeddings, False for biases & norm scales."""
-    return jax.tree.map(lambda p: p.ndim >= 2, params)
+    """True (decay) for matrices/embeddings, False for biases & norm scales.
+
+    Checks the leaf *name* as well as rank: under pipeline parallelism the
+    blocks are stacked with a leading layer dim, which makes norm scales
+    (L, d) — rank alone would silently start decaying them."""
+    def is_decay(path, p) -> bool:
+        leaf = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
+        return p.ndim >= 2 and leaf not in ("scale", "bias")
+
+    return jax.tree.map_with_path(is_decay, params)
 
 
 def make_optimizer(

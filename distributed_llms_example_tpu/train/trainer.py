@@ -142,6 +142,7 @@ class Trainer:
             self.model = PipelinedLlama(
                 self.config, self.mesh, dtype=compute_dtype,
                 num_microbatches=cfg.pipeline_microbatches,
+                remat=cfg.remat,
             )
             self._rules = pipeline_rules()
             log_json({

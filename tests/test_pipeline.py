@@ -260,3 +260,21 @@ def test_trainer_pipelined_end_to_end(tmp_path):
     )
     assert "block_0" in restored and "block_1" in restored
     assert "stacked_blocks" not in restored
+
+
+def test_decay_mask_on_stacked_params():
+    """Weight decay must not hit norm scales just because stacking gave
+    them a leading layer dim (rank-only masks get this wrong)."""
+    from distributed_llms_example_tpu.train.optim import decay_mask
+
+    params = {
+        "stacked_blocks": {
+            "attn_norm": {"scale": np.ones((4, 32), np.float32)},
+            "self_attn": {"q_proj": {"kernel": np.ones((4, 32, 32), np.float32)}},
+        },
+        "final_norm": {"scale": np.ones((32,), np.float32)},
+    }
+    mask = decay_mask(params)
+    assert mask["stacked_blocks"]["attn_norm"]["scale"] is False
+    assert mask["stacked_blocks"]["self_attn"]["q_proj"]["kernel"] is True
+    assert mask["final_norm"]["scale"] is False

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from distributed_llms_example_tpu.ops.attention import mask_to_bias
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
+from distributed_llms_example_tpu.ops.moe import MoEMLP
 from distributed_llms_example_tpu.ops.norms import RMSNorm
 from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
 
@@ -35,7 +36,12 @@ class LlamaConfig:
     pad_token_id: int = 0
     bos_token_id: int = 1
     eos_token_id: int = 2
-    attention_impl: str = "auto"  # "auto" | "flash" | "xla" (see ops/mha.py)
+    attention_impl: str = "auto"  # "auto" | "flash" | "ring" | "xla" (see ops/mha.py)
+    # Mixture-of-experts (Mixtral-class): 0 experts = dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.0  # load-balance loss weight (0 disables)
 
     @property
     def head_dim(self) -> int:
@@ -86,7 +92,17 @@ class LlamaBlock(nn.Module):
             name="self_attn",
         )
         self.mlp_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="mlp_norm")
-        self.mlp = LlamaMLP(cfg, dtype=self.dtype, name="mlp")
+        if cfg.num_experts > 0:
+            self.mlp = MoEMLP(
+                num_experts=cfg.num_experts,
+                intermediate_size=cfg.intermediate_size,
+                top_k=cfg.num_experts_per_tok,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=self.dtype,
+                name="mlp",
+            )
+        else:
+            self.mlp = LlamaMLP(cfg, dtype=self.dtype, name="mlp")
 
     def __call__(
         self, hidden, bias=None, deterministic: bool = True, use_cache: bool = False, positions=None
@@ -122,6 +138,13 @@ class PipelinedLlama:
                 raise ValueError(
                     f"pipeline (stage>1) does not compose with {ax} parallelism"
                 )
+        if getattr(config, "num_experts", 0) > 0:
+            raise ValueError(
+                "pipeline (stage>1) does not support MoE configs yet: the "
+                "load-balance loss sown inside the pipeline body cannot reach "
+                "the loss fn (and the train step's mutable-apply path is not "
+                "wired through this adapter)"
+            )
         stages = mesh.shape.get("stage", 1)
         if config.num_hidden_layers % max(stages, 1):
             raise ValueError(

@@ -71,6 +71,20 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
     "llama-2-13b": LlamaConfig(
         hidden_size=5120, intermediate_size=13824, num_hidden_layers=40, num_attention_heads=40
     ),
+    # Mixtral-class sparse MoE (ops/moe.py): LLaMA blocks with top-2 routed
+    # expert MLPs, experts sharded over ``tensor`` (expert parallelism)
+    "mixtral-test": LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+        num_experts=4, num_experts_per_tok=2, moe_aux_weight=0.01,
+    ),
+    "mixtral-8x7b": LlamaConfig(
+        hidden_size=4096, intermediate_size=14336, num_hidden_layers=32,
+        num_attention_heads=32, num_key_value_heads=8, vocab_size=32000,
+        max_position_embeddings=32768, rope_theta=1e6,
+        num_experts=8, num_experts_per_tok=2, moe_aux_weight=0.02,
+    ),
 }
 
 

@@ -1,0 +1,144 @@
+"""Mixture-of-experts MLP with expert parallelism (Mixtral-style).
+
+The reference has no MoE (SURVEY.md §2: expert parallel "out of scope");
+this module goes past parity so the LLaMA family extends to
+Mixtral-class sparse models.  TPU-first design choices:
+
+- **Dense dispatch in fixed-size groups** (GShard/Switch formulation):
+  tokens are routed within groups of ``group_size``, and routing is
+  expressed as two einsums against a (group, tokens, experts, capacity)
+  one-hot dispatch tensor — the whole layer is static-shaped matmuls the
+  MXU executes and XLA can partition; no ragged gather/scatter, no
+  data-dependent shapes, and activation memory linear in sequence length
+  (per-group dispatch is O(group_size²·K/E), ~167 MB fp32 at the 4096
+  default with E=8/K=2).  Tokens over an expert's per-group capacity are
+  dropped (their output is 0; the block's residual connection carries
+  them through), the standard capacity-factor trade.
+- **Expert parallelism via GSPMD**: the stacked expert weights
+  (E, d_in, d_out) shard their leading dim over the ``tensor`` mesh axis
+  (see ``parallel/sharding.py`` EXPERT rules), and the expert-major
+  activations (G, E, capacity, d) are constrained to the same axis — the
+  partitioner then lowers the dispatch/combine einsums to the expert
+  all-to-all over ICI, with zero hand-written collectives.
+- **Router in fp32** — softmax over experts is precision-sensitive, the
+  same policy as attention softmax (core/precision.py).
+- The Switch load-balancing auxiliary loss (E · Σ_e fraction_e · prob_e,
+  =1 at uniform routing) is ``sow``-n into the ``losses`` collection;
+  the train step adds it when ``config.moe_aux_weight > 0`` and
+  generation (which never mutates ``losses``) silently discards it.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_llms_example_tpu.parallel.activation import constrain
+
+
+def _expert_spec():
+    """(groups, experts, capacity, d_model) — experts over ``tensor``."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, "tensor")
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU experts; drop-in for a dense gated MLP.
+
+    Shapes: E experts, each a SwiGLU of (d_model → ff → d_model) with
+    stacked weights (E, ...).  ``capacity_factor`` scales each expert's
+    token budget: capacity = ceil(top_k · N / E · factor).
+    """
+
+    num_experts: int
+    intermediate_size: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    # routing group size (GShard): tokens are routed within fixed-size
+    # groups, so the (group, E, capacity) dispatch tensors stay
+    # O(group_size²) per group and total activation memory is LINEAR in
+    # sequence length — without grouping the dense dispatch is quadratic
+    # and cannot fit 32k-context mixtral-8x7b on a 16 GB chip
+    group_size: int = 4096
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, s, d = x.shape
+        E, K = self.num_experts, self.top_k
+        n = b * s
+        g = min(self.group_size, n)
+        G = -(-n // g)  # ceil
+        n_pad = G * g - n
+        tokens = x.reshape(n, d)
+        if n_pad:
+            tokens = jnp.pad(tokens, ((0, n_pad), (0, 0)))
+        tokens = tokens.reshape(G, g, d)
+        # pad tokens are excluded from routing (they claim no capacity)
+        valid = (jnp.arange(G * g) < n).astype(jnp.float32).reshape(G, g)
+        capacity = max(1, int(K * g / E * self.capacity_factor))
+
+        router = nn.Dense(E, use_bias=False, dtype=jnp.float32, name="router")
+        logits = router(tokens.astype(jnp.float32))  # (G, g, E), fp32
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k selection; Mixtral renormalizes the chosen gates to sum 1
+        gate_vals, expert_idx = jax.lax.top_k(probs, K)  # (G, g, K)
+        if K > 1:
+            gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+        # position-in-expert via in-group cumsum, k-th choices queue behind
+        # (k-1)-th; tokens past an expert's capacity are dropped
+        dispatch = jnp.zeros((G, g, E, capacity), jnp.float32)
+        combine = jnp.zeros((G, g, E, capacity), jnp.float32)
+        counts = jnp.zeros((G, E), jnp.float32)
+        for k in range(K):
+            mask_k = jax.nn.one_hot(expert_idx[..., k], E, dtype=jnp.float32)
+            mask_k = mask_k * valid[..., None]  # (G, g, E)
+            pos_k = jnp.cumsum(mask_k, axis=1) - mask_k + counts[:, None, :]
+            counts = counts + jnp.sum(mask_k, axis=1)
+            mask_k = mask_k * (pos_k < capacity)
+            slot = jax.nn.one_hot(
+                jnp.sum(pos_k * mask_k, axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32
+            )  # (G, g, cap)
+            disp_k = mask_k[..., None] * slot[..., None, :]  # (G, g, E, cap)
+            dispatch = dispatch + disp_k
+            combine = combine + gate_vals[..., k, None, None] * disp_k
+
+        # Switch load-balance loss over REAL tokens: E * Σ_e fraction_e ·
+        # mean-prob_e; top-1 assignments define the fraction, 1.0 at uniform
+        n_real = jnp.maximum(jnp.sum(valid), 1.0)
+        top1 = jax.nn.one_hot(expert_idx[..., 0], E, dtype=jnp.float32) * valid[..., None]
+        frac = jnp.sum(top1, axis=(0, 1)) / n_real
+        mean_prob = jnp.sum(probs * valid[..., None], axis=(0, 1)) / n_real
+        aux = E * jnp.sum(frac * mean_prob)
+        self.sow(
+            "losses", "moe_aux", aux,
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+
+        # dispatch → per-expert per-group batches, batched SwiGLU on the
+        # MXU (experts broadcast over groups), combine
+        expert_in = jnp.einsum("Gnec,Gnd->Gecd", dispatch.astype(self.dtype), tokens)
+        expert_in = constrain(expert_in, _expert_spec())
+        w_gate = self.param(
+            "gate_proj", nn.initializers.lecun_normal(), (E, d, self.intermediate_size)
+        ).astype(self.dtype)
+        w_up = self.param(
+            "up_proj", nn.initializers.lecun_normal(), (E, d, self.intermediate_size)
+        ).astype(self.dtype)
+        w_down = self.param(
+            "down_proj", nn.initializers.lecun_normal(), (E, self.intermediate_size, d)
+        ).astype(self.dtype)
+        h = nn.silu(jnp.einsum("Gecd,edf->Gecf", expert_in, w_gate))
+        h = h * jnp.einsum("Gecd,edf->Gecf", expert_in, w_up)
+        expert_out = jnp.einsum("Gecf,efd->Gecd", h, w_down)
+        expert_out = constrain(expert_out, _expert_spec())
+        out = jnp.einsum("Gnec,Gecd->Gnd", combine.astype(self.dtype), expert_out)
+        out = out.reshape(G * g, d)
+        if n_pad:
+            out = out[:n]
+        return out.reshape(b, s, d)

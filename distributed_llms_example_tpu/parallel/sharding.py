@@ -93,6 +93,11 @@ DEFAULT_RULES: list[tuple[str, P]] = [
     # o is row-parallel (heads*head_dim, d_model)
     (r"(self_attn|cross_attn|attention)/(q|k|v)_proj/kernel", P("fsdp", "tensor")),
     (r"(self_attn|cross_attn|attention)/o_proj/kernel", P("tensor", "fsdp")),
+    # MoE: stacked expert weights (E, d_in, d_out) — experts over ``tensor``
+    # (expert parallelism: GSPMD lowers the dispatch/combine einsums to the
+    # expert all-to-all), inner input dim over ``fsdp``; fp32 router
+    # replicated (falls through to default)
+    (r"mlp/(gate_proj|up_proj|down_proj)$", P("tensor", "fsdp", None)),
     # MLP: in column-parallel, out row-parallel
     (r"mlp/(wi|wi_0|wi_1|gate_proj|up_proj|fc1)/kernel", P("fsdp", "tensor")),
     (r"mlp/(wo|down_proj|fc2)/kernel", P("tensor", "fsdp")),

@@ -76,28 +76,42 @@ def make_loss_fn(
     position t's logits predict ``labels[t+1]`` (next-token convention).
     """
 
+    # MoE models sow a load-balance loss into the "losses" collection; it
+    # is token-weighted into the CE sum so the normalized loss comes out
+    # as mean-CE + weight·aux (exact under scan-based grad accumulation).
+    moe_weight = float(getattr(config, "moe_aux_weight", 0.0) or 0.0)
+
+    def apply_model(params: Any, *args, **kw):
+        if moe_weight > 0.0:
+            logits, mutated = model.apply({"params": params}, *args, mutable=["losses"], **kw)
+            aux = sum(jax.tree.leaves(mutated.get("losses", {})), jnp.zeros((), jnp.float32))
+            return logits, aux
+        return model.apply({"params": params}, *args, **kw), jnp.zeros((), jnp.float32)
+
     def loss_sums(params: Any, batch: dict, dropout_rng: jax.Array | None = None) -> tuple:
         labels = batch["labels"]
         rngs = {"dropout": dropout_rng} if dropout_rng is not None else {}
         if is_seq2seq:
             decoder_input_ids = shift_right(labels, config.decoder_start_token_id, config.pad_token_id)
-            logits = model.apply(
-                {"params": params},
+            logits, aux = apply_model(
+                params,
                 batch["input_ids"],
                 batch["attention_mask"],
                 decoder_input_ids,
                 deterministic=dropout_rng is None,
                 rngs=rngs,
             )
-            return cross_entropy_sums(logits, labels, label_smoothing)
-        logits = model.apply(
-            {"params": params},
-            batch["input_ids"],
-            batch["attention_mask"],
-            deterministic=dropout_rng is None,
-            rngs=rngs,
-        )
-        return cross_entropy_sums(logits[:, :-1], labels[:, 1:], label_smoothing)
+            lsum, tokens = cross_entropy_sums(logits, labels, label_smoothing)
+        else:
+            logits, aux = apply_model(
+                params,
+                batch["input_ids"],
+                batch["attention_mask"],
+                deterministic=dropout_rng is None,
+                rngs=rngs,
+            )
+            lsum, tokens = cross_entropy_sums(logits[:, :-1], labels[:, 1:], label_smoothing)
+        return lsum + moe_weight * aux * tokens, tokens
 
     return loss_sums
 

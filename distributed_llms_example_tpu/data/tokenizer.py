@@ -41,7 +41,10 @@ class ByteTokenizer:
         return [b + self.OFFSET for b in text.encode("utf-8")]
 
     def decode(self, ids: Sequence[int]) -> str:
-        data = bytes(i - self.OFFSET for i in ids if i >= self.OFFSET)
+        # ids outside [OFFSET, OFFSET+256) are skipped, not an error: models
+        # may have a larger vocab than the tokenizer (padded/rounded vocab
+        # sizes), and randomly-initialized models emit arbitrary ids
+        data = bytes(i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256)
         return data.decode("utf-8", errors="replace")
 
 

@@ -168,8 +168,14 @@ def convert_bart_state_dict(state_dict: Mapping[str, Any]) -> dict:
 
 
 def convert_llama_state_dict(state_dict: Mapping[str, Any]) -> dict:
-    """HF ``LlamaForCausalLM`` state_dict → our param tree."""
+    """HF ``LlamaForCausalLM`` / ``MixtralForCausalLM`` state_dict → our
+    param tree.  Mixtral's per-expert ``block_sparse_moe.experts.{j}.w1/w2/w3``
+    linears are stacked into our (E, d_in, d_out) expert tensors
+    (w1→gate_proj, w3→up_proj, w2→down_proj) and the router gate transposes
+    into ``mlp/router/kernel``."""
     params: dict = {}
+    # (block prefix, w-index) → {expert index: transposed weight}
+    experts: dict[tuple, dict[int, Any]] = {}
     for name, tensor in state_dict.items():
         if name.endswith("rotary_emb.inv_freq"):
             continue  # derived buffer
@@ -196,6 +202,13 @@ def convert_llama_state_dict(state_dict: Mapping[str, Any]) -> dict:
         if m:
             _set(params, f"{prefix}/mlp/{m.group(1)}_proj/kernel", _t(arr))
             continue
+        if rest == "block_sparse_moe.gate.weight":
+            _set(params, f"{prefix}/mlp/router/kernel", _t(arr))
+            continue
+        m = re.match(r"block_sparse_moe\.experts\.(\d+)\.w([123])\.weight", rest)
+        if m:
+            experts.setdefault((prefix, m.group(2)), {})[int(m.group(1))] = _t(arr)
+            continue
         if rest == "input_layernorm.weight":
             _set(params, f"{prefix}/attn_norm/scale", arr)
             continue
@@ -203,6 +216,10 @@ def convert_llama_state_dict(state_dict: Mapping[str, Any]) -> dict:
             _set(params, f"{prefix}/mlp_norm/scale", arr)
             continue
         raise ValueError(f"unrecognized LLaMA layer parameter: {name}")
+    w_names = {"1": "gate_proj", "3": "up_proj", "2": "down_proj"}
+    for (prefix, w), per_expert in experts.items():
+        stacked = np.stack([per_expert[j] for j in range(len(per_expert))])
+        _set(params, f"{prefix}/mlp/{w_names[w]}", stacked)
     return params
 
 
@@ -212,6 +229,7 @@ CONVERTERS: dict[str, Callable[[Mapping[str, Any]], dict]] = {
     "t5": convert_t5_state_dict,
     "bart": convert_bart_state_dict,
     "llama": convert_llama_state_dict,
+    "mixtral": convert_llama_state_dict,  # llama blocks + stacked experts
 }
 
 

@@ -110,6 +110,11 @@ class LlamaBlock(nn.Module):
         hidden = hidden + self.self_attn(
             self.attn_norm(hidden), bias=bias, use_cache=use_cache, positions=positions
         )
+        if self.config.num_experts > 0:
+            # cached decode/prefill = inference: size expert capacity so no
+            # token drops (exact HF-checkpoint behavior); training keeps the
+            # capacity-factor trade
+            return hidden + self.mlp(self.mlp_norm(hidden), no_drop=use_cache)
         return hidden + self.mlp(self.mlp_norm(hidden))
 
 

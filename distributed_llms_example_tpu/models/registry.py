@@ -189,13 +189,28 @@ def _build(family: str, cfg: Any, dtype: jnp.dtype, remat: bool, params: Any = N
     if family == "bart":
         module = BartForConditionalGeneration(cfg, dtype=dtype, remat=remat)
         return LoadedModel("bart", cfg, module, params, is_seq2seq=True)
-    if family == "llama":
+    if family in ("llama", "mixtral"):  # mixtral = llama blocks + MoE MLP
         module = LlamaForCausalLM(cfg, dtype=dtype, remat=remat)
         return LoadedModel("llama", cfg, module, params, is_seq2seq=False)
     raise ValueError(f"unsupported model family {family!r}")
 
 
-_HF_CONFIG_PARSERS = {"t5": _t5_from_hf_config, "bart": _bart_from_hf_config, "llama": _llama_from_hf_config}
+def _mixtral_from_hf_config(cfg: dict) -> LlamaConfig:
+    base = _llama_from_hf_config(cfg)
+    return dataclasses.replace(
+        base,
+        num_experts=cfg.get("num_local_experts", 8),
+        num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+        moe_aux_weight=cfg.get("router_aux_loss_coef", 0.02),
+    )
+
+
+_HF_CONFIG_PARSERS = {
+    "t5": _t5_from_hf_config,
+    "bart": _bart_from_hf_config,
+    "llama": _llama_from_hf_config,
+    "mixtral": _mixtral_from_hf_config,
+}
 
 
 def load_model(
@@ -213,9 +228,9 @@ def load_model(
     XLA attention (its learned relative-position bias would get a silent
     zero gradient from the flash kernel).
     """
-    if attention_impl not in (None, "auto", "flash", "xla"):
+    if attention_impl not in (None, "auto", "flash", "ring", "xla"):
         raise ValueError(
-            f"attention_impl={attention_impl!r}: must be 'auto', 'flash', or 'xla'"
+            f"attention_impl={attention_impl!r}: must be 'auto', 'flash', 'ring', or 'xla'"
         )
 
     def _apply_impl(cfg):

@@ -30,6 +30,8 @@ Mixtral-class sparse models.  TPU-first design choices:
 
 from __future__ import annotations
 
+import math
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -65,7 +67,11 @@ class MoEMLP(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, no_drop: bool = False) -> jnp.ndarray:
+        """``no_drop=True`` (inference/decode) sizes capacity so NO token
+        can overflow (capacity = group size): converted checkpoints then
+        reproduce HF Mixtral logits exactly, at the price of a larger
+        dispatch tensor — acceptable off the training path."""
         b, s, d = x.shape
         E, K = self.num_experts, self.top_k
         n = b * s
@@ -78,7 +84,7 @@ class MoEMLP(nn.Module):
         tokens = tokens.reshape(G, g, d)
         # pad tokens are excluded from routing (they claim no capacity)
         valid = (jnp.arange(G * g) < n).astype(jnp.float32).reshape(G, g)
-        capacity = max(1, int(K * g / E * self.capacity_factor))
+        capacity = g if no_drop else max(1, math.ceil(K * g / E * self.capacity_factor))
 
         router = nn.Dense(E, use_bias=False, dtype=jnp.float32, name="router")
         logits = router(tokens.astype(jnp.float32))  # (G, g, E), fp32

@@ -84,7 +84,11 @@ def make_loss_fn(
     def apply_model(params: Any, *args, **kw):
         if moe_weight > 0.0:
             logits, mutated = model.apply({"params": params}, *args, mutable=["losses"], **kw)
-            aux = sum(jax.tree.leaves(mutated.get("losses", {})), jnp.zeros((), jnp.float32))
+            leaves = jax.tree.leaves(mutated.get("losses", {}))
+            # mean over layers (each MoE layer sows one scalar): keeps the
+            # configured coefficient comparable to HF Mixtral's single
+            # all-layer loss instead of scaling with depth
+            aux = sum(leaves, jnp.zeros((), jnp.float32)) / max(len(leaves), 1)
             return logits, aux
         return model.apply({"params": params}, *args, **kw), jnp.zeros((), jnp.float32)
 

@@ -117,11 +117,16 @@ def _flagship():
 
     from distributed_llms_example_tpu.models.registry import load_model
 
+    attention = os.environ.get("BENCH_ATTENTION", "") or None
+    if attention not in (None, "auto", "flash", "ring", "xla"):
+        # validate up front: the except below is for unknown registry names,
+        # and a typo'd env var must not masquerade as "no model found"
+        raise SystemExit(f"BENCH_ATTENTION={attention!r}: must be auto/flash/ring/xla")
     for name in (os.environ.get("BENCH_MODEL", ""), "bart-large-cnn", "t5-small"):
         if not name:
             continue
         try:
-            lm = load_model(name, dtype=jax.numpy.bfloat16)
+            lm = load_model(name, dtype=jax.numpy.bfloat16, attention_impl=attention)
         except ValueError:
             continue
         # remat trades ~27% measured throughput for activation memory — only

@@ -130,6 +130,29 @@ def _t5_from_hf_config(cfg: dict) -> T5Config:
 
 
 def _load_local_state_dict(path: str) -> dict:
+    # sharded layouts first: large checkpoints (7B+, mixtral-8x7b) are always
+    # shipped as model-0000N-of-000NN files plus an index json
+    for index_name, loader in (
+        ("model.safetensors.index.json", "safetensors"),
+        ("pytorch_model.bin.index.json", "torch"),
+    ):
+        index_path = os.path.join(path, index_name)
+        if not os.path.exists(index_path):
+            continue
+        with open(index_path) as f:
+            weight_map = json.load(f)["weight_map"]
+        out: dict = {}
+        for shard in sorted(set(weight_map.values())):
+            shard_path = os.path.join(path, shard)
+            if loader == "safetensors":
+                from safetensors.numpy import load_file  # ships with transformers
+
+                out.update(load_file(shard_path))
+            else:
+                import torch
+
+                out.update(torch.load(shard_path, map_location="cpu", weights_only=True))
+        return out
     st_path = os.path.join(path, "model.safetensors")
     if os.path.exists(st_path):
         from safetensors.numpy import load_file  # ships with transformers
@@ -140,7 +163,9 @@ def _load_local_state_dict(path: str) -> dict:
         import torch
 
         return torch.load(bin_path, map_location="cpu", weights_only=True)
-    raise FileNotFoundError(f"no model.safetensors or pytorch_model.bin under {path}")
+    raise FileNotFoundError(
+        f"no model.safetensors(.index.json) or pytorch_model.bin(.index.json) under {path}"
+    )
 
 
 def _bart_from_hf_config(cfg: dict) -> BartConfig:
@@ -201,7 +226,12 @@ def _mixtral_from_hf_config(cfg: dict) -> LlamaConfig:
         base,
         num_experts=cfg.get("num_local_experts", 8),
         num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
-        moe_aux_weight=cfg.get("router_aux_loss_coef", 0.02),
+        # HF MixtralConfig default; a larger fallback would silently apply
+        # stronger load-balance pressure than the same checkpoint under HF
+        moe_aux_weight=cfg.get("router_aux_loss_coef", 0.001),
+        # HF routes densely (no capacity limit): <=0 = no-drop everywhere,
+        # so converted checkpoints reproduce HF logits on every path
+        moe_capacity_factor=-1.0,
     )
 
 

@@ -68,10 +68,13 @@ class MoEMLP(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, no_drop: bool = False) -> jnp.ndarray:
-        """``no_drop=True`` (inference/decode) sizes capacity so NO token
-        can overflow (capacity = group size): converted checkpoints then
-        reproduce HF Mixtral logits exactly, at the price of a larger
-        dispatch tensor — acceptable off the training path."""
+        """``no_drop=True`` (cached decode/prefill) sizes capacity so NO
+        token can overflow (capacity = group size).  ``capacity_factor <= 0``
+        makes the layer no-drop on EVERY path, including teacher-forced
+        scoring and fine-tuning — HF Mixtral routes densely with no
+        capacity limit, so converted checkpoints load with that setting
+        (registry) to reproduce HF logits exactly everywhere, at the price
+        of a larger dispatch tensor."""
         b, s, d = x.shape
         E, K = self.num_experts, self.top_k
         n = b * s
@@ -84,6 +87,7 @@ class MoEMLP(nn.Module):
         tokens = tokens.reshape(G, g, d)
         # pad tokens are excluded from routing (they claim no capacity)
         valid = (jnp.arange(G * g) < n).astype(jnp.float32).reshape(G, g)
+        no_drop = no_drop or self.capacity_factor <= 0
         capacity = g if no_drop else max(1, math.ceil(K * g / E * self.capacity_factor))
 
         router = nn.Dense(E, use_bias=False, dtype=jnp.float32, name="router")

@@ -204,9 +204,13 @@ class Trainer:
                 self.state, self.start_step = restored
                 log_json({"event": "resumed", "step": self.start_step})
 
+        # Eval always uses the STANDARD (per-layer) module: under pipeline
+        # parallelism evaluate() unstacks the stacked blocks first (layer
+        # params then live replicated across stage groups for the eval pass
+        # — generation needs the KV-cache path the pipeline adapter lacks).
         self.evaluator = (
             Evaluator(
-                self.model,
+                self.loaded.module,
                 self.config,
                 self.tokenizer,
                 self.mesh,
@@ -214,16 +218,9 @@ class Trainer:
                 max_new_tokens=cfg.eval_max_new_tokens,
                 is_seq2seq=self.loaded.is_seq2seq,
             )
-            if self.val_ds and not self.pipelined
+            if self.val_ds
             else None
         )
-        if self.pipelined and self.val_ds:
-            log_json({
-                "event": "eval_disabled",
-                "reason": "pipeline (stage>1) is train-only; export writes the "
-                          "standard per-layer layout — run eval from it on a "
-                          "non-stage mesh",
-            })
         self._rng = jax.random.PRNGKey(cfg.shuffle_seed)
 
     # ------------------------------------------------------------------
@@ -231,10 +228,15 @@ class Trainer:
     def evaluate(self, epoch: int | None = None) -> dict[str, float]:
         if self.evaluator is None or self.val_ds is None:
             return {}
+        eval_params = self.state.params
+        if self.pipelined:
+            from distributed_llms_example_tpu.parallel.pipeline import unstack_blocks
+
+            eval_params = unstack_blocks(eval_params)
         eval_batch = self.cfg.eval_batch_size or self.cfg.batch_size
         eval_batch = min(eval_batch, max(jax.process_count(), len(self.val_ds)))
         scores = self.evaluator.run(
-            self.state.params,
+            eval_params,
             self.val_ds,
             global_batch=eval_batch,
             bucket_multiple=self.cfg.pad_to_multiple,

@@ -217,7 +217,8 @@ def test_mixtral_hf_parity():
     cfg = LlamaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
         num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
-        num_experts=4, num_experts_per_tok=2, moe_capacity_factor=16.0,
+        # <=0 = no-drop on every path, the converted-checkpoint setting
+        num_experts=4, num_experts_per_tok=2, moe_capacity_factor=-1.0,
     )
     model = LlamaForCausalLM(cfg)
     params = convert_llama_state_dict(hf_model.state_dict())
@@ -266,6 +267,48 @@ def test_local_mixtral_checkpoint_loads(tmp_path):
     assert lm.config.num_experts_per_tok == 2
     assert lm.config.moe_aux_weight == pytest.approx(0.05)
     assert lm.params is not None and "router" in lm.params["block_0"]["mlp"]
+    ids = np.ones((1, 8), np.int32)
+    logits = lm.module.apply({"params": lm.params}, ids, np.ones_like(ids))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_sharded_safetensors_checkpoint_loads(tmp_path):
+    """Real 7B+/mixtral checkpoints ship as model-0000N-of-000NN.safetensors
+    shards plus an index json — the local loader must reassemble them."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import json
+
+    from safetensors.numpy import save_file
+
+    from distributed_llms_example_tpu.models.registry import load_model
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=2, num_experts_per_tok=2,
+        max_position_embeddings=64, attention_dropout=0.0,
+    )
+    torch.manual_seed(5)
+    sd = {k: v.numpy() for k, v in transformers.MixtralForCausalLM(hf_cfg).state_dict().items()}
+    ckpt = tmp_path / "sharded"
+    ckpt.mkdir()
+    keys = sorted(sd)
+    half = len(keys) // 2
+    shards = {
+        "model-00001-of-00002.safetensors": {k: sd[k] for k in keys[:half]},
+        "model-00002-of-00002.safetensors": {k: sd[k] for k in keys[half:]},
+    }
+    weight_map = {k: shard for shard, kv in shards.items() for k in kv}
+    for shard, kv in shards.items():
+        save_file(kv, ckpt / shard)
+    (ckpt / "model.safetensors.index.json").write_text(json.dumps({"weight_map": weight_map}))
+    (ckpt / "config.json").write_text(json.dumps({**hf_cfg.to_dict(), "model_type": "mixtral"}))
+
+    lm = load_model(str(ckpt))
+    assert lm.params is not None
+    # converted checkpoints default to no-drop routing (HF parity everywhere)
+    assert lm.config.moe_capacity_factor <= 0
     ids = np.ones((1, 8), np.int32)
     logits = lm.module.apply({"params": lm.params}, ids, np.ones_like(ids))
     assert np.isfinite(np.asarray(logits)).all()

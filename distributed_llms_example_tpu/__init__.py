@@ -5,17 +5,18 @@ valohai/distributed-llms-example (reference mounted at /root/reference):
 the reference's three CUDA+NCCL data-parallel fine-tuning paths
 (`train-torchrun.py`, `train-accelerator.py`, `train-task.py`) are
 re-expressed as a single SPMD training core jitted over a
-`jax.sharding.Mesh` with named axes ("data", "fsdp", "sequence",
-"tensor"), Flax model definitions, an Optax optimizer, and XLA
-collectives over ICI/DCN instead of NCCL.
+`jax.sharding.Mesh` with named axes ("stage", "data", "fsdp",
+"sequence", "tensor") — pipeline, data, ZeRO-3, ring-attention context,
+and tensor/expert parallelism respectively — Flax model definitions, an
+Optax optimizer, and XLA collectives over ICI/DCN instead of NCCL.
 
 Package layout (see SURVEY.md section 7 for the build plan):
 
 - ``core``       — config, device mesh, multi-host init, precision policy
 - ``utils``      — pytree helpers, JSON-line metric logging, Valohai facts
-- ``parallel``   — sharding rules, collectives, sequence/ring parallelism
-- ``ops``        — attention (XLA + Pallas flash), norms, activations
-- ``models``     — T5 / BART / LLaMA in flax.linen + HF weight converters
+- ``parallel``   — sharding rules, activation constraints, GPipe pipeline
+- ``ops``        — attention (XLA + Pallas flash + ring), MoE, norms
+- ``models``     — T5 / BART / LLaMA / Mixtral in flax.linen + HF converters
 - ``data``       — tokenizers, JSON datasets, deterministic host sharding
 - ``train``      — the pjit train step, optimizer factory, Trainer
 - ``evaluation`` — jitted greedy/beam generation, ROUGE, metric aggregation

@@ -10,10 +10,12 @@ Replaces the reference's process-group plumbing with the TPU-native pair:
   XLA runtime, after which all communication is compiler-inserted
   collectives over ICI/DCN.
 
-- ``jax.sharding.Mesh`` over named axes ("data", "fsdp", "sequence",
-  "tensor") is the single object that expresses every parallelism strategy;
-  the reference needed three different mechanisms (torchrun env vars,
-  Accelerate, hand-rolled all_reduce) for data parallelism alone.
+- ``jax.sharding.Mesh`` over named axes ("stage", "data", "fsdp",
+  "sequence", "tensor") — pipeline, data, ZeRO-3, ring-attention context,
+  and tensor/expert parallelism respectively — is the single object that
+  expresses every parallelism strategy; the reference needed three
+  different mechanisms (torchrun env vars, Accelerate, hand-rolled
+  all_reduce) for data parallelism alone.
 """
 
 from __future__ import annotations

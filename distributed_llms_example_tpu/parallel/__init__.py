@@ -1,8 +1,25 @@
+from distributed_llms_example_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_blocks,
+    unstack_blocks,
+)
 from distributed_llms_example_tpu.parallel.sharding import (
     ShardingRules,
     batch_sharding,
     infer_param_shardings,
+    pipeline_rules,
     replicated,
+    resolve_shardings,
 )
 
-__all__ = ["ShardingRules", "batch_sharding", "infer_param_shardings", "replicated"]
+__all__ = [
+    "ShardingRules",
+    "batch_sharding",
+    "infer_param_shardings",
+    "pipeline_apply",
+    "pipeline_rules",
+    "replicated",
+    "resolve_shardings",
+    "stack_blocks",
+    "unstack_blocks",
+]

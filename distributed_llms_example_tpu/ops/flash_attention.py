@@ -421,6 +421,29 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+MAX_BLOCK = 512  # measured on v5e: 512-tiles run the fwd+bwd ~2.5x faster
+#                  than 128-tiles at (16, 16, 1024, 64) — bigger tiles
+#                  amortize grid overhead and keep the MXU busier, and a
+#                  512x512 fp32 score tile + operands is still ~1.5 MB VMEM
+
+
+def auto_block(seq_len: int, cap: int = MAX_BLOCK) -> int:
+    """Default tile size when the caller doesn't pin one (0 = not tileable,
+    callers fall back to XLA attention).
+
+    Largest 16-aligned block in [128, cap] dividing ``seq_len`` — 16 is the
+    bf16 sublane tiling (8 would satisfy fp32 only), and below 128 the
+    kv×q grid overhead beats the XLA path the kernel replaces.  Sequences
+    shorter than 128 use one seq-sized tile when 16-aligned."""
+    if seq_len < 128:
+        return seq_len if seq_len >= 16 and seq_len % 16 == 0 else 0
+    start = min(cap, seq_len) // 16 * 16
+    for b in range(start, 127, -16):
+        if seq_len % b == 0:
+            return b
+    return 0
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -429,16 +452,19 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
     dtype: jnp.dtype | None = None,
 ) -> jnp.ndarray:
     """Blockwise-softmax attention; drop-in for ``dot_product_attention``.
 
-    Requires seq lens divisible by the (auto-clamped) block sizes — the
-    framework's bucketed batching guarantees this for training shapes; call
-    ``flash_supported`` first for arbitrary shapes.
+    ``block_q``/``block_k`` default to ``auto_block``: the largest
+    16-aligned tile in [128, 512] dividing each sequence length (one
+    seq-sized tile for short sequences).  Requires seq lens
+    divisible by the (auto-clamped) block sizes — the framework's bucketed
+    batching guarantees this for training shapes; call ``flash_supported``
+    first for arbitrary shapes.
 
     Contract notes (both enforced or documented because this is a public
     drop-in API, not just an internal kernel):
@@ -461,10 +487,12 @@ def flash_attention(
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    block_q = min(block_q, q.shape[2])
-    block_k = min(block_k, k.shape[2])
+    block_q = auto_block(q.shape[2]) if block_q is None else min(block_q, q.shape[2])
+    block_k = auto_block(k.shape[2]) if block_k is None else min(block_k, k.shape[2])
     if (
-        q.shape[2] % block_q
+        not block_q
+        or not block_k
+        or q.shape[2] % block_q
         or k.shape[2] % block_k
         or block_q % 8
         or block_k % 8
@@ -487,11 +515,15 @@ def flash_attention(
 
 
 def flash_supported(q_len: int, kv_len: int, head_dim: int,
-                    block_q: int = 128, block_k: int = 128) -> bool:
-    """True when shapes are flash-eligible (divisible seqs, sane head_dim)."""
-    bq, bk = min(block_q, q_len), min(block_k, kv_len)
+                    block_q: int | None = None, block_k: int | None = None) -> bool:
+    """True when shapes are flash-eligible (divisible seqs, sane head_dim).
+    ``None`` blocks mirror ``flash_attention``'s ``auto_block`` defaults."""
+    bq = auto_block(q_len) if block_q is None else min(block_q, q_len)
+    bk = auto_block(kv_len) if block_k is None else min(block_k, kv_len)
     return (
-        q_len % bq == 0
+        bq > 0
+        and bk > 0
+        and q_len % bq == 0
         and kv_len % bk == 0
         and bq % 8 == 0  # TPU sublane alignment
         and bk % 8 == 0

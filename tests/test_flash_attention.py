@@ -127,3 +127,31 @@ def test_rejects_bad_shapes():
     q, k, v = _qkv(100, 100)
     with pytest.raises(ValueError):
         flash_attention(q, k, v)
+
+
+def test_auto_block_selection():
+    """Largest 8-aligned divisor ≤ 512 — big tiles for the bench shapes,
+    graceful degradation for odd-but-divisible lengths."""
+    from distributed_llms_example_tpu.ops.flash_attention import auto_block, flash_supported
+
+    assert auto_block(1024) == 512
+    assert auto_block(512) == 512
+    assert auto_block(128) == 128
+    assert auto_block(640) == 320  # not divisible by 512; previously 128-tiled
+    assert auto_block(64) == 64  # short sequence: one seq-sized tile
+    assert auto_block(136) == 0  # no 16-aligned divisor ≥ 128 → XLA fallback
+    assert auto_block(1048) == 0  # 8*131: tiny tiles would drown in grid overhead
+    assert auto_block(100) == 0
+    assert auto_block(7) == 0
+    assert flash_supported(640, 640, 64)
+    assert not flash_supported(7, 7, 64)
+    assert not flash_supported(1048, 1048, 64)
+
+
+def test_parity_non_pow2_length():
+    """Auto-blocked parity at a length divisible by neither 128 nor 512."""
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(2, 2, 320, 16).astype(np.float32)) for _ in range(3))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = dot_product_attention(q, k, v, make_causal_bias(320, 320))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

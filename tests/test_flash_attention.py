@@ -130,7 +130,7 @@ def test_rejects_bad_shapes():
 
 
 def test_auto_block_selection():
-    """Largest 8-aligned divisor ≤ 512 — big tiles for the bench shapes,
+    """Largest 16-aligned divisor in [128, 512] — big tiles for the bench shapes,
     graceful degradation for odd-but-divisible lengths."""
     from distributed_llms_example_tpu.ops.flash_attention import auto_block, flash_supported
 

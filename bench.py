@@ -143,7 +143,11 @@ def _flagship():
             import dataclasses
 
             lm = dataclasses.replace(
-                lm, module=type(lm.module)(lm.config, dtype=jax.numpy.bfloat16, remat=True)
+                lm,
+                module=type(lm.module)(
+                    lm.config, dtype=jax.numpy.bfloat16, remat=True,
+                    remat_policy=os.environ.get("BENCH_REMAT_POLICY", "full"),
+                ),
             )
         return name, lm, remat
     raise SystemExit("no benchmarkable model in registry")

@@ -106,6 +106,7 @@ class TrainConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: bool = False  # jax.checkpoint the transformer blocks
+    remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
     # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
     # bubble fraction is (stages-1)/(microbatches+stages-1)
     pipeline_microbatches: int = 0
@@ -140,6 +141,10 @@ class TrainConfig:
 
 
 # Single source of defaults for the CLI layer: the dataclass itself.
+# remat policy names; utils/remat.py asserts its POLICIES registry matches
+# (kept here so config stays importable without jax/flax)
+REMAT_POLICIES = ("full", "dots")
+
 _D = TrainConfig()
 
 
@@ -163,6 +168,7 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--param-dtype", type=str, default=_D.param_dtype)
     p.add_argument("--compute-dtype", type=str, default=_D.compute_dtype)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)
     p.add_argument("--num-beams", type=int, default=_D.num_beams)
     p.add_argument("--log-every-steps", type=int, default=_D.log_every_steps)

@@ -18,6 +18,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_llms_example_tpu.ops.attention import mask_to_bias
+from distributed_llms_example_tpu.utils.remat import remat_block
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.norms import LayerNorm
 from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
@@ -148,6 +149,7 @@ class BartForConditionalGeneration(nn.Module):
     config: BartConfig
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
+    remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
 
     def setup(self) -> None:
         cfg = self.config
@@ -166,8 +168,8 @@ class BartForConditionalGeneration(nn.Module):
         self.decoder_layernorm_embedding = LayerNorm(
             cfg.layer_norm_epsilon, self.dtype, name="decoder_layernorm_embedding"
         )
-        enc_layer = nn.remat(BartEncoderLayer, static_argnums=(3,)) if self.remat else BartEncoderLayer
-        dec_layer = nn.remat(BartDecoderLayer, static_argnums=(5, 6)) if self.remat else BartDecoderLayer
+        enc_layer = remat_block(BartEncoderLayer, (3,), self.remat_policy) if self.remat else BartEncoderLayer
+        dec_layer = remat_block(BartDecoderLayer, (5, 6), self.remat_policy) if self.remat else BartDecoderLayer
         self.encoder_blocks = [
             enc_layer(cfg, dtype=self.dtype, name=f"encoder_block_{i}") for i in range(cfg.encoder_layers)
         ]

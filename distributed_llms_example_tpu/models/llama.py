@@ -20,6 +20,7 @@ from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.moe import MoEMLP
 from distributed_llms_example_tpu.ops.norms import RMSNorm
 from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
+from distributed_llms_example_tpu.utils.remat import remat_block
 
 
 @dataclasses.dataclass(frozen=True)
@@ -198,12 +199,13 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
+    remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
 
     def setup(self) -> None:
         cfg = self.config
         self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, name="embed_tokens")
         # static args: deterministic (3), use_cache (4) — counting self at 0
-        block = nn.remat(LlamaBlock, static_argnums=(3, 4)) if self.remat else LlamaBlock
+        block = remat_block(LlamaBlock, (3, 4), self.remat_policy) if self.remat else LlamaBlock
         self.blocks = [block(cfg, dtype=self.dtype, name=f"block_{i}") for i in range(cfg.num_hidden_layers)]
         self.final_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="final_norm")
         self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")

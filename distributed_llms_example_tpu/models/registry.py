@@ -207,15 +207,16 @@ def _llama_from_hf_config(cfg: dict) -> LlamaConfig:
     )
 
 
-def _build(family: str, cfg: Any, dtype: jnp.dtype, remat: bool, params: Any = None) -> LoadedModel:
+def _build(family: str, cfg: Any, dtype: jnp.dtype, remat: bool, params: Any = None,
+           remat_policy: str = "full") -> LoadedModel:
     if family == "t5":
-        module = T5ForConditionalGeneration(cfg, dtype=dtype, remat=remat)
+        module = T5ForConditionalGeneration(cfg, dtype=dtype, remat=remat, remat_policy=remat_policy)
         return LoadedModel("t5", cfg, module, params, is_seq2seq=True)
     if family == "bart":
-        module = BartForConditionalGeneration(cfg, dtype=dtype, remat=remat)
+        module = BartForConditionalGeneration(cfg, dtype=dtype, remat=remat, remat_policy=remat_policy)
         return LoadedModel("bart", cfg, module, params, is_seq2seq=True)
     if family in ("llama", "mixtral"):  # mixtral = llama blocks + MoE MLP
-        module = LlamaForCausalLM(cfg, dtype=dtype, remat=remat)
+        module = LlamaForCausalLM(cfg, dtype=dtype, remat=remat, remat_policy=remat_policy)
         return LoadedModel("llama", cfg, module, params, is_seq2seq=False)
     raise ValueError(f"unsupported model family {family!r}")
 
@@ -248,6 +249,7 @@ def load_model(
     *,
     dtype: jnp.dtype = jnp.float32,
     remat: bool = False,
+    remat_policy: str = "full",
     load_weights: bool = True,
     attention_impl: str | None = None,
 ) -> LoadedModel:
@@ -279,15 +281,15 @@ def load_model(
         if load_weights:
             params = convert_state_dict(model_type, _load_local_state_dict(name_or_path))
             params = jax.tree.map(jnp.asarray, params)
-        return _build(model_type, cfg, dtype, remat, params)
+        return _build(model_type, cfg, dtype, remat, params, remat_policy=remat_policy)
     # short names: strip org prefixes like "google/" or "facebook/"
     short = name_or_path.rsplit("/", 1)[-1]
     if short in T5_CONFIGS:
-        return _build("t5", _apply_impl(T5_CONFIGS[short]), dtype, remat)
+        return _build("t5", _apply_impl(T5_CONFIGS[short]), dtype, remat, remat_policy=remat_policy)
     if short in BART_CONFIGS:
-        return _build("bart", _apply_impl(BART_CONFIGS[short]), dtype, remat)
+        return _build("bart", _apply_impl(BART_CONFIGS[short]), dtype, remat, remat_policy=remat_policy)
     if short in LLAMA_CONFIGS:
-        return _build("llama", _apply_impl(LLAMA_CONFIGS[short]), dtype, remat)
+        return _build("llama", _apply_impl(LLAMA_CONFIGS[short]), dtype, remat, remat_policy=remat_policy)
     known = sorted(T5_CONFIGS) + sorted(BART_CONFIGS) + sorted(LLAMA_CONFIGS)
     raise ValueError(
         f"unknown model {name_or_path!r}: not a local checkpoint dir and not one of {known}"

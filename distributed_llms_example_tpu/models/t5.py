@@ -32,6 +32,7 @@ from distributed_llms_example_tpu.ops.attention import (
     mask_to_bias,
 )
 from distributed_llms_example_tpu.ops.norms import RMSNorm
+from distributed_llms_example_tpu.utils.remat import remat_block
 from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
 
 
@@ -224,6 +225,7 @@ class T5Stack(nn.Module):
     causal: bool = False  # True → decoder (causal self-attn + cross-attn)
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
+    remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
 
     def setup(self) -> None:
         cfg = self.config
@@ -236,7 +238,7 @@ class T5Stack(nn.Module):
         )
         block = T5Block
         if self.remat:
-            block = nn.remat(T5Block, static_argnums=(5, 6))
+            block = remat_block(T5Block, (5, 6), self.remat_policy)
         self.blocks = [
             block(cfg, causal=self.causal, has_cross=self.causal, dtype=self.dtype, name=f"block_{i}")
             for i in range(n)
@@ -299,6 +301,7 @@ class T5ForConditionalGeneration(nn.Module):
     config: T5Config
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
+    remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
 
     def setup(self) -> None:
         cfg = self.config
@@ -309,8 +312,10 @@ class T5ForConditionalGeneration(nn.Module):
             dtype=self.dtype,
             name="shared",
         )
-        self.encoder = T5Stack(cfg, causal=False, dtype=self.dtype, remat=self.remat, name="encoder")
-        self.decoder = T5Stack(cfg, causal=True, dtype=self.dtype, remat=self.remat, name="decoder")
+        self.encoder = T5Stack(cfg, causal=False, dtype=self.dtype, remat=self.remat,
+                               remat_policy=self.remat_policy, name="encoder")
+        self.decoder = T5Stack(cfg, causal=True, dtype=self.dtype, remat=self.remat,
+                               remat_policy=self.remat_policy, name="decoder")
         if not cfg.tie_word_embeddings:
             self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")
 

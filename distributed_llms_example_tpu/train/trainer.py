@@ -66,7 +66,9 @@ class Trainer:
 
         self.tokenizer = get_tokenizer(cfg.tokenizer, cfg.model_ckpt)
         compute_dtype = parse_dtype(cfg.compute_dtype)
-        self.loaded = load_model(cfg.model_ckpt, dtype=compute_dtype, remat=cfg.remat)
+        self.loaded = load_model(
+            cfg.model_ckpt, dtype=compute_dtype, remat=cfg.remat, remat_policy=cfg.remat_policy
+        )
         self.model, self.config = self.loaded.module, self.loaded.config
 
         if self.loaded.is_seq2seq:

@@ -109,6 +109,7 @@ def audit_train_step_memory(
     tgt_len: int = 128,
     dtype: str = "bfloat16",
     remat: bool = True,
+    remat_policy: str = "full",
     grad_accum_steps: int = 1,
     compile: bool = True,
 ) -> dict:
@@ -155,7 +156,10 @@ def audit_train_step_memory(
                 for k, v in sizes.items()
             }
         mesh = jax.sharding.AbstractMesh(tuple(sizes.values()), tuple(sizes.keys()))
-    lm = load_model(model_name, dtype=parse_dtype(dtype), remat=remat, load_weights=False)
+    lm = load_model(
+        model_name, dtype=parse_dtype(dtype), remat=remat, load_weights=False,
+        remat_policy=remat_policy,
+    )
     tx, schedule = make_optimizer(total_steps=1000)
 
     # abstract everything: eval_shape traces without allocating
@@ -240,6 +244,11 @@ def audit_train_step_memory(
         "tgt_len": tgt_len,
         "dtype": dtype,
         "remat": remat,
+        "remat_policy": remat_policy,
+        # the analytic activation model assumes policy="full" (block-boundary
+        # saves only); "dots" additionally saves matmul outputs, so analytic
+        # figures UNDER-estimate it — trust the compiled stats for dots
+        "analytic_assumes_full_remat": remat_policy != "full",
         "params": n_params,
         "backend": backend,
         "analytic_state_bytes": state_b,
@@ -277,6 +286,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--tgt-len", type=int, default=128)
     p.add_argument("--dtype", type=str, default="bfloat16")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", type=str, default="full")
     p.add_argument("--grad-accum-steps", type=int, default=1)
     p.add_argument(
         "--analytic",
@@ -293,6 +303,7 @@ def main(argv: list[str] | None = None) -> int:
         tgt_len=args.tgt_len,
         dtype=args.dtype,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         grad_accum_steps=args.grad_accum_steps,
         compile=not args.analytic,
     )

@@ -172,3 +172,36 @@ def test_dropout_step_runs(mesh8, setup):
     step, _ = build(state)
     state, metrics = step(state, put_batch(_toy_batch(), mesh8), jax.random.PRNGKey(3))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_remat_policies_match_no_remat(mesh8):
+    """Remat never changes math — 'full' and 'dots' policies must produce
+    the identical loss as no remat at all."""
+    import optax
+
+    losses = {}
+    batch = _toy_batch(b=8)
+    for policy in (None, "full", "dots"):
+        lm = load_model(
+            "llama-test",
+            remat=policy is not None,
+            remat_policy=policy or "full",
+        )
+        tx = optax.sgd(1e-2)
+        build = make_train_step(
+            lm.module, lm.config, tx, lambda s: 1e-2, mesh8, donate=False, is_seq2seq=False
+        )
+        params = jax.device_get(lm.init_params(0))
+        state = create_train_state(shard_params(params, mesh8), tx)
+        sh = state_shardings(state, mesh8)
+        state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+        step, _ = build(state)
+        cb = {
+            "input_ids": batch["input_ids"],
+            "attention_mask": batch["attention_mask"],
+            "labels": batch["input_ids"],
+        }
+        _, metrics = step(state, put_batch(cb, mesh8))
+        losses[policy] = float(metrics["loss"])
+    assert losses["full"] == pytest.approx(losses[None], rel=1e-6)
+    assert losses["dots"] == pytest.approx(losses[None], rel=1e-6)

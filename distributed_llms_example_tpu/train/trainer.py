@@ -259,7 +259,70 @@ class Trainer:
             tokens += int(np.sum(batch["labels"] != LABEL_PAD))
         return tokens
 
+    def _install_preemption_handler(self) -> None:
+        """SIGTERM/SIGINT → finish the in-flight step, checkpoint, exit
+        cleanly.  TPU pods get preempted; the reference's answer is losing
+        the run (its only save is end-of-training).  With this handler plus
+        resume, a preempted execution restarts where it stopped.  No-op
+        outside the main thread (signal module restriction)."""
+        import signal
+
+        self._preempted = False
+        self._prev_handlers = {}
+
+        def on_signal(signum, frame):
+            self._preempted = True
+            log_json({"event": "preemption_signal", "signal": int(signum)})
+            # one graceful chance: restore the previous handler so a SECOND
+            # signal terminates (a hung collective can't be flag-broken)
+            prev = self._prev_handlers.get(signum)
+            if prev is not None:
+                try:
+                    signal.signal(signum, prev)
+                except (ValueError, TypeError):
+                    pass
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = signal.signal(sig, on_signal)
+            except ValueError:  # not the main thread
+                return
+
+    def _restore_signal_handlers(self) -> None:
+        import signal
+
+        for sig, handler in getattr(self, "_prev_handlers", {}).items():
+            try:
+                signal.signal(sig, handler)
+            except ValueError:
+                pass
+
+    def _preemption_agreed(self) -> bool:
+        """Multi-host: every process must take the same branch at the same
+        step — a host-local flag would leave host A saving while host B
+        issues the next step's collectives (pod-wide deadlock).  All hosts
+        agree via an allgather of the local flag (any host signaled →
+        everyone stops).  Single-process: just the flag."""
+        if jax.process_count() == 1:
+            return self._preempted
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(np.asarray([self._preempted]))
+        return bool(np.asarray(flags).any())
+
     def train(self) -> dict[str, Any]:
+        # handlers restored in a finally: a raising train step must not
+        # leave the flag-setting handler installed process-wide (it would
+        # swallow Ctrl-C forever after); on the preempted path the finally
+        # runs AFTER the graceful checkpoint, so a second SIGTERM during
+        # the save terminates instead of being silently re-flagged
+        self._install_preemption_handler()
+        try:
+            return self._train_loop()
+        finally:
+            self._restore_signal_handlers()
+
+    def _train_loop(self) -> dict[str, Any]:
         cfg = self.cfg
         logger = MetricLogger(every=cfg.log_every_steps)
         step = self.start_step
@@ -312,10 +375,15 @@ class Trainer:
                         self.checkpointer.save(step, self.state)
                     if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
                         last_eval = self.evaluate(epoch)
+                    if self._preemption_agreed():
+                        self._preempted = True  # agreed across hosts
+                        break
             finally:
                 # stop the producer thread even when the loop body raises
                 if isinstance(epoch_batches, Prefetcher):
                     epoch_batches.close()
+            if self._preempted:
+                break
             last_eval = self.evaluate(epoch)  # per-epoch eval, reference parity
         if profiling_active:
             # training ended inside the trace window — close it so the trace
@@ -323,6 +391,16 @@ class Trainer:
             jax.block_until_ready(metrics["loss"])
             jax.profiler.stop_trace()
             log_json({"event": "profile_trace", "dir": cfg.profile_dir, "truncated": True})
+        if self._preempted:
+            # save where we stopped and get out; resume restarts from here
+            self.checkpointer.save(step, self.state, force=True)
+            self.checkpointer.wait()
+            wall = time.perf_counter() - t0
+            log_json({"event": "preempted", "step": step, "wall_seconds": wall})
+            return {
+                "steps": step, "wall_seconds": wall, "final_eval": last_eval,
+                "preempted": True,
+            }
         self.checkpointer.save(self.total_steps, self.state, force=True)
         self.checkpointer.wait()
         self.save_final()

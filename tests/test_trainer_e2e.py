@@ -130,3 +130,61 @@ def test_cli_requires_train_file():
 
     with pytest.raises(SystemExit, match="train-file"):
         main(["--model-ckpt", "t5-test"])
+
+
+def test_preemption_checkpoints_and_resumes(tmp_path):
+    """SIGTERM mid-training → trainer finishes the in-flight step, saves a
+    checkpoint, returns preempted=True; a fresh Trainer resumes from that
+    step and completes the run.  The reference loses the whole run on
+    preemption (only saves at the very end)."""
+    import signal
+
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model_ckpt="t5-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=2,
+        warmup_steps=0,
+        evaluation_steps=0,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        num_beams=1,
+        log_every_steps=100,
+        mesh=MeshConfig(data=2, fsdp=2, sequence=1, tensor=2),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=True, async_save=False),
+        tokenizer="byte",
+    )
+    records = _records()
+    handler_before = signal.getsignal(signal.SIGTERM)
+
+    trainer = Trainer(cfg, train_records=records)
+    total = trainer.total_steps
+    assert total == 8
+    # deliver a real SIGTERM (to ourselves) during step 3's bookkeeping
+    orig = trainer._batch_tokens
+    seen = []
+
+    def hook(batch):
+        seen.append(1)
+        if len(seen) == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return orig(batch)
+
+    trainer._batch_tokens = hook
+    result = trainer.train()
+    assert result.get("preempted") is True
+    assert result["steps"] == 3
+    # handler restored to exactly what was installed before the Trainer
+    assert signal.getsignal(signal.SIGTERM) is handler_before
+    # no final model export on preemption
+    assert not os.path.isdir(os.path.join(str(tmp_path), "model", "params"))
+
+    resumed = Trainer(cfg, train_records=records)
+    assert resumed.start_step == 3
+    result2 = resumed.train()
+    assert result2.get("preempted") is None
+    assert result2["steps"] == total
+    assert os.path.isdir(os.path.join(str(tmp_path), "model", "params"))

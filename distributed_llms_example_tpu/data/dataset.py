@@ -248,4 +248,6 @@ def iter_global_batches(
         yield order[s * global_batch : (s + 1) * global_batch]
     if rem and not drop_last:
         tail = order[steps * global_batch :]
-        yield np.concatenate([tail, order[: global_batch - rem]])
+        # np.resize cycles the order, so the batch is exactly global_batch
+        # even when the corpus itself is smaller than one batch
+        yield np.concatenate([tail, np.resize(order, global_batch - rem)])

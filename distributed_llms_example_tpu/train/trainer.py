@@ -67,7 +67,8 @@ class Trainer:
         self.tokenizer = get_tokenizer(cfg.tokenizer, cfg.model_ckpt)
         compute_dtype = parse_dtype(cfg.compute_dtype)
         self.loaded = load_model(
-            cfg.model_ckpt, dtype=compute_dtype, remat=cfg.remat, remat_policy=cfg.remat_policy
+            cfg.model_ckpt, dtype=compute_dtype, remat=cfg.remat, remat_policy=cfg.remat_policy,
+            moe_capacity_factor=cfg.moe_capacity_factor,
         )
         self.model, self.config = self.loaded.module, self.loaded.config
 
@@ -236,7 +237,12 @@ class Trainer:
 
             eval_params = unstack_blocks(eval_params)
         eval_batch = self.cfg.eval_batch_size or self.cfg.batch_size
-        eval_batch = min(eval_batch, max(jax.process_count(), len(self.val_ds)))
+        pc = jax.process_count()
+        eval_batch = min(eval_batch, max(pc, len(self.val_ds)))
+        # host_batch_slices requires divisibility by process count; a tiny
+        # val set (e.g. 3 examples, 2 processes) would otherwise crash
+        # mid-eval after the clamp above
+        eval_batch = max(pc, eval_batch - eval_batch % pc)
         scores = self.evaluator.run(
             eval_params,
             self.val_ds,
@@ -310,6 +316,21 @@ class Trainer:
         flags = multihost_utils.process_allgather(np.asarray([self._preempted]))
         return bool(np.asarray(flags).any())
 
+    def _check_preemption(self, step: int) -> bool:
+        """Preemption check for the step loop.  Single-process: the local
+        flag, every step (free).  Multi-host: the allgather only at a
+        bounded cadence (every ``log_every_steps``) — a per-step blocking
+        host collective would serialize JAX's async dispatch and put a DCN
+        round-trip on every step's critical path.  The step counter is
+        identical on all hosts, so they always enter the allgather
+        together; a SIGTERM is acted on at most ``log_every_steps`` steps
+        late, well inside any preemption grace period (tens of seconds)."""
+        if jax.process_count() == 1:
+            return self._preempted
+        if step % self._preempt_sync_every != 0:
+            return False
+        return self._preemption_agreed()
+
     def train(self) -> dict[str, Any]:
         # handlers restored in a finally: a raising train step must not
         # leave the flag-setting handler installed process-wide (it would
@@ -325,6 +346,7 @@ class Trainer:
     def _train_loop(self) -> dict[str, Any]:
         cfg = self.cfg
         logger = MetricLogger(every=cfg.log_every_steps)
+        self._preempt_sync_every = max(1, cfg.log_every_steps)
         step = self.start_step
         t0 = time.perf_counter()
         last_eval: dict[str, float] = {}
@@ -375,13 +397,22 @@ class Trainer:
                         self.checkpointer.save(step, self.state)
                     if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
                         last_eval = self.evaluate(epoch)
-                    if self._preemption_agreed():
+                    if self._check_preemption(step):
                         self._preempted = True  # agreed across hosts
                         break
             finally:
                 # stop the producer thread even when the loop body raises
                 if isinstance(epoch_batches, Prefetcher):
                     epoch_batches.close()
+            # Epoch boundary: a SIGTERM that landed between sync steps may
+            # have set only the LOCAL flag (the cadence check above skipped
+            # it) — acting on it here un-agreed would desynchronize the
+            # pod (this host saves/exits while peers enter eval's
+            # collectives).  Every host reaches this point at the same
+            # step, so an unconditional agreement round is collectively
+            # safe; mid-epoch agreed breaks re-agree here (still true).
+            if jax.process_count() > 1:
+                self._preempted = self._preemption_agreed()
             if self._preempted:
                 break
             last_eval = self.evaluate(epoch)  # per-epoch eval, reference parity

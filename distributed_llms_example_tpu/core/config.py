@@ -110,6 +110,10 @@ class TrainConfig:
     # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
     # bubble fraction is (stages-1)/(microbatches+stages-1)
     pipeline_microbatches: int = 0
+    # MoE expert capacity override for fine-tuning (None = keep the model's
+    # own setting; HF-converted Mixtral defaults to no-drop, which is exact
+    # but memory-hungry — 1.25 restores the capacity trade for training)
+    moe_capacity_factor: float | None = None
 
     # --- eval/generation (reference live path: beams=2, max_length=128,
     #     train-accelerator.py:239-242) ---
@@ -170,6 +174,7 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--remat", action="store_true")
     p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)
+    p.add_argument("--moe-capacity-factor", type=float, default=_D.moe_capacity_factor)
     p.add_argument("--num-beams", type=int, default=_D.num_beams)
     p.add_argument("--log-every-steps", type=int, default=_D.log_every_steps)
     p.add_argument("--tokenizer", type=str, default=_D.tokenizer)

@@ -103,25 +103,74 @@ class BatchIterator:
         return steps + (1 if rem and not self.drop_last else 0)
 
     def epoch(self, epoch: int) -> Iterator[dict[str, np.ndarray]]:
-        pad_id = self.ds.tokenizer.pad_id
-        for global_idx in iter_global_batches(
-            len(self.ds),
-            self.global_batch,
-            seed=self.seed,
-            epoch=epoch,
-            shuffle=self.shuffle,
-            drop_last=self.drop_last,
-        ):
-            # bucket from the GLOBAL batch (shape agreement across hosts)...
-            widths = [len(self.ds[int(i)].input_ids) for i in global_idx]
-            tgt_widths = [len(self.ds[int(i)].labels) for i in global_idx]
-            src_w = bucket_len(max(widths), self.bucket_multiple, self.max_source_length)
-            tgt_w = bucket_len(
-                max(tgt_widths), min(self.bucket_multiple, self.max_target_length), self.max_target_length
+        """Iterator over the host's batches for one epoch.
+
+        Multi-host: an eager pass (on the caller's thread, NOT under the
+        prefetcher) tokenizes the host's 1/P slice to get per-batch length
+        maxima, then ONE ``process_allgather`` per epoch agrees on bucket
+        widths.  Round 2 computed widths from the *global* index list,
+        which tokenized the entire corpus on every host (the per-rank
+        duplication SURVEY.md §7 hard-part 3 warns about); now each host
+        touches only its own slice.  The agreement collective runs on the
+        main thread at the epoch boundary, never on the prefetch thread
+        (background-thread collectives could interleave differently across
+        hosts and deadlock the runtime) and never on the step critical
+        path.  Single-process: widths come lazily per batch (no agreement
+        needed), so first-epoch tokenization overlaps device steps under
+        the prefetcher."""
+        batches = list(
+            iter_global_batches(
+                len(self.ds),
+                self.global_batch,
+                seed=self.seed,
+                epoch=epoch,
+                shuffle=self.shuffle,
+                drop_last=self.drop_last,
             )
-            # ...materialize only the host-local slice
-            local_idx = global_idx[self._slice]
-            ex = [self.ds[int(i)] for i in local_idx]
+        )
+        import jax
+
+        if self.process_count > 1 and jax.process_count() > 1:
+            # Real multi-host: eager local maxima (tokenizes only this
+            # host's 1/P slice; memoized in the dataset so the cost is
+            # once per run), then ONE agreement allgather per epoch on the
+            # caller's thread.
+            maxima = np.zeros((len(batches), 2), np.int32)
+            for s, global_idx in enumerate(batches):
+                ex = [self.ds[int(i)] for i in global_idx[self._slice]]
+                maxima[s, 0] = max(len(e.input_ids) for e in ex)
+                maxima[s, 1] = max(len(e.labels) for e in ex)
+            from jax.experimental import multihost_utils
+
+            gathered = np.asarray(multihost_utils.process_allgather(maxima))
+            maxima = np.max(gathered.reshape(-1, *maxima.shape), axis=0)
+            return self._iter_batches(batches, iter(maxima))
+        # Single process needs no cross-host agreement: stay LAZY so
+        # first-epoch tokenization overlaps device steps under the
+        # prefetcher instead of serializing at epoch start.  Simulated
+        # multi-host (tests build P iterators in ONE process and drain
+        # them sequentially) has no peers to gather from: scan the global
+        # index list per batch — same widths, test-only cost.
+        rows = slice(None) if self.process_count > 1 else self._slice
+        maxima_lazy = (
+            (
+                max(len(self.ds[int(i)].input_ids) for i in global_idx[rows]),
+                max(len(self.ds[int(i)].labels) for i in global_idx[rows]),
+            )
+            for global_idx in batches
+        )
+        return self._iter_batches(batches, maxima_lazy)
+
+    def _iter_batches(
+        self, batches: list[np.ndarray], maxima: Iterator[tuple[int, int]]
+    ) -> Iterator[dict[str, np.ndarray]]:
+        pad_id = self.ds.tokenizer.pad_id
+        for global_idx, (src_max, tgt_max) in zip(batches, maxima):
+            src_w = bucket_len(int(src_max), self.bucket_multiple, self.max_source_length)
+            tgt_w = bucket_len(
+                int(tgt_max), min(self.bucket_multiple, self.max_target_length), self.max_target_length
+            )
+            ex = [self.ds[int(i)] for i in global_idx[self._slice]]
             input_ids = pad_2d([e.input_ids for e in ex], src_w, pad_id)
             attention_mask = np.zeros_like(input_ids)
             for i, e in enumerate(ex):

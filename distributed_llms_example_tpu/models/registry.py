@@ -252,6 +252,7 @@ def load_model(
     remat_policy: str = "full",
     load_weights: bool = True,
     attention_impl: str | None = None,
+    moe_capacity_factor: float | None = None,
 ) -> LoadedModel:
     """Resolve a model name or local HF checkpoint dir into a LoadedModel.
 
@@ -259,6 +260,14 @@ def load_model(
     "flash" / "xla", see ops/mha.py) for families that support it; T5 keeps
     XLA attention (its learned relative-position bias would get a silent
     zero gradient from the flash kernel).
+
+    ``moe_capacity_factor`` overrides the MoE expert capacity factor for
+    models that have experts.  HF-converted Mixtral checkpoints default to
+    no-drop routing (<= 0) for exact logit parity with HF, but no-drop
+    sizes the dispatch tensors at capacity = group_size — a memory cliff
+    at fine-tune batch/length.  Passing e.g. 1.25 here restores the
+    standard capacity-factor trade for training while leaving parity
+    evals (which load without the override) exact.
     """
     if attention_impl not in (None, "auto", "flash", "ring", "xla"):
         raise ValueError(
@@ -267,7 +276,12 @@ def load_model(
 
     def _apply_impl(cfg):
         if attention_impl is not None and hasattr(cfg, "attention_impl"):
-            return dataclasses.replace(cfg, attention_impl=attention_impl)
+            cfg = dataclasses.replace(cfg, attention_impl=attention_impl)
+        if (
+            moe_capacity_factor is not None
+            and getattr(cfg, "num_experts", 0) > 0
+        ):
+            cfg = dataclasses.replace(cfg, moe_capacity_factor=moe_capacity_factor)
         return cfg
 
     if os.path.isdir(name_or_path):

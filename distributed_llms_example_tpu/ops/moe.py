@@ -22,10 +22,12 @@ Mixtral-class sparse models.  TPU-first design choices:
   all-to-all over ICI, with zero hand-written collectives.
 - **Router in fp32** — softmax over experts is precision-sensitive, the
   same policy as attention softmax (core/precision.py).
-- The Switch load-balancing auxiliary loss (E · Σ_e fraction_e · prob_e,
-  =1 at uniform routing) is ``sow``-n into the ``losses`` collection;
-  the train step adds it when ``config.moe_aux_weight > 0`` and
-  generation (which never mutates ``losses``) silently discards it.
+- The load-balancing auxiliary loss (E · Σ_e fraction_e · prob_e with
+  all top-k assignments in the fraction — HF Mixtral's
+  ``load_balancing_loss_func``, = top_k at uniform routing) is ``sow``-n
+  into the ``losses`` collection; the train step adds it when
+  ``config.moe_aux_weight > 0`` and generation (which never mutates
+  ``losses``) silently discards it.
 """
 
 from __future__ import annotations
@@ -117,11 +119,17 @@ class MoEMLP(nn.Module):
             dispatch = dispatch + disp_k
             combine = combine + gate_vals[..., k, None, None] * disp_k
 
-        # Switch load-balance loss over REAL tokens: E * Σ_e fraction_e ·
-        # mean-prob_e; top-1 assignments define the fraction, 1.0 at uniform
+        # Load-balance loss over REAL tokens: E * Σ_e fraction_e ·
+        # mean-prob_e, where the fraction counts ALL top-k assignments
+        # (pre-capacity) — exactly HF Mixtral's load_balancing_loss_func,
+        # so a converted checkpoint's router_aux_loss_coef is directly
+        # comparable.  Value is top_k at uniform routing (1.0 for top-1,
+        # the Switch special case).
         n_real = jnp.maximum(jnp.sum(valid), 1.0)
-        top1 = jax.nn.one_hot(expert_idx[..., 0], E, dtype=jnp.float32) * valid[..., None]
-        frac = jnp.sum(top1, axis=(0, 1)) / n_real
+        # ``counts`` already accumulated Σ_k Σ_tokens of the PRE-capacity
+        # (valid-masked) assignment one-hots in the dispatch loop — reuse
+        # it instead of materializing a (G, g, K, E) one-hot again
+        frac = jnp.sum(counts, axis=0) / n_real  # sums to top_k
         mean_prob = jnp.sum(probs * valid[..., None], axis=(0, 1)) / n_real
         aux = E * jnp.sum(frac * mean_prob)
         self.sow(

@@ -93,8 +93,10 @@ def ring_attention(
     ``axis_name`` (``axis_size`` shards, equal blocks).  ``causal=True``
     requires equal global q/kv lengths (top-left alignment, as in
     ``flash_attention``).  ``bias`` is a K-only local block (batch|1, 1, 1,
-    kv_blk); rows that end up fully masked produce zeros (their queries are
-    padding and must be loss-masked by the caller).
+    kv_blk).  Masking uses a finite NEG_INF, so a row whose keys are ALL
+    masked yields a near-uniform average of V, not zeros — such rows are
+    padding queries and the caller must loss-mask them (the train step's
+    label mask does).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -131,7 +133,10 @@ def ring_attention(
             m, l, acc = update((m, l, acc), q, cur_k, cur_v, cur_bias, q_pos, k_pos)
         if nxt is not None:
             kv = nxt
-    out = acc / jnp.where(l == 0.0, 1.0, l)
+    # l >= 1 always: every device applies at least one update (causal skip
+    # never drops the diagonal tile) and the running max makes the max
+    # element contribute exp(0) = 1, so no division guard is needed
+    out = acc / l
     return out.astype(dtype or q.dtype)
 
 

@@ -1,0 +1,230 @@
+"""REAL multi-process integration tests (2 processes × 4 CPU devices).
+
+The reference's distinguishing variant is genuinely multi-machine
+(reference train-task.py:404-430: one process per host, NCCL rendezvous
+over ``tcp://master:1234``).  Every other test in this suite simulates
+multi-host on a single process with 8 virtual devices; these tests spawn
+TWO OS processes that rendezvous through ``jax.distributed.initialize``
+(gloo collectives over localhost) and run the full Trainer CLI end-to-end,
+executing every ``process_count > 1`` branch that is otherwise dead code:
+
+- ``initialize_distributed`` from the VH_* env triple (core/mesh.py)
+- ``put_batch``'s ``make_array_from_process_local_data`` (train/step.py)
+- the per-epoch bucket-width allgather (data/batching.py)
+- cross-host eval row gathering + metric aggregation (evaluation/)
+- the cadenced preemption agreement allgather (train/trainer.py)
+
+Loss parity with a single-process 8-device run of the identical config is
+the correctness oracle: same global batches, same mesh, same shardings —
+the distribution mechanism must be invisible in the math.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = "distributed_llms_example_tpu.launch.cli"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(n_local_devices: int, *, rank: int | None = None,
+               world: int | None = None, port: int | None = None) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_local_devices}"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the axon TPU plugin off
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # never inherit rendezvous facts from an outer context
+    for k in ("VH_MASTER_IP", "VH_WORLD_SIZE", "VH_RANK", "MASTER_ADDR", "WORLD_SIZE", "RANK"):
+        env.pop(k, None)
+    if rank is not None:
+        env["VH_MASTER_IP"] = f"127.0.0.1:{port}"
+        env["VH_WORLD_SIZE"] = str(world)
+        env["VH_RANK"] = str(rank)
+    return env
+
+
+def _cli_args(outdir: str, train: str, val: str, **over) -> list[str]:
+    opts = {
+        "model-ckpt": "t5-test",
+        "output-dir": outdir,
+        "batch-size": 8,
+        "num-epochs": 2,
+        "train-file": train,
+        "val-file": val,
+        "mesh": "data=2,fsdp=2,tensor=2",
+        "compute-dtype": "float32",  # exact loss parity across process layouts
+        "log-every-steps": 1,
+        "num-beams": 1,
+        "eval-max-new-tokens": 8,
+    }
+    opts.update(over)
+    args = [sys.executable, "-m", CLI]
+    for k, v in opts.items():
+        args += [f"--{k}", str(v)]
+    return args
+
+
+def _write_dataset(tmp_path) -> tuple[str, str]:
+    recs = [
+        {
+            "dialogue": f"Speaker A: point {i} about the {i % 7} plan. "
+                        f"Speaker B: noted, we will revisit item {i} tomorrow.",
+            "summary": f"They discuss point {i} and defer it.",
+        }
+        for i in range(48)
+    ]
+    train, val = str(tmp_path / "train.json"), str(tmp_path / "val.json")
+    with open(train, "w") as f:
+        json.dump(recs[:40], f)
+    with open(val, "w") as f:
+        json.dump(recs[40:], f)
+    return train, val
+
+
+def _events(stdout: str) -> list[dict]:
+    out = []
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def _step_losses(events: list[dict]) -> dict[int, float]:
+    return {e["step"]: e["loss"] for e in events if "step" in e and "loss" in e}
+
+
+@pytest.mark.slow
+def test_two_process_loss_parity(tmp_path):
+    """2 procs × 4 devices must reproduce the single-process 8-device run
+    bit-for-bit in batches and to float tolerance in losses/ROUGE."""
+    train, val = _write_dataset(tmp_path)
+
+    single = subprocess.run(
+        _cli_args(str(tmp_path / "single"), train, val),
+        env=_child_env(8), cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert single.returncode == 0, single.stderr[-3000:]
+    ev_single = _events(single.stdout)
+    losses_single = _step_losses(ev_single)
+    assert len(losses_single) == 10  # 40 examples / batch 8 × 2 epochs
+
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            # one SHARED output dir for both ranks: orbax's multi-process
+            # save coordinates through the filesystem (every rank commits
+            # its shards under the same checkpoint dir); per-rank dirs
+            # deadlock its finalize barrier
+            _cli_args(str(tmp_path / "multi"), train, val),
+            env=_child_env(4, rank=r, world=2, port=port),
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        outs.append((p.returncode, out, err))
+    assert all(rc == 0 for rc, _, _ in outs), "\n".join(e[-2000:] for _, _, e in outs)
+
+    ev0 = _events(outs[0][1])
+    report = next(e for e in ev0 if e.get("event") == "device_report")
+    assert report["process_count"] == 2 and report["global_device_count"] == 8
+    losses_multi = _step_losses(ev0)
+    assert sorted(losses_multi) == sorted(losses_single)
+    for s, loss in losses_single.items():
+        assert losses_multi[s] == pytest.approx(loss, rel=2e-4), (
+            f"step {s}: single={loss} multi={losses_multi[s]}"
+        )
+    # eval ran the cross-host row-gather path and agreed on scores
+    eval_single = [e for e in ev_single if e.get("event") == "eval"][-1]
+    eval_multi = [e for e in ev0 if e.get("event") == "eval"][-1]
+    for k in ("rouge1", "rougeL"):
+        assert eval_multi[k] == pytest.approx(eval_single[k], abs=1e-6)
+    # metrics logging is process-0-only: rank 1 must not emit step lines
+    assert not _step_losses(_events(outs[1][1]))
+
+
+@pytest.mark.slow
+def test_two_process_preemption_and_resume(tmp_path):
+    """SIGTERM on ONE rank must stop BOTH at an agreed step (the cadenced
+    allgather), checkpoint, exit cleanly — then a resumed run finishes."""
+    train, val = _write_dataset(tmp_path)
+    outdir = str(tmp_path / "out")  # shared by both ranks (see above)
+    port = _free_port()
+
+    # stderr goes to files: the test reads stdout incrementally, and a
+    # PIPE'd stderr nobody drains (gloo/XLA chatter) could fill and block
+    # the children
+    errs = [open(str(tmp_path / f"err{r}.log"), "w") for r in range(2)]
+
+    def launch(r: int, port_: int, **over) -> subprocess.Popen:
+        return subprocess.Popen(
+            _cli_args(outdir, train, val, **{"evaluation-steps": 0, **over}),
+            env=_child_env(4, rank=r, world=2, port=port_),
+            cwd=REPO, stdout=subprocess.PIPE, stderr=errs[r], text=True,
+        )
+
+    procs = [launch(r, port, **{"num-epochs": 40}) for r in range(2)]
+    # wait until rank 0 has taken a few steps, then SIGTERM rank 0 ONLY
+    buf = []
+    deadline = time.time() + 420
+    while time.time() < deadline:
+        line = procs[0].stdout.readline()
+        if not line:
+            break
+        buf.append(line)
+        if '"step": 3' in line:
+            procs[0].send_signal(signal.SIGTERM)
+            break
+    else:
+        pytest.fail("rank 0 never reached step 3")
+
+    rest0, _ = procs[0].communicate(timeout=420)
+    out1, _ = procs[1].communicate(timeout=420)
+    for f in errs:
+        f.close()
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, open(str(tmp_path / f"err{r}.log")).read()[-3000:]
+    ev0 = _events("".join(buf) + rest0)
+    pre = [e for e in ev0 if e.get("event") == "preempted"]
+    assert pre, "rank 0 did not checkpoint-and-exit on SIGTERM"
+    stopped_at = pre[0]["step"]
+    assert stopped_at >= 3
+    # the agreed-step checkpoint committed (tmp suffix gone = every rank's
+    # shards landed and the finalize barrier passed)
+    assert os.path.isdir(os.path.join(outdir, "checkpoints", str(stopped_at)))
+
+    # resume: fresh pair, same output dirs, larger epoch budget than the
+    # preempted step so the run both resumes and finishes
+    port2 = _free_port()
+    errs = [open(str(tmp_path / f"err2_{r}.log"), "w") for r in range(2)]
+    procs = [launch(r, port2, **{"num-epochs": 4}) for r in range(2)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for f in errs:
+        f.close()
+    assert all(p.returncode == 0 for p in procs), "\n".join(
+        open(str(tmp_path / f"err2_{r}.log")).read()[-2000:] for r in range(2)
+    )
+    ev = _events(outs[0][0])
+    assert any(e.get("event") == "resumed" and e["step"] == stopped_at for e in ev)
+    assert any(e.get("event") == "done" for e in ev)

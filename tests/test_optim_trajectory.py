@@ -1,0 +1,133 @@
+"""Optimizer-TRAJECTORY parity vs a minimal torch training loop.
+
+BASELINE.md's quality target (ROUGE-L parity with the reference's torch
+run) needs real weights, which this environment cannot download.  The
+strongest offline stand-in: on SHARED tiny random weights, run N steps of
+the full optimizer semantics — AdamW 5e-5 (b1 .9, b2 .999, eps 1e-8),
+linear warmup+decay schedule, global-norm clip 1.0, the no-decay split —
+here and in a hand-written torch loop (the reference's loop,
+reference train-accelerator.py:174-205, minus its dead knobs), on the
+SAME batches, and pin the loss curves together.  Single-step logit parity
+(test_bart_parity) catches model bugs; this catches optimizer/schedule/
+clipping semantics drift that would silently change training outcomes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llms_example_tpu.core.config import MeshConfig
+from distributed_llms_example_tpu.core.mesh import build_mesh
+from distributed_llms_example_tpu.models.bart import BartConfig, BartForConditionalGeneration
+from distributed_llms_example_tpu.models.convert import convert_bart_state_dict
+from distributed_llms_example_tpu.models.t5 import shift_right
+from distributed_llms_example_tpu.train.optim import make_optimizer
+from distributed_llms_example_tpu.train.step import create_train_state, make_train_step
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+N_STEPS = 20
+LR, WD, WARMUP, CLIP = 5e-5, 0.01, 3, 1.0
+LABEL_PAD = -100
+
+
+def _pair():
+    hf_cfg = transformers.BartConfig(
+        vocab_size=128, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=96, decoder_ffn_dim=96, max_position_embeddings=64,
+        dropout=0.0, attention_dropout=0.0, activation_dropout=0.0,
+        scale_embedding=True, pad_token_id=1, bos_token_id=0, eos_token_id=2,
+        decoder_start_token_id=2, forced_bos_token_id=0,
+    )
+    torch.manual_seed(7)
+    hf_model = transformers.BartForConditionalGeneration(hf_cfg)
+    hf_model.train()  # dropout rates are all 0 → deterministic anyway
+    cfg = BartConfig(
+        vocab_size=128, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=96, decoder_ffn_dim=96, max_position_embeddings=64,
+        dropout_rate=0.0, scale_embedding=True, forced_bos_token_id=0,
+    )
+    model = BartForConditionalGeneration(cfg)
+    params = convert_bart_state_dict(hf_model.state_dict())
+    return hf_model, model, cfg, params
+
+
+def _batches():
+    rng = np.random.RandomState(42)
+    out = []
+    for _ in range(N_STEPS):
+        ids = rng.randint(4, 128, (8, 12)).astype(np.int32)
+        mask = np.ones((8, 12), np.int32)
+        mask[0, -4:] = 0
+        labels = rng.randint(4, 128, (8, 7)).astype(np.int32)
+        labels[:, -2:] = LABEL_PAD
+        out.append({"input_ids": ids, "attention_mask": mask, "labels": labels})
+    return out
+
+
+def _torch_losses(hf_model) -> list[float]:
+    """The reference loop: param split, AdamW, linear schedule, clip."""
+    decay, no_decay = [], []
+    for p in hf_model.parameters():
+        (decay if p.ndim >= 2 else no_decay).append(p)
+    opt = torch.optim.AdamW(
+        [{"params": decay, "weight_decay": WD}, {"params": no_decay, "weight_decay": 0.0}],
+        lr=LR, betas=(0.9, 0.999), eps=1e-8,
+    )
+    sched = transformers.get_linear_schedule_with_warmup(opt, WARMUP, N_STEPS)
+    ce = torch.nn.CrossEntropyLoss(ignore_index=LABEL_PAD)
+    losses = []
+    for batch in _batches():
+        dec_in = np.asarray(shift_right(batch["labels"], 2, 1))
+        out = hf_model(
+            input_ids=torch.tensor(batch["input_ids"], dtype=torch.long),
+            attention_mask=torch.tensor(batch["attention_mask"], dtype=torch.long),
+            decoder_input_ids=torch.tensor(dec_in, dtype=torch.long),
+        )
+        loss = ce(
+            out.logits.reshape(-1, out.logits.shape[-1]),
+            torch.tensor(batch["labels"], dtype=torch.long).reshape(-1),
+        )
+        opt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(hf_model.parameters(), CLIP)
+        opt.step()
+        sched.step()
+        losses.append(float(loss.detach()))
+    return losses
+
+
+def _ours_losses(model, cfg, params) -> list[float]:
+    mesh = build_mesh(MeshConfig(data=-1))
+    tx, schedule = make_optimizer(
+        learning_rate=LR, weight_decay=WD, warmup_steps=WARMUP,
+        total_steps=N_STEPS, max_grad_norm=CLIP,
+    )
+    state = create_train_state(jax.tree.map(np.asarray, params), tx)
+    build = make_train_step(
+        model, cfg, tx, schedule, mesh, is_seq2seq=True, sequence_sharded=False, donate=False,
+    )
+    step_fn, state_sh = build(state)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_sh)
+    from distributed_llms_example_tpu.train.step import put_batch
+
+    losses = []
+    for batch in _batches():
+        state, metrics = step_fn(state, put_batch(batch, mesh))
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def test_twenty_step_loss_curve_parity():
+    hf_model, model, cfg, params = _pair()
+    ours = _ours_losses(model, cfg, params)
+    ref = _torch_losses(hf_model)
+    # step 0 is pure forward parity; later steps compound optimizer updates
+    # (fp32 everywhere, so agreement should be tight through 20 steps)
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-4)
+    # the curve must actually be a trajectory, not a flat line: training
+    # happened (losses move) and both sides agree step by step
+    assert abs(ours[0] - ours[-1]) > 1e-3

@@ -37,6 +37,11 @@ class MeshConfig:
     - ``data``:     pure data parallelism (batch sharding, params replicated)
     - ``fsdp``:     data parallelism with parameters/optimizer sharded
                     (ZeRO-3 equivalent; batch is also sharded over this axis)
+    - ``expert``:   MoE expert parallelism (stacked expert weights shard
+                    their leading E dim here; batch is also sharded over
+                    this axis, and GSPMD lowers dispatch/combine to the
+                    expert all-to-all) — independent of ``tensor`` so
+                    expert count and megatron splits scale separately
     - ``sequence``: sequence/context parallelism (activations sharded over
                     the length dimension; ring attention)
     - ``tensor``:   tensor (megatron-style) model parallelism
@@ -49,12 +54,14 @@ class MeshConfig:
     sequence: int = 1
     tensor: int = 1
     stage: int = 1
+    expert: int = 1
 
     def axis_sizes(self) -> dict[str, int]:
         return {
             "stage": self.stage,
             "data": self.data,
             "fsdp": self.fsdp,
+            "expert": self.expert,
             "sequence": self.sequence,
             "tensor": self.tensor,
         }
@@ -114,6 +121,12 @@ class TrainConfig:
     # own setting; HF-converted Mixtral defaults to no-drop, which is exact
     # but memory-hungry — 1.25 restores the capacity trade for training)
     moe_capacity_factor: float | None = None
+    # Under stage>1, generation-based ROUGE eval unstacks the blocks to
+    # replicated per-layer params — fine for models that fit one device,
+    # an OOM for the ones that actually need the pipeline.  False skips
+    # ROUGE there; the stage-sharded teacher-forced val_loss (computed
+    # through the pipeline itself, no unstacking) is always reported.
+    pipeline_eval_rouge: bool = True
 
     # --- eval/generation (reference live path: beams=2, max_length=128,
     #     train-accelerator.py:239-242) ---
@@ -175,7 +188,13 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)
     p.add_argument("--moe-capacity-factor", type=float, default=_D.moe_capacity_factor)
+    p.add_argument(
+        "--no-pipeline-eval-rouge", action="store_true",
+        help="under stage>1, skip the unstacked generation eval (use for models too big to replicate)",
+    )
     p.add_argument("--num-beams", type=int, default=_D.num_beams)
+    p.add_argument("--eval-max-new-tokens", type=int, default=_D.eval_max_new_tokens)
+    p.add_argument("--eval-batch-size", type=int, default=_D.eval_batch_size)
     p.add_argument("--log-every-steps", type=int, default=_D.log_every_steps)
     p.add_argument("--tokenizer", type=str, default=_D.tokenizer)
     p.add_argument("--prefetch-batches", type=int, default=_D.prefetch_batches)
@@ -197,7 +216,7 @@ def parse_mesh_arg(spec: str) -> MeshConfig:
         for part in spec.split(","):
             k, _, v = part.partition("=")
             k = k.strip()
-            if k not in ("stage", "data", "fsdp", "sequence", "tensor"):
+            if k not in ("stage", "data", "fsdp", "expert", "sequence", "tensor"):
                 raise ValueError(f"unknown mesh axis {k!r}")
             kw[k] = int(v)
     # MeshConfig defaults data to -1 (wildcard); if the user put the wildcard
@@ -219,6 +238,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     kw = {k: v for k, v in present.items() if k in fields and k not in ("mesh", "checkpoint")}
     if "mesh" in present:
         kw["mesh"] = parse_mesh_arg(present["mesh"])
+    if present.get("no_pipeline_eval_rouge"):
+        kw["pipeline_eval_rouge"] = False
     ckpt_kw = {}
     if "save_every_steps" in present:
         ckpt_kw["save_every_steps"] = present["save_every_steps"]

@@ -11,11 +11,11 @@ Replaces the reference's process-group plumbing with the TPU-native pair:
   collectives over ICI/DCN.
 
 - ``jax.sharding.Mesh`` over named axes ("stage", "data", "fsdp",
-  "sequence", "tensor") — pipeline, data, ZeRO-3, ring-attention context,
-  and tensor/expert parallelism respectively — is the single object that
-  expresses every parallelism strategy; the reference needed three
-  different mechanisms (torchrun env vars, Accelerate, hand-rolled
-  all_reduce) for data parallelism alone.
+  "expert", "sequence", "tensor") — pipeline, data, ZeRO-3, MoE expert,
+  ring-attention context, and tensor parallelism respectively — is the
+  single object that expresses every parallelism strategy; the reference
+  needed three different mechanisms (torchrun env vars, Accelerate,
+  hand-rolled all_reduce) for data parallelism alone.
 """
 
 from __future__ import annotations
@@ -33,7 +33,7 @@ from distributed_llms_example_tpu.core.config import MeshConfig
 
 logger = logging.getLogger(__name__)
 
-AXES: tuple[str, ...] = ("stage", "data", "fsdp", "sequence", "tensor")
+AXES: tuple[str, ...] = ("stage", "data", "fsdp", "expert", "sequence", "tensor")
 
 DEFAULT_COORDINATOR_PORT = 1234  # parity with reference train-task.py:420
 
@@ -47,19 +47,20 @@ class MeshSpec:
     sequence: int
     tensor: int
     stage: int = 1
+    expert: int = 1
 
     @property
     def size(self) -> int:
-        return self.stage * self.data * self.fsdp * self.sequence * self.tensor
+        return self.stage * self.data * self.fsdp * self.expert * self.sequence * self.tensor
 
     @property
     def batch_shards(self) -> int:
-        """Number of ways the global batch is split (data × fsdp)."""
-        return self.data * self.fsdp
+        """Number of ways the global batch is split (data × fsdp × expert)."""
+        return self.data * self.fsdp * self.expert
 
-    def as_tuple(self) -> tuple[int, int, int, int, int]:
+    def as_tuple(self) -> tuple[int, int, int, int, int, int]:
         """Axis sizes in mesh-axis order (AXES)."""
-        return (self.stage, self.data, self.fsdp, self.sequence, self.tensor)
+        return (self.stage, self.data, self.fsdp, self.expert, self.sequence, self.tensor)
 
 
 def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> MeshSpec:

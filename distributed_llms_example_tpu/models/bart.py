@@ -243,3 +243,90 @@ class BartForConditionalGeneration(nn.Module):
             decoder_attention_mask=decoder_attention_mask,
             deterministic=deterministic,
         )
+
+
+class PipelinedBart:
+    """Train-time ``apply()`` adapter running BOTH BART stacks as GPipe
+    pipelines over the ``stage`` mesh axis (parallel/pipeline.py) — the
+    encoder pipeline drains fully, then its output rides the decoder
+    pipeline as a per-example extra feeding every stage's cross-attention.
+
+    Drop-in for ``BartForConditionalGeneration.apply`` in the train step's
+    loss fn (same signature/logits) with the param tree holding
+    ``stacked_encoder_blocks`` / ``stacked_decoder_blocks``
+    (``stack_for_family("bart", ...)``).  Embeddings / logits run outside
+    the pipelines under plain GSPMD; ``stage`` composes with data/fsdp and
+    ``tensor`` (partial-manual shard_map), not ``sequence``.  Deterministic
+    only: dropout is disabled under the pipeline (the Trainer logs this) —
+    threading per-microbatch RNGs through the stage loop is not supported.
+    Training + teacher-forced scoring only (no KV-cache generation path).
+    """
+
+    def __init__(self, config: BartConfig, mesh, dtype=jnp.float32,
+                 num_microbatches: int = 0, remat: bool = True):
+        if mesh.shape.get("sequence", 1) > 1:
+            raise ValueError("pipeline (stage>1) does not compose with sequence parallelism")
+        stages = mesh.shape.get("stage", 1)
+        for n, what in ((config.encoder_layers, "encoder"), (config.decoder_layers, "decoder")):
+            if n % max(stages, 1):
+                raise ValueError(f"{n} {what} layers not divisible into {stages} stages")
+        self.config = config
+        self.mesh = mesh
+        self.dtype = dtype
+        self.num_microbatches = num_microbatches or max(stages, 1)
+        self.remat = remat
+        cfg = config
+        self._shared = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=dtype)
+        self._pos = nn.Embed(cfg.max_position_embeddings + cfg.POSITION_OFFSET, cfg.d_model, dtype=dtype)
+        self._ln = LayerNorm(cfg.layer_norm_epsilon, dtype)
+        self._enc_layer = BartEncoderLayer(cfg, dtype=dtype)
+        self._dec_layer = BartDecoderLayer(cfg, dtype=dtype)
+
+    def _embed(self, params, shared, ids, pos_key, ln_key):
+        cfg = self.config
+        pos = jnp.arange(ids.shape[1]) + cfg.POSITION_OFFSET
+        h = shared * cfg.embed_scale + self._pos.apply({"params": params[pos_key]}, pos)[None]
+        return constrain_hidden(self._ln.apply({"params": params[ln_key]}, h))
+
+    def apply(self, variables, input_ids, attention_mask=None, decoder_input_ids=None,
+              decoder_attention_mask=None, *, deterministic: bool = True, rngs=None):
+        from distributed_llms_example_tpu.parallel.activation import activation_mesh
+        from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
+
+        p = variables["params"]
+        shared = lambda ids: self._shared.apply({"params": p["shared"]}, ids)  # noqa: E731
+        enc_bias = mask_to_bias(attention_mask) if attention_mask is not None else None
+
+        hidden = self._embed(p, shared(input_ids), input_ids,
+                             "encoder_embed_positions", "encoder_layernorm_embedding")
+
+        def enc_fn(lp, h, ex):
+            with activation_mesh(None):
+                return self._enc_layer.apply({"params": lp}, h, ex.get("bias"), True)
+
+        hidden = pipeline_apply(
+            enc_fn, p["stacked_encoder_blocks"], hidden,
+            {"bias": enc_bias} if enc_bias is not None else {},
+            mesh=self.mesh, num_microbatches=self.num_microbatches, checkpoint=self.remat,
+        )
+
+        dh = self._embed(p, shared(decoder_input_ids), decoder_input_ids,
+                         "decoder_embed_positions", "decoder_layernorm_embedding")
+        extras = {"enc": hidden}
+        if enc_bias is not None:
+            extras["cross_bias"] = enc_bias
+        if decoder_attention_mask is not None:
+            extras["self_bias"] = mask_to_bias(decoder_attention_mask)
+
+        def dec_fn(lp, h, ex):
+            with activation_mesh(None):
+                return self._dec_layer.apply(
+                    {"params": lp}, h, ex.get("self_bias"), ex["enc"], ex.get("cross_bias"), True
+                )
+
+        dh = pipeline_apply(
+            dec_fn, p["stacked_decoder_blocks"], dh, extras,
+            mesh=self.mesh, num_microbatches=self.num_microbatches, checkpoint=self.remat,
+        )
+        logits = constrain_logits(dh @ p["shared"]["embedding"].astype(self.dtype).T)
+        return logits + p["final_logits_bias"].astype(logits.dtype)

@@ -128,22 +128,24 @@ class PipelinedLlama:
     *stacked*: ``{embed_tokens, stacked_blocks, final_norm, lm_head}``
     (``stack_blocks`` of the standard tree; checkpoints/eval use
     ``unstack_blocks`` to return to the per-layer layout).  Embedding,
-    final norm, and LM head run replicated across stages outside the
-    pipeline body; each stage applies its layer slice with single-shard
-    (XLA) attention — ``stage`` composes with data/fsdp batch axes but not
-    with ``tensor``/``sequence`` (validated).  Training only: no KV-cache
-    generation path (unstack for eval/decoding).
+    final norm, and LM head run outside the pipeline body under plain
+    GSPMD.  The pipeline shard_map is manual over ``stage`` ONLY, so
+    ``stage`` composes with data/fsdp (batch) AND ``tensor`` (megatron
+    splits on the stacked kernels, partitioned automatically by GSPMD
+    inside each stage) — the stage×tensor topology 7B+ models use.
+    ``sequence`` is still excluded (ring attention is its own fully-manual
+    shard_map; manual regions don't nest).  Training + teacher-forced
+    scoring only: no KV-cache generation path (unstack for decoding).
     """
 
     def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32,
                  num_microbatches: int = 0, remat: bool = True):
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply  # noqa: F401 (validated here, used in apply)
 
-        for ax in ("tensor", "sequence"):
-            if mesh.shape.get(ax, 1) > 1:
-                raise ValueError(
-                    f"pipeline (stage>1) does not compose with {ax} parallelism"
-                )
+        if mesh.shape.get("sequence", 1) > 1:
+            raise ValueError(
+                "pipeline (stage>1) does not compose with sequence parallelism"
+            )
         if getattr(config, "num_experts", 0) > 0:
             raise ValueError(
                 "pipeline (stage>1) does not support MoE configs yet: the "

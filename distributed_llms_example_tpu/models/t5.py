@@ -392,3 +392,103 @@ def shift_right(labels: jnp.ndarray, decoder_start_token_id: int, pad_token_id: 
     semantics: -100 label positions become pad)."""
     shifted = jnp.roll(labels, 1, axis=-1).at[:, 0].set(decoder_start_token_id)
     return jnp.where(shifted == -100, pad_token_id, shifted)
+
+
+class PipelinedT5:
+    """Train-time ``apply()`` adapter running both T5 stacks as GPipe
+    pipelines over ``stage`` (parallel/pipeline.py; see ``PipelinedBart``
+    for the twin-pipeline shape).  The learned relative-position bias is
+    computed OUTSIDE the pipelines directly from each stack's bucket table
+    — one (1, heads, q, kv) tensor per stack, entering the stage loop as a
+    replicated per-call extra, so the bias table itself still receives
+    gradient through the bucket lookup.  Param tree:
+    ``stack_for_family("t5", ...)`` (each stack's blocks stacked under
+    ``{encoder,decoder}/stacked_blocks``).  Deterministic only; training +
+    teacher-forced scoring only.
+    """
+
+    def __init__(self, config: T5Config, mesh, dtype=jnp.float32,
+                 num_microbatches: int = 0, remat: bool = True):
+        if mesh.shape.get("sequence", 1) > 1:
+            raise ValueError("pipeline (stage>1) does not compose with sequence parallelism")
+        stages = mesh.shape.get("stage", 1)
+        for n, what in ((config.num_layers, "encoder"), (config.decoder_layers, "decoder")):
+            if n % max(stages, 1):
+                raise ValueError(f"{n} {what} layers not divisible into {stages} stages")
+        self.config = config
+        self.mesh = mesh
+        self.dtype = dtype
+        self.num_microbatches = num_microbatches or max(stages, 1)
+        self.remat = remat
+        cfg = config
+        self._shared = nn.Embed(
+            cfg.vocab_size, cfg.d_model, embedding_init=nn.initializers.normal(1.0), dtype=dtype
+        )
+        self._enc_block = T5Block(cfg, causal=False, has_cross=False, dtype=dtype)
+        self._dec_block = T5Block(cfg, causal=True, has_cross=True, dtype=dtype)
+        self._norm = RMSNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype)
+        if not cfg.tie_word_embeddings:
+            self._head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=dtype)
+
+    def _position_bias(self, table: jnp.ndarray, q_len: int, causal: bool) -> jnp.ndarray:
+        """(1, heads, q, q) additive bias from a stack's bucket table —
+        the functional twin of T5Stack.position_bias."""
+        cfg = self.config
+        rel = jnp.arange(q_len)[None, :] - jnp.arange(q_len)[:, None]
+        buckets = relative_position_bucket(
+            rel,
+            bidirectional=not causal,
+            num_buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance,
+        )
+        bias = jnp.take(table, buckets, axis=0)  # (q, kv, heads)
+        return bias.transpose(2, 0, 1)[None].astype(self.dtype)
+
+    def _run_stack(self, stack_params, block, hidden, self_bias, extras):
+        from distributed_llms_example_tpu.parallel.activation import activation_mesh
+        from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
+
+        ex = {k: v for k, v in extras.items() if v is not None}
+
+        def layer_fn(lp, h, e):
+            with activation_mesh(None):
+                return block.apply(
+                    {"params": lp}, h, e.get("self_bias"), e.get("enc"), e.get("cross_bias"), True
+                )
+
+        hidden = pipeline_apply(
+            layer_fn, stack_params["stacked_blocks"], hidden,
+            {**ex, "self_bias": self_bias},
+            mesh=self.mesh, num_microbatches=self.num_microbatches, checkpoint=self.remat,
+        )
+        return self._norm.apply({"params": stack_params["final_norm"]}, hidden)
+
+    def apply(self, variables, input_ids, attention_mask=None, decoder_input_ids=None,
+              decoder_attention_mask=None, *, deterministic: bool = True, rngs=None):
+        p = variables["params"]
+        cfg = self.config
+        shared = lambda ids: constrain_hidden(  # noqa: E731
+            self._shared.apply({"params": p["shared"]}, ids)
+        )
+
+        q_len = input_ids.shape[1]
+        enc_table = p["encoder"]["relative_attention_bias"]["embedding"]
+        self_bias = self._position_bias(enc_table, q_len, causal=False)
+        if attention_mask is not None:
+            self_bias = self_bias + mask_to_bias(attention_mask)
+        enc = self._run_stack(p["encoder"], self._enc_block, shared(input_ids), self_bias, {})
+
+        d_len = decoder_input_ids.shape[1]
+        dec_table = p["decoder"]["relative_attention_bias"]["embedding"]
+        dec_bias = self._position_bias(dec_table, d_len, causal=True) + make_causal_bias(d_len, d_len)
+        if decoder_attention_mask is not None:
+            dec_bias = dec_bias + mask_to_bias(decoder_attention_mask)
+        cross_bias = mask_to_bias(attention_mask) if attention_mask is not None else None
+        hidden = self._run_stack(
+            p["decoder"], self._dec_block, shared(decoder_input_ids), dec_bias,
+            {"enc": enc, "cross_bias": cross_bias},
+        )
+        if cfg.tie_word_embeddings:
+            hidden = hidden * (cfg.d_model**-0.5)
+            return constrain_logits(hidden @ p["shared"]["embedding"].astype(self.dtype).T)
+        return constrain_logits(self._head.apply({"params": p["lm_head"]}, hidden))

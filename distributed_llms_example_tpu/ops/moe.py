@@ -42,10 +42,10 @@ from distributed_llms_example_tpu.parallel.activation import constrain
 
 
 def _expert_spec():
-    """(groups, experts, capacity, d_model) — experts over ``tensor``."""
+    """(groups, experts, capacity, d_model) — experts over ``expert``."""
     from jax.sharding import PartitionSpec as P
 
-    return P(None, "tensor")
+    return P(None, "expert")
 
 
 class MoEMLP(nn.Module):

@@ -150,7 +150,7 @@ def ring_attention_sharded(
     causal: bool = False,
     scale: float | None = None,
     dtype: jnp.dtype | None = None,
-    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    batch_axes: tuple[str, ...] = ("data", "fsdp", "expert"),
     head_axis: str = "tensor",
     seq_axis: str = "sequence",
 ) -> jnp.ndarray:

@@ -26,12 +26,15 @@ Design — a spatial pipeline expressed as one SPMD program, TPU-first:
 - Bubble: (S-1)/(M+S-1) of ticks compute garbage that is discarded (and
   contributes zero gradient).  Raise ``num_microbatches`` to amortize.
 
-Composition rules (v1): ``stage`` composes with the batch axes
-(``data``/``fsdp`` — both act as pure data parallelism here, since
-pipelined params are sharded by layer, not within tensors) but not with
-``tensor`` or ``sequence``; the adapter validates this.  Inside the
-pipeline body there is no ambient GSPMD mesh, so attention runs its
-single-shard path per stage.
+Composition (v2): the ``shard_map`` is manual over ``stage`` ONLY
+(``axis_names={"stage"}``) — every other mesh axis stays *automatic*, so
+GSPMD keeps partitioning the per-stage compute over ``data``/``fsdp``
+(batch) and ``tensor`` (megatron splits on the stacked kernels, the
+standard stage×tensor 7B+ topology) inside the pipeline body, inserting
+the collectives itself.  Only ``sequence`` (ring attention is its own
+fully-manual shard_map — nesting manual regions is not supported) and
+MoE (sown aux losses can't cross the shard_map) remain excluded; the
+adapters validate that.
 """
 
 from __future__ import annotations
@@ -43,13 +46,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def stack_blocks(params: dict, prefix: str = "block_") -> dict:
+def stack_blocks(params: dict, prefix: str = "block_", out_key: str = "stacked_blocks") -> dict:
     """Standard per-layer tree ({block_0: t, block_1: t, ...}) → pipelined
     tree ({stacked_blocks: tree-of-(L, ...) arrays, ...rest}).  The inverse
     of ``unstack_blocks``; checkpoints and HF conversion stay in the
     per-layer layout, this transform is applied at training-setup time."""
     names = sorted(
-        (k for k in params if k.startswith(prefix)),
+        (k for k in params if k.startswith(prefix) and k[len(prefix):].isdigit()),
         key=lambda k: int(k[len(prefix):]),
     )
     if not names:
@@ -65,13 +68,13 @@ def stack_blocks(params: dict, prefix: str = "block_") -> dict:
     stacked = jax.tree.map(
         lambda *xs: np.stack([np.asarray(x) for x in xs]), *(params[n] for n in names)
     )
-    return {**rest, "stacked_blocks": stacked}
+    return {**rest, out_key: stacked}
 
 
-def unstack_blocks(params: dict, prefix: str = "block_") -> dict:
+def unstack_blocks(params: dict, prefix: str = "block_", key: str = "stacked_blocks") -> dict:
     """Pipelined tree → standard per-layer tree (for checkpoints/eval)."""
-    stacked = params["stacked_blocks"]
-    rest = {k: v for k, v in params.items() if k != "stacked_blocks"}
+    stacked = params[key]
+    rest = {k: v for k, v in params.items() if k != key}
     n = jax.tree.leaves(stacked)[0].shape[0]
     out = dict(rest)
     for i in range(n):
@@ -79,8 +82,50 @@ def unstack_blocks(params: dict, prefix: str = "block_") -> dict:
     return out
 
 
+def stack_for_family(family: str, params: dict) -> dict:
+    """Family-aware stacking: LLaMA stacks its single decoder stack; BART
+    stacks encoder+decoder at the top level; T5 stacks inside its nested
+    encoder/decoder subtrees."""
+    if family == "llama":
+        return stack_blocks(params)
+    if family == "bart":
+        params = stack_blocks(params, "encoder_block_", "stacked_encoder_blocks")
+        return stack_blocks(params, "decoder_block_", "stacked_decoder_blocks")
+    if family == "t5":
+        return {
+            **params,
+            "encoder": stack_blocks(params["encoder"]),
+            "decoder": stack_blocks(params["decoder"]),
+        }
+    raise ValueError(f"no pipeline stacking for family {family!r}")
+
+
+def unstack_for_family(family: str, params: dict) -> dict:
+    if family == "llama":
+        return unstack_blocks(params)
+    if family == "bart":
+        params = unstack_blocks(params, "encoder_block_", "stacked_encoder_blocks")
+        return unstack_blocks(params, "decoder_block_", "stacked_decoder_blocks")
+    if family == "t5":
+        return {
+            **params,
+            "encoder": unstack_blocks(params["encoder"]),
+            "decoder": unstack_blocks(params["decoder"]),
+        }
+    raise ValueError(f"no pipeline unstacking for family {family!r}")
+
+
 def _full_spec(leading, ndim: int) -> P:
     return P(leading, *([None] * (ndim - 1)))
+
+
+VARY_WITH_PCAST = True  # False path: check_vma=False, no explicit pcasts
+
+
+def _vary(tree, axis_name: str):
+    if not VARY_WITH_PCAST:
+        return tree
+    return jax.tree.map(lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
 
 
 def pipeline_apply(
@@ -92,7 +137,7 @@ def pipeline_apply(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = "stage",
-    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    batch_axes: tuple[str, ...] = ("data", "fsdp", "expert"),
     checkpoint: bool = True,
 ) -> jnp.ndarray:
     """Run ``hidden`` through the stacked layers as a pipelined schedule.
@@ -134,14 +179,32 @@ def pipeline_apply(
         # no pipeline: plain scan over the full stack under GSPMD
         return run_stage(stacked_params, hidden, extras)
 
-    batch_spec = batch_axes or None
-    hidden_spec = _full_spec(batch_spec, hidden.ndim)
     # which extras are per-example (to be microbatched) vs per-call
     # constants (replicated): decided from GLOBAL shapes, outside the body
     is_batched = jax.tree.map(lambda m: m.ndim > 0 and m.shape[0] == B, extras)
+    # original extras dtypes: bf16 extras ride the plumbing in fp32 (same
+    # partitioner bug as the hidden carries) and cast back per microbatch
+    ex_dtypes = jax.tree.map(lambda m: m.dtype, extras)
+
+    # The pipeline PLUMBING (microbatch selects, hop buffers, the output
+    # accumulator) runs in fp32 when the compute dtype is bf16: the XLA
+    # SPMD partitioner miscompiles bf16 select/copy chains under
+    # partial-manual shard_map ("Invalid binary instruction opcode copy",
+    # observed on jax 0.9/XLA CPU), and the converts fuse into the layer
+    # matmuls anyway.  Layer compute still happens in the caller's dtype.
+    compute_dtype = hidden.dtype
+    plumb_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
 
     def body(local_params: Any, h: jnp.ndarray, ex: Any) -> jnp.ndarray:
+        # Manual over ``stage`` only: shapes here are GLOBAL in every other
+        # dim and every array must be made stage-varying (each stage
+        # branches on s_idx), hence the pcasts.  GSPMD still auto-shards
+        # the per-stage compute over data/fsdp/tensor.
         s_idx = jax.lax.axis_index(axis_name)
+        ex = jax.tree.map(
+            lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, ex
+        )
+        h, ex = _vary(h.astype(plumb_dtype), axis_name), _vary(ex, axis_name)
         mb = h.shape[0] // M
         micro = h.reshape(M, mb, *h.shape[1:])
         micro_ex = jax.tree.map(
@@ -149,8 +212,8 @@ def pipeline_apply(
             ex,
             is_batched,
         )
-        buf = jnp.zeros((mb, *h.shape[1:]), h.dtype)
-        outputs = jnp.zeros((M, mb, *h.shape[1:]), h.dtype)
+        buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axis_name)
+        outputs = _vary(jnp.zeros((M, mb, *h.shape[1:]), h.dtype), axis_name)
         perm = [(i, i + 1) for i in range(S - 1)]
 
         def tick(carry, t):
@@ -159,13 +222,16 @@ def pipeline_apply(
             m_idx = jnp.clip(t - s_idx, 0, M - 1)
             x0 = jax.lax.dynamic_index_in_dim(micro, m_idx, 0, keepdims=False)
             ex_t = jax.tree.map(
-                lambda m, batched: jax.lax.dynamic_index_in_dim(m, m_idx, 0, keepdims=False)
-                if batched else m,
+                lambda m, batched, dt: (
+                    jax.lax.dynamic_index_in_dim(m, m_idx, 0, keepdims=False)
+                    if batched else m
+                ).astype(dt),
                 micro_ex,
                 is_batched,
+                ex_dtypes,
             )
             inp = jnp.where(s_idx == 0, x0, buf)
-            y = run_stage(local_params, inp, ex_t)
+            y = run_stage(local_params, inp.astype(compute_dtype), ex_t).astype(plumb_dtype)
             nxt = jax.lax.ppermute(y, axis_name, perm)
             write = (s_idx == S - 1) & (t >= S - 1)
             upd = jax.lax.dynamic_update_index_in_dim(outputs, y, m_idx, 0)
@@ -178,18 +244,18 @@ def pipeline_apply(
         outputs = jax.lax.psum(
             jnp.where(s_idx == S - 1, outputs, jnp.zeros_like(outputs)), axis_name
         )
-        return outputs.reshape(h.shape)
+        return outputs.reshape(h.shape).astype(compute_dtype)
 
+    # in/out specs name ONLY the manual axis; shardings over the automatic
+    # axes (fsdp/tensor splits on the stacked kernels, data/fsdp on the
+    # batch) ride through untouched
     param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
-    extras_specs = jax.tree.map(
-        lambda m, batched: _full_spec(batch_spec, m.ndim) if batched else P(),
-        extras,
-        is_batched,
-    )
+    extras_specs = jax.tree.map(lambda m: P(), extras)
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, hidden_spec, extras_specs),
-        out_specs=hidden_spec,
-        check_vma=False,
+        axis_names={axis_name},
+        in_specs=(param_specs, P(), extras_specs),
+        out_specs=P(),
+        check_vma=VARY_WITH_PCAST,
     )(stacked_params, hidden, extras)

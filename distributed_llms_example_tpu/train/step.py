@@ -161,7 +161,7 @@ def make_train_step(
         else mesh.shape.get("sequence", 1) > 1
     )
     micro_sharding = NamedSharding(
-        mesh, P(None, ("data", "fsdp"), "sequence" if seq_sharded else None)
+        mesh, P(None, ("data", "fsdp", "expert"), "sequence" if seq_sharded else None)
     )
 
     def value_and_grad_sums(params: Any, batch: dict, rng: jax.Array | None) -> tuple:

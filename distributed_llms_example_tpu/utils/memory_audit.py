@@ -202,7 +202,7 @@ def audit_train_step_memory(
     )
     micro_batch = global_batch // max(1, grad_accum_steps)
     batch_shards = 1
-    for ax in ("data", "fsdp"):
+    for ax in ("data", "fsdp", "expert"):
         batch_shards *= mesh.shape.get(ax, 1)
     b_loc = max(1, micro_batch // batch_shards)
     dtype_bytes = jnp.dtype(parse_dtype(dtype)).itemsize

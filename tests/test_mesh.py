@@ -16,14 +16,14 @@ from distributed_llms_example_tpu.core.mesh import (
 
 def test_resolve_wildcard():
     spec = resolve_mesh_shape(MeshConfig(data=-1, fsdp=2, tensor=2), 8)
-    assert spec.as_tuple() == (1, 2, 2, 1, 2)
+    assert spec.as_tuple() == (1, 2, 2, 1, 1, 2)
     assert spec.size == 8
     assert spec.batch_shards == 4
 
 
 def test_resolve_exact():
     spec = resolve_mesh_shape(MeshConfig(data=8, fsdp=1), 8)
-    assert spec.as_tuple() == (1, 8, 1, 1, 1)
+    assert spec.as_tuple() == (1, 8, 1, 1, 1, 1)
 
 
 def test_resolve_errors():
@@ -36,7 +36,7 @@ def test_resolve_errors():
 
 
 def test_build_mesh_axes(mesh8):
-    assert mesh8.axis_names == ("stage", "data", "fsdp", "sequence", "tensor")
+    assert mesh8.axis_names == ("stage", "data", "fsdp", "expert", "sequence", "tensor")
     assert mesh8.devices.size == 8
 
 

@@ -124,8 +124,11 @@ def test_mixtral_forward_and_aux_plumbing(mesh8):
 
 
 def test_moe_sharded_step_equals_single_device(mesh8):
-    """Expert-parallel train step (experts over tensor, tokens over
-    data×fsdp) == single device: loss, grad-norm, updated params."""
+    """Expert-parallel train step == single device on TWO topologies:
+    the general mesh8 (expert=1: experts replicated, megatron splits over
+    tensor) and the decoupled EP×TP mesh (expert=2,tensor=2: experts over
+    their own axis COMPOSED with column/row splits) — loss, grad-norm,
+    updated params all match."""
     import optax
 
     from distributed_llms_example_tpu.core.config import MeshConfig
@@ -151,8 +154,9 @@ def test_moe_sharded_step_equals_single_device(mesh8):
 
     tx = optax.sgd(1e-2)
     mesh1 = build_mesh(MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1])
+    mesh_ep = build_mesh(MeshConfig(data=2, fsdp=1, expert=2, sequence=1, tensor=2))
     outs = {}
-    for name, mesh in (("sharded", mesh8), ("single", mesh1)):
+    for name, mesh in (("sharded", mesh8), ("ep_tp", mesh_ep), ("single", mesh1)):
         build = make_train_step(
             lm.module, lm.config, tx, lambda _: 1e-2, mesh, donate=False, is_seq2seq=False
         )
@@ -166,16 +170,18 @@ def test_moe_sharded_step_equals_single_device(mesh8):
             float(metrics["loss"]),
             float(metrics["grad_norm"]),
         )
-    p_sh, loss_sh, gn_sh = outs["sharded"]
     p_1, loss_1, gn_1 = outs["single"]
-    assert loss_sh == pytest.approx(loss_1, rel=1e-5)
-    assert gn_sh == pytest.approx(gn_1, rel=1e-4)
-    for a, b_ in zip(jax.tree.leaves(p_sh), jax.tree.leaves(p_1)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5, rtol=2e-5)
-    # expert weights really are sharded: E=4 over tensor=2 → 2 per device
-    sharded_params = shard_params(params0, mesh8)
-    gate = sharded_params["block_0"]["mlp"]["gate_proj"]
-    assert {sh.data.shape[0] for sh in gate.addressable_shards} == {2}
+    for name in ("sharded", "ep_tp"):
+        p_sh, loss_sh, gn_sh = outs[name]
+        assert loss_sh == pytest.approx(loss_1, rel=1e-5), name
+        assert gn_sh == pytest.approx(gn_1, rel=1e-4), name
+        for a, b_ in zip(jax.tree.leaves(p_sh), jax.tree.leaves(p_1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5, rtol=2e-5)
+    # EP × TP really compose: gate_proj (E=4, d, ff) shards E=4 over
+    # expert=2 AND ff over tensor=2 — (2, d, ff/2) per device
+    gate = shard_params(params0, mesh_ep)["block_0"]["mlp"]["gate_proj"]
+    E, d, ff = params0["block_0"]["mlp"]["gate_proj"].shape
+    assert {s.data.shape for s in gate.addressable_shards} == {(E // 2, d, ff // 2)}
 
 def test_grouped_routing_matches_ungrouped():
     """With ample capacity, routing decisions are per-token, so splitting

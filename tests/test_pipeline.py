@@ -217,6 +217,64 @@ def test_pipelined_train_step_equals_single_device(pp_mesh, tiny_llama4):
     assert {s.data.shape[0] for s in stacked_leaf.addressable_shards} == {1}
 
 
+def test_pipelined_stage_x_tensor_equals_single_device(tiny_llama4):
+    """stage=2 × tensor=2 × data=2 — the standard 7B+ topology.  The
+    pipeline shard_map is manual over ``stage`` only, so GSPMD partitions
+    the stacked kernels' megatron splits over ``tensor`` inside each
+    stage; the result must equal the single-device standard module."""
+    import optax
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.parallel.pipeline import stack_blocks
+    from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    cfg, module, params0 = tiny_llama4
+    rng = np.random.RandomState(5)
+    b, src = 8, 16
+    ids = rng.randint(2, cfg.vocab_size, (b, src)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, :3] = LABEL_PAD
+    batch = {"input_ids": ids, "attention_mask": np.ones((b, src), np.int32), "labels": labels}
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    mesh1 = build_mesh(MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1])
+    build = make_train_step(module, cfg, tx, schedule, mesh1, donate=False, is_seq2seq=False)
+    state = create_train_state(shard_params(params0, mesh1), tx)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh1))
+    step, _ = build(state)
+    _, ref_metrics = step(state, put_batch(batch, mesh1))
+
+    mesh_st = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=1, tensor=2))
+    piped = PipelinedLlama(cfg, mesh_st, num_microbatches=2)
+    rules = pipeline_rules()
+    pparams = shard_params(stack_blocks(params0), mesh_st, rules)
+    state_p = create_train_state(pparams, tx)
+    state_p = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, mesh_st, rules)
+    )
+    build_p = make_train_step(
+        piped, cfg, tx, schedule, mesh_st, rules=rules, donate=False, is_seq2seq=False
+    )
+    step_p, _ = build_p(state_p)
+    new_state_p, metrics_p = step_p(state_p, put_batch(batch, mesh_st))
+
+    assert float(metrics_p["loss"]) == pytest.approx(float(ref_metrics["loss"]), rel=1e-5)
+    assert float(metrics_p["grad_norm"]) == pytest.approx(float(ref_metrics["grad_norm"]), rel=1e-4)
+    # stacked q_proj kernel (L, d, heads·hd): L=4 over stage=2 AND the
+    # output dim over tensor=2 — stage × tensor really compose
+    leaf = new_state_p.params["stacked_blocks"]["self_attn"]["q_proj"]["kernel"]
+    L, d = cfg.num_hidden_layers, cfg.hidden_size
+    assert {s.data.shape for s in leaf.addressable_shards} == {(L // 2, d, d // 2)}
+
+
 def test_trainer_pipelined_end_to_end(tmp_path):
     """Trainer on a stage=2 × data=2 mesh: stacks the blocks, trains through
     the pipeline, disables eval, exports the standard per-layer layout."""
@@ -253,14 +311,16 @@ def test_trainer_pipelined_end_to_end(tmp_path):
     result = trainer.train()
     assert result["steps"] == trainer.total_steps
     assert "rougeL" in result["final_eval"]  # eval really ran under stage>1
-    # exported artifact is back in the standard per-layer layout
-    import orbax.checkpoint as ocp
+    # stage-sharded teacher-forced eval (no unstacking) always reports
+    assert np.isfinite(result["final_eval"]["val_loss"])
+    # exported artifact is an HF checkpoint in the standard per-layer
+    # layout — it round-trips through the loader
+    from distributed_llms_example_tpu.models.registry import load_model
 
-    restored = ocp.StandardCheckpointer().restore(
-        os.path.abspath(os.path.join(str(tmp_path), "model", "params"))
-    )
-    assert "block_0" in restored and "block_1" in restored
-    assert "stacked_blocks" not in restored
+    reloaded = load_model(os.path.join(str(tmp_path), "model"))
+    assert reloaded.params is not None
+    assert "block_0" in reloaded.params and "block_1" in reloaded.params
+    assert "stacked_blocks" not in reloaded.params
 
 
 def test_decay_mask_on_stacked_params():
@@ -279,3 +339,122 @@ def test_decay_mask_on_stacked_params():
     assert mask["stacked_blocks"]["attn_norm"]["scale"] is False
     assert mask["stacked_blocks"]["self_attn"]["q_proj"]["kernel"] is True
     assert mask["final_norm"]["scale"] is False
+
+
+def test_pipelined_bart_logits_parity():
+    """PipelinedBart (twin pipelines, stage=2 × data=2 × tensor=2) must
+    reproduce the standard BartForConditionalGeneration logits."""
+    from distributed_llms_example_tpu.models.bart import (
+        BartConfig,
+        BartForConditionalGeneration,
+        PipelinedBart,
+    )
+    from distributed_llms_example_tpu.parallel.pipeline import stack_for_family
+
+    cfg = BartConfig(
+        vocab_size=128, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, max_position_embeddings=64,
+        dropout_rate=0.0,
+    )
+    module = BartForConditionalGeneration(cfg)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(4, 128, (8, 12)).astype(np.int32)
+    mask = np.ones((8, 12), np.int32)
+    mask[:2, -4:] = 0
+    dec = rng.randint(4, 128, (8, 6)).astype(np.int32)
+    params = jax.device_get(
+        module.init(jax.random.PRNGKey(0), jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(dec))["params"]
+    )
+    ref = module.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(dec))
+
+    mesh = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=1, tensor=2))
+    piped = PipelinedBart(cfg, mesh, num_microbatches=2, remat=False)
+    pparams = stack_for_family("bart", params)
+    out = piped.apply({"params": pparams}, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(dec))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_pipelined_t5_logits_parity():
+    """PipelinedT5 (twin pipelines + out-of-pipeline relative-position
+    bias) must reproduce the standard T5ForConditionalGeneration logits,
+    and the bias tables must still receive gradient."""
+    from distributed_llms_example_tpu.models.t5 import (
+        T5Config,
+        T5ForConditionalGeneration,
+        PipelinedT5,
+    )
+    from distributed_llms_example_tpu.parallel.pipeline import stack_for_family
+
+    cfg = T5Config(vocab_size=128, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+                   num_heads=4, dropout_rate=0.0)
+    module = T5ForConditionalGeneration(cfg)
+    rng = np.random.RandomState(4)
+    ids = rng.randint(4, 128, (8, 10)).astype(np.int32)
+    mask = np.ones((8, 10), np.int32)
+    mask[:3, -3:] = 0
+    dec = rng.randint(4, 128, (8, 5)).astype(np.int32)
+    params = jax.device_get(
+        module.init(jax.random.PRNGKey(1), jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(dec))["params"]
+    )
+    ref = module.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(dec))
+
+    mesh = build_mesh(MeshConfig(stage=2, data=2, fsdp=2, sequence=1, tensor=1))
+    piped = PipelinedT5(cfg, mesh, num_microbatches=2, remat=False)
+    pparams = stack_for_family("t5", params)
+    out = piped.apply({"params": pparams}, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(dec))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    # relative-position bias tables get gradient through the pipelined path
+    def loss(p):
+        lg = piped.apply({"params": p}, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(dec))
+        return jnp.sum(lg.astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)(pparams)
+    for stack in ("encoder", "decoder"):
+        gt = np.asarray(g[stack]["relative_attention_bias"]["embedding"])
+        assert np.abs(gt).sum() > 0, stack
+
+
+def test_trainer_pipelined_bart_end_to_end(tmp_path):
+    """Trainer with bart-test on stage=2: twin pipelines end-to-end,
+    pipelined val_loss, dropout disabled (bart default is 0.1), HF export
+    back in per-layer layout."""
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    rng = np.random.RandomState(1)
+    records = [
+        {
+            "dialogue": " ".join(f"w{rng.randint(40)}" for _ in range(rng.randint(5, 16))),
+            "summary": "w3 w4",
+        }
+        for _ in range(16)
+    ]
+    cfg = TrainConfig(
+        model_ckpt="bart-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        warmup_steps=0,
+        learning_rate=1e-3,
+        max_source_length=64,
+        max_target_length=32,
+        pad_to_multiple=32,
+        log_every_steps=1,
+        num_beams=1,
+        eval_max_new_tokens=8,
+        mesh=MeshConfig(stage=2, data=2, fsdp=2, sequence=1, tensor=1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        tokenizer="byte",
+        pipeline_microbatches=2,
+    )
+    trainer = Trainer(cfg, train_records=records, val_records=records[:4])
+    assert trainer.pipelined and not trainer.use_dropout
+    result = trainer.train()
+    assert result["steps"] == trainer.total_steps
+    assert np.isfinite(result["final_eval"]["val_loss"])
+    assert "rougeL" in result["final_eval"]
+    reloaded = load_model(str(tmp_path / "model"))
+    assert "encoder_block_0" in reloaded.params and "decoder_block_1" in reloaded.params

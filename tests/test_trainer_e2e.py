@@ -49,9 +49,10 @@ def test_trainer_end_to_end(tiny_cfg, capsys):
     result = trainer.train()
     assert result["steps"] == trainer.total_steps == 8  # 32/8 * 2 epochs
     assert result["final_eval"].get("epoch") == 1.0
-    # final export exists with sidecars
+    # final export is an HF-format checkpoint with sidecars
     model_dir = os.path.join(tiny_cfg.output_dir, "model")
-    assert os.path.isdir(os.path.join(model_dir, "params"))
+    assert os.path.isfile(os.path.join(model_dir, "model.safetensors"))
+    assert os.path.isfile(os.path.join(model_dir, "config.json"))
     sidecars = [f for f in os.listdir(model_dir) if f.endswith(".metadata.json")]
     assert sidecars
     # JSON-lines contract on stdout
@@ -180,11 +181,11 @@ def test_preemption_checkpoints_and_resumes(tmp_path):
     # handler restored to exactly what was installed before the Trainer
     assert signal.getsignal(signal.SIGTERM) is handler_before
     # no final model export on preemption
-    assert not os.path.isdir(os.path.join(str(tmp_path), "model", "params"))
+    assert not os.path.exists(os.path.join(str(tmp_path), "model", "model.safetensors"))
 
     resumed = Trainer(cfg, train_records=records)
     assert resumed.start_step == 3
     result2 = resumed.train()
     assert result2.get("preempted") is None
     assert result2["steps"] == total
-    assert os.path.isdir(os.path.join(str(tmp_path), "model", "params"))
+    assert os.path.isfile(os.path.join(str(tmp_path), "model", "model.safetensors"))

@@ -48,7 +48,9 @@ def _supervise() -> int:
     """
     attempts = int(os.environ.get("BENCH_RETRIES", "3"))
     backoff = float(os.environ.get("BENCH_BACKOFF", "10"))
-    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "600"))
+    # generous per-attempt ceiling: the child now compiles three programs
+    # (headline step, with-dropout step, trainer loop) before measuring
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "900"))
     # hard wall-clock ceiling so a hanging backend can't outlive the
     # driver's own timeout with no JSON printed (round-1 rc=124 mode)
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "1400"))
@@ -161,6 +163,206 @@ def _flagship():
     raise SystemExit("no benchmarkable model in registry")
 
 
+def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
+                        attention: str | None) -> dict:
+    """Measure the REAL Trainer loop (bucketed batching + prefetch +
+    logging cadence + put_batch on the critical path), not just the jitted
+    step — the round-2 bench only timed synthetic fixed batches, so input-
+    pipeline regressions were invisible.  Returns tok/s/chip with the
+    prefetcher on (depth 2) and off (0): their gap quantifies how much
+    host input work the background thread actually hides.
+
+    Checkpoint/export IO is stubbed out (this measures the training loop,
+    not artifact writes), and each timed pass re-runs the SAME Trainer so
+    compilation stays out of the window."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from distributed_llms_example_tpu.core.config import (
+        CheckpointConfig,
+        MeshConfig,
+        TrainConfig,
+    )
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    steps = max(2, int(os.environ.get("BENCH_TRAINER_STEPS", "6")))
+    batch = int(os.environ.get("BENCH_BATCH", "16")) * n_chips
+    rng = np.random.RandomState(7)
+
+    def text(n_chars: int) -> str:
+        # byte tokenizer ≈ 1 token/char: sources fill the 1024 bucket,
+        # targets the 128 bucket, mirroring the synthetic workload
+        words = []
+        total = 0
+        while total < n_chars:
+            w = "".join(chr(97 + rng.randint(26)) for _ in range(3 + rng.randint(6)))
+            words.append(w)
+            total += len(w) + 1
+        return " ".join(words)[:n_chars]
+
+    records = [{"dialogue": text(1016), "summary": text(120)} for _ in range(batch * steps)]
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg = TrainConfig(
+            model_ckpt=model_name,
+            output_dir=tmp,
+            batch_size=batch,
+            num_epochs=1,
+            warmup_steps=0,
+            evaluation_steps=0,
+            learning_rate=5e-5,
+            max_source_length=1024,
+            max_target_length=128,
+            pad_to_multiple=128,
+            prefetch_batches=2,
+            log_every_steps=steps,
+            tokenizer="byte",
+            # mirror the synthetic step's BENCH_REMAT / BENCH_ATTENTION
+            # overrides so vs_synthetic compares identically-built programs
+            remat=remat,
+            attention_impl=attention or "",
+            mesh=MeshConfig(data=-1),
+            checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        )
+        trainer = Trainer(cfg, train_records=records)
+        trainer.checkpointer.save = lambda *a, **k: None
+        trainer.checkpointer.wait = lambda: None
+        trainer.save_final = lambda: None
+        tokens = sum(trainer._batch_tokens(b) for b in trainer.batches.epoch(0))
+
+        def timed_pass() -> float:
+            t0 = time.perf_counter()
+            trainer.train()
+            # force completion: train() can return with steps still in
+            # flight (async dispatch; block_until_ready is unreliable on
+            # the tunneled backend, so read a param element back)
+            _ = jax.device_get(jax.tree.leaves(trainer.state.params)[0].ravel()[0])
+            return time.perf_counter() - t0
+
+        timed_pass()  # compile + warmup
+        out = {}
+        for prefetch in (2, 0):
+            trainer.cfg = cfg.replace(prefetch_batches=prefetch)
+            # COLD tokenizer cache each pass: the dataset memoizes encoded
+            # examples, and a warm cache would exclude tokenization from
+            # the timed window entirely — the prefetch 2-vs-0 gap is
+            # precisely "does the background thread hide tokenize+pad"
+            trainer.train_ds._cache = [None] * len(trainer.train_ds)
+            dt = timed_pass()
+            out[f"tokens_per_sec_chip_prefetch{prefetch}"] = round(tokens / dt / n_chips, 1)
+        out["steps"] = steps
+        return out
+
+
+def _llama_depth_main() -> None:
+    """BENCH_MODE=llama-depth: measured 7B-class remat step time by depth
+    extrapolation.  One v5e chip cannot hold llama-2-7b's optimizer state,
+    so this measures the full-width model (hidden 4096 / inter 11008, GQA,
+    bf16, remat ON — the BASELINE.json config-5 recipe) truncated to
+    2 and 4 layers, fits time = overhead + per_layer · L, and extrapolates
+    to the real 32-layer depth.  Transformer step time is linear in depth
+    (identical layers, remat recompute included per layer), so the fit has
+    exactly the two degrees of freedom the two measurements pin down."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from distributed_llms_example_tpu.core.config import MeshConfig
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.models.llama import LlamaForCausalLM
+    from distributed_llms_example_tpu.models.registry import LLAMA_CONFIGS
+    from distributed_llms_example_tpu.train.optim import make_optimizer
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    policy = os.environ.get("BENCH_REMAT_POLICY", "full")
+    batch = int(os.environ.get("BENCH_BATCH_7B", "4"))
+    seq = int(os.environ.get("BENCH_SEQ_7B", "1024"))
+    depths = [int(x) for x in os.environ.get("BENCH_DEPTHS", "2,4").split(",")]
+    steps = max(2, int(os.environ.get("BENCH_STEPS", "4")))
+    base = LLAMA_CONFIGS["llama-2-7b"]
+    mesh = build_mesh(MeshConfig(data=-1))
+    n_chips = jax.device_count()
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(2, base.vocab_size, (batch * n_chips, seq)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, : seq // 4] = LABEL_PAD
+    b = {"input_ids": ids, "attention_mask": np.ones_like(ids), "labels": labels}
+    tokens_per_step = int(np.sum(b["attention_mask"]))
+
+    from distributed_llms_example_tpu.parallel.sharding import infer_param_shardings
+
+    step_ms = {}
+    for L in depths:
+        cfg = dataclasses.replace(base, num_hidden_layers=L)
+        module = LlamaForCausalLM(cfg, dtype=jax.numpy.bfloat16, remat=True, remat_policy=policy)
+
+        # init ON-DEVICE with output shardings: a host round-trip of these
+        # multi-GB trees through the tunneled backend takes minutes and
+        # times the bench out
+        def init_params():
+            return module.init(
+                jax.random.PRNGKey(0), jax.numpy.ones((1, 8), jax.numpy.int32)
+            )["params"]
+
+        shapes = jax.eval_shape(init_params)
+        params = jax.jit(
+            init_params, out_shardings=infer_param_shardings(shapes, mesh)
+        )()
+        tx, schedule = make_optimizer(learning_rate=5e-5, warmup_steps=0, total_steps=1000)
+        state = create_train_state(params, tx)
+        sh = state_shardings(state, mesh)
+        state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+        build = make_train_step(module, cfg, tx, schedule, mesh, is_seq2seq=False)
+        step_fn, _ = build(state)
+        gb = put_batch(b, mesh)
+        for _ in range(2):
+            state, metrics = step_fn(state, gb)
+        _ = float(jax.device_get(metrics["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, gb)
+        _ = float(jax.device_get(metrics["loss"]))
+        _ = jax.device_get(jax.tree.leaves(state.params)[0].ravel()[0])
+        step_ms[L] = (time.perf_counter() - t0) / steps * 1e3
+        del state, params, gb, metrics  # free ~11 GB before the next depth
+
+    l_lo, l_hi = min(depths), max(depths)
+    per_layer = (step_ms[l_hi] - step_ms[l_lo]) / (l_hi - l_lo)
+    overhead = step_ms[l_lo] - l_lo * per_layer
+    t_full_ms = overhead + base.num_hidden_layers * per_layer
+    tps_chip = tokens_per_step / (t_full_ms / 1e3) / n_chips
+    # same analytic method as the 406M baseline constant: 6·N FLOPs/token at
+    # 35% utilization of a 312 TFLOP/s bf16 A100 → ~2,700 tok/s/GPU at 6.74B
+    baseline_7b = 312e12 * 0.35 / (6.0 * 6.74e9)
+    print(
+        json.dumps(
+            {
+                "metric": f"llama-2-7b causal-LM fine-tune throughput, depth-extrapolated "
+                          f"from measured {depths}-layer full-width steps "
+                          f"(seq {seq}, bf16+remat[{policy}], batch {batch})",
+                "value": round(tps_chip, 1),
+                "unit": "tokens/sec/chip (extrapolated)",
+                "vs_baseline": round(tps_chip / baseline_7b, 3),
+                "extrapolated_step_ms": round(t_full_ms, 1),
+                "per_layer_ms": round(per_layer, 2),
+                "non_layer_overhead_ms": round(overhead, 2),
+                "measured_step_ms": {str(k): round(v, 1) for k, v in step_ms.items()},
+                "chips": n_chips,
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
 def main() -> None:
     import jax
     import numpy as np
@@ -267,32 +469,88 @@ def main() -> None:
     tps = tokens_per_step * steps / dt
     tps_chip = tps / n_chips
     mfu = flops_per_step * steps / dt / (n_chips * peak_flops)
-    print(
-        json.dumps(
-            {
-                "metric": f"{name} seq2seq fine-tune train-step throughput "
-                          f"(src1024/tgt128, bf16{'+remat' if remat else ''}, batch {batch})",
-                "value": round(tps_chip, 1),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(tps_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3),
-                "mfu": round(mfu, 4),
-                "model_flops_per_token": round(flops_per_step / tokens_per_step),
-                "params": n_params,
-                "chips": n_chips,
-                "backend": jax.default_backend(),
-                "step_time_ms_sync_inclusive": {
-                    "p50": round(order[len(order) // 2] * 1e3, 1),
-                    "p90": round(order[min(len(order) - 1, int(0.9 * len(order)))] * 1e3, 1),
-                    "min": round(order[0] * 1e3, 1),
-                    "max": round(order[-1] * 1e3, 1),
-                },
-            }
-        )
-    )
+
+    # The Trainer trains with the model's real dropout (bart-large-cnn:
+    # 0.1, the reference's recipe) while the headline synthetic step runs
+    # dropout-free — measured on v5e, dropout alone costs ~20%.  Measure a
+    # with-dropout synthetic pass so the trainer-loop comparison below is
+    # apples-to-apples (trainer ≈ this number ⇒ the input pipeline is off
+    # the critical path; trainer ≈ headline would be impossible).
+    tps_chip_dropout = None
+    if os.environ.get("BENCH_DROPOUT", "1") != "0":
+        try:
+            build_d = make_train_step(lm.module, lm.config, tx, schedule, mesh, with_dropout=True)
+            step_d, _ = build_d(state)
+            key = jax.random.PRNGKey(0)
+            for _ in range(2):
+                key, sub = jax.random.split(key)
+                state, metrics = step_d(state, gb, sub)
+            sync(state, metrics)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                key, sub = jax.random.split(key)
+                state, metrics = step_d(state, gb, sub)
+            sync(state, metrics)
+            dtd = time.perf_counter() - t0
+            tps_chip_dropout = round(tokens_per_step * steps / dtd / n_chips, 1)
+        except Exception as e:
+            print(f"bench: dropout-step bench failed ({e})", file=sys.stderr)
+
+    # the full Trainer loop (bucketed batching + prefetch + logging on the
+    # critical path): validating within ~5% of the with-dropout synthetic
+    # number proves the input pipeline stays off the device's back
+    trainer_loop = None
+    if os.environ.get("BENCH_TRAINER", "1") != "0":
+        # free the synthetic run's device state first: params + AdamW
+        # moments are ~5 GB for the 406M flagship, and the Trainer builds
+        # its own copy — both living at once exhausts a 16 GB chip
+        del state, metrics, gb, params
+        try:
+            trainer_loop = _trainer_loop_bench(
+                name, n_chips, remat=remat,
+                attention=os.environ.get("BENCH_ATTENTION", "") or None,
+            )
+            tl = trainer_loop.get("tokens_per_sec_chip_prefetch2")
+            if tl:
+                trainer_loop["vs_synthetic_step"] = round(tl / tps_chip, 3)
+                if tps_chip_dropout:
+                    trainer_loop["vs_synthetic_step_with_dropout"] = round(
+                        tl / tps_chip_dropout, 3
+                    )
+        except Exception as e:  # never lose the headline number to an add-on
+            print(f"bench: trainer-loop bench failed ({e})", file=sys.stderr)
+            trainer_loop = {"error": str(e)[:300]}
+
+    result = {
+        "metric": f"{name} seq2seq fine-tune train-step throughput "
+                  f"(src1024/tgt128, bf16{'+remat' if remat else ''}, batch {batch})",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3),
+        "mfu": round(mfu, 4),
+        "model_flops_per_token": round(flops_per_step / tokens_per_step),
+        "params": n_params,
+        "chips": n_chips,
+        "backend": jax.default_backend(),
+        "step_time_ms_sync_inclusive": {
+            "p50": round(order[len(order) // 2] * 1e3, 1),
+            "p90": round(order[min(len(order) - 1, int(0.9 * len(order)))] * 1e3, 1),
+            "min": round(order[0] * 1e3, 1),
+            "max": round(order[-1] * 1e3, 1),
+        },
+    }
+    if tps_chip_dropout is not None:
+        result["with_dropout_tokens_per_sec_chip"] = tps_chip_dropout
+    if trainer_loop is not None:
+        result["trainer_loop"] = trainer_loop
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
     if os.environ.get(_BENCH_CHILD) == "1":
-        main()
+        if os.environ.get("BENCH_MODE", "") == "llama-depth":
+            _llama_depth_main()
+        else:
+            main()
     else:
         raise SystemExit(_supervise())

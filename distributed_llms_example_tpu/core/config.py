@@ -112,6 +112,8 @@ class TrainConfig:
     # --- precision / memory ---
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
+    # "" = model default; else "auto" | "flash" | "ring" | "xla" (ops/mha.py)
+    attention_impl: str = ""
     remat: bool = False  # jax.checkpoint the transformer blocks
     remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
     # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
@@ -185,6 +187,11 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--param-dtype", type=str, default=_D.param_dtype)
     p.add_argument("--compute-dtype", type=str, default=_D.compute_dtype)
     p.add_argument("--remat", action="store_true")
+    p.add_argument(
+        "--attention-impl", type=str, default=_D.attention_impl,
+        choices=("", "auto", "flash", "ring", "xla"),
+        help="attention path override; empty = model default (auto)",
+    )
     p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)
     p.add_argument("--moe-capacity-factor", type=float, default=_D.moe_capacity_factor)

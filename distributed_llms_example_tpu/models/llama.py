@@ -140,7 +140,8 @@ class PipelinedLlama:
 
     def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32,
                  num_microbatches: int = 0, remat: bool = True):
-        from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply  # noqa: F401 (validated here, used in apply)
+        # imported here so a missing pipeline module fails at construction
+        from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
 
         if mesh.shape.get("sequence", 1) > 1:
             raise ValueError(

@@ -257,9 +257,11 @@ def load_model(
     """Resolve a model name or local HF checkpoint dir into a LoadedModel.
 
     ``attention_impl`` overrides the config's attention path ("auto" /
-    "flash" / "xla", see ops/mha.py) for families that support it; T5 keeps
-    XLA attention (its learned relative-position bias would get a silent
-    zero gradient from the flash kernel).
+    "flash" / "ring" / "xla", see ops/mha.py) for every family.  T5's
+    learned relative-position bias rides the flash kernel's differentiable
+    ``learned_bias`` input on a single device; multi-device meshes keep
+    XLA for T5 self-attention (see T5Attention._attend) while T5
+    cross-attention takes the same flash/ring paths as BART/LLaMA.
 
     ``moe_capacity_factor`` overrides the MoE expert capacity factor for
     models that have experts.  HF-converted Mixtral checkpoints default to

@@ -31,6 +31,7 @@ from distributed_llms_example_tpu.ops.attention import (
     make_causal_bias,
     mask_to_bias,
 )
+from distributed_llms_example_tpu.ops.flash_attention import flash_attention
 from distributed_llms_example_tpu.ops.norms import RMSNorm
 from distributed_llms_example_tpu.utils.remat import remat_block
 from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
@@ -54,6 +55,12 @@ class T5Config:
     pad_token_id: int = 0
     eos_token_id: int = 1
     decoder_start_token_id: int = 0
+    # "auto": Pallas flash attention where eligible — the learned
+    # relative-position bias rides the kernel's differentiable
+    # ``learned_bias`` input (single-device; multi-device meshes keep XLA
+    # for learned-bias self-attention, see ops/mha.flash_run), and
+    # mask-only cross-attention takes the same paths as BART/LLaMA.
+    attention_impl: str = "auto"
 
     @property
     def decoder_layers(self) -> int:
@@ -146,11 +153,19 @@ class T5Attention(nn.Module):
         bias: jnp.ndarray | None = None,
         *,
         use_cache: bool = False,
+        learned_bias: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
+        """``bias``: constant (mask-like) additive bias.  ``learned_bias``:
+        the (1, H, Q, K) relative-position bias, kept SEPARATE so the flash
+        kernel can treat the mask as constant while computing the learned
+        bias's gradient in its dbias kernel.  When the caller pre-combines
+        everything into ``bias`` (cache decode, the pipeline adapter), the
+        XLA path reproduces round-2 behavior exactly."""
         kv_src = hidden if kv_hidden is None else kv_hidden
         q = self._split(self.q_proj(hidden))
         k = self._split(self.k_proj(kv_src))
         v = self._split(self.v_proj(kv_src))
+        causal_in_bias = False
         if use_cache and self.causal:
             k, v, idx = self._cache_kv(k, v)
             # mask out cache slots beyond the current position
@@ -161,8 +176,67 @@ class T5Attention(nn.Module):
             causal = pos <= (idx + jnp.arange(q_len)[None, None, :, None])
             step_bias = jnp.where(valid & causal, 0.0, NEG_INF)
             bias = step_bias if bias is None else bias + step_bias
-        out = dot_product_attention(q, k, v, bias, scale=1.0, dtype=self.dtype)
+            causal_in_bias = True
+        out = self._attend(q, k, v, bias, learned_bias, use_cache, causal_in_bias)
         return self.o_proj(self._merge(out))
+
+    def _attend(self, q, k, v, bias, learned_bias, use_cache, causal_in_bias):
+        """T5 attention is UNSCALED (scale=1.0).  Selection mirrors
+        MultiHeadAttention: ring on sequence meshes (cross-attention /
+        mask-only biases), Pallas flash on TPU where tileable, XLA
+        otherwise.  A learned bias additionally requires a single device —
+        the shard_map flash path runs check_vma=False and would drop the
+        cross-shard psum of dbias."""
+        from distributed_llms_example_tpu.ops.mha import (
+            _log_impl_once,
+            flash_run,
+            select_attention_impl,
+        )
+        from distributed_llms_example_tpu.ops.ring_attention import ring_attention_sharded
+        from distributed_llms_example_tpu.parallel.activation import current_mesh
+
+        causal_here = self.causal and not use_cache and not causal_in_bias
+        mesh = current_mesh()
+        impl, reason = select_attention_impl(
+            self.config.attention_impl,
+            batch=q.shape[0],
+            heads=self.config.num_heads,
+            head_dim=self.config.d_kv,
+            q_len=q.shape[2],
+            kv_len=k.shape[2],
+            use_cache=use_cache,
+            mesh=mesh,
+            backend=jax.default_backend(),
+            device_count=jax.device_count(),
+            causal=causal_here,
+            bias_kv_only=(
+                False
+                if learned_bias is not None
+                else None if bias is None else (bias.shape[1] == 1 and bias.shape[2] == 1)
+            ),
+        )
+        if impl == "flash" and learned_bias is not None and jax.device_count() > 1:
+            impl, reason = "xla", "learned bias needs single-device flash (dbias psum)"
+        _log_impl_once(f"t5:{impl}", reason)
+        if impl == "ring":
+            return ring_attention_sharded(
+                q, k, v, bias, mesh=mesh, causal=causal_here, scale=1.0, dtype=self.dtype
+            )
+        if impl == "flash":
+            if learned_bias is not None:
+                return flash_attention(
+                    q, k, v, bias, learned_bias=learned_bias,
+                    causal=causal_here, scale=1.0, dtype=self.dtype,
+                )
+            return flash_run(
+                q, k, v, bias, causal=causal_here, mesh=mesh, dtype=self.dtype, scale=1.0
+            )
+        if causal_here:
+            step = make_causal_bias(q.shape[2], k.shape[2])
+            bias = step if bias is None else bias + step
+        if learned_bias is not None:
+            bias = learned_bias if bias is None else bias + learned_bias
+        return dot_product_attention(q, k, v, bias, scale=1.0, dtype=self.dtype)
 
 
 class T5MLP(nn.Module):
@@ -208,10 +282,16 @@ class T5Block(nn.Module):
         cross_bias: jnp.ndarray | None = None,
         deterministic: bool = True,
         use_cache: bool = False,
+        pos_bias: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         # deterministic/use_cache are positional so nn.remat can mark them
-        # static (argnums 5, 6 counting self at 0)
-        h = self.self_attn(self.self_attn_norm(hidden), bias=self_bias, use_cache=use_cache)
+        # static (argnums 5, 6 counting self at 0); pos_bias is the learned
+        # relative-position bias kept separate from the (constant) mask in
+        # self_bias so the flash kernel can compute its gradient
+        h = self.self_attn(
+            self.self_attn_norm(hidden), bias=self_bias, use_cache=use_cache,
+            learned_bias=pos_bias,
+        )
         hidden = hidden + self.dropout(h, deterministic=deterministic)
         if self.has_cross:
             h = self.cross_attn(self.cross_attn_norm(hidden), kv_hidden=encoder_hidden, bias=cross_bias)
@@ -273,25 +353,31 @@ class T5Stack(nn.Module):
         max_kv_len: int | None = None,
     ) -> jnp.ndarray:
         q_len = hidden.shape[1]
+        pos_bias = None
         if use_cache and self.causal:
             # Incremental decoding: relative bias of the current step(s)
             # against the full cache buffer (max_kv_len); masking of not-yet-
             # written cache slots + causality is added inside T5Attention.
+            # Decode always takes the XLA path, so the learned bias can ride
+            # the combined (constant-treated) bias — no gradients in decode.
             if max_kv_len is None:
                 raise ValueError("max_kv_len is required when decoding with a cache")
             self_bias = self.position_bias(q_len, max_kv_len, offset=cache_offset)
         else:
-            self_bias = self.position_bias(q_len, q_len)
-            if self.causal:
-                self_bias = self_bias + make_causal_bias(q_len, q_len)
-            if attention_mask is not None:
-                self_bias = self_bias + mask_to_bias(attention_mask)
+            # keep the LEARNED bias separate from the constant mask:
+            # T5Attention routes it through the flash kernel's
+            # differentiable learned_bias input (causality is the
+            # attention impl's job — flash applies it natively)
+            pos_bias = self.position_bias(q_len, q_len)
+            self_bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
         hidden = self.dropout(hidden, deterministic=deterministic)
         for blk in self.blocks:
             # re-anchor the residual stream every layer so GSPMD never
             # propagates a param sharding (d_model over fsdp/tensor) into it
-            hidden = constrain_hidden(blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache))
+            hidden = constrain_hidden(
+                blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache, pos_bias)
+            )
         return self.dropout(self.final_norm(hidden), deterministic=deterministic)
 
 
@@ -444,21 +530,28 @@ class PipelinedT5:
         bias = jnp.take(table, buckets, axis=0)  # (q, kv, heads)
         return bias.transpose(2, 0, 1)[None].astype(self.dtype)
 
-    def _run_stack(self, stack_params, block, hidden, self_bias, extras):
+    def _run_stack(self, stack_params, block, hidden, self_bias, pos_bias, extras):
         from distributed_llms_example_tpu.parallel.activation import activation_mesh
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
 
         ex = {k: v for k, v in extras.items() if v is not None}
+        if self_bias is not None:
+            ex["self_bias"] = self_bias
+        if pos_bias is not None:
+            # the LEARNED bias rides its own slot all the way into
+            # T5Attention.learned_bias — pre-combining it into the constant
+            # mask would zero its gradient on any flash-selected path
+            ex["pos_bias"] = pos_bias
 
         def layer_fn(lp, h, e):
             with activation_mesh(None):
                 return block.apply(
-                    {"params": lp}, h, e.get("self_bias"), e.get("enc"), e.get("cross_bias"), True
+                    {"params": lp}, h, e.get("self_bias"), e.get("enc"),
+                    e.get("cross_bias"), True, False, e.get("pos_bias"),
                 )
 
         hidden = pipeline_apply(
-            layer_fn, stack_params["stacked_blocks"], hidden,
-            {**ex, "self_bias": self_bias},
+            layer_fn, stack_params["stacked_blocks"], hidden, ex,
             mesh=self.mesh, num_microbatches=self.num_microbatches, checkpoint=self.remat,
         )
         return self._norm.apply({"params": stack_params["final_norm"]}, hidden)
@@ -473,19 +566,23 @@ class PipelinedT5:
 
         q_len = input_ids.shape[1]
         enc_table = p["encoder"]["relative_attention_bias"]["embedding"]
-        self_bias = self._position_bias(enc_table, q_len, causal=False)
-        if attention_mask is not None:
-            self_bias = self_bias + mask_to_bias(attention_mask)
-        enc = self._run_stack(p["encoder"], self._enc_block, shared(input_ids), self_bias, {})
+        enc_pos = self._position_bias(enc_table, q_len, causal=False)
+        enc_mask = mask_to_bias(attention_mask) if attention_mask is not None else None
+        enc = self._run_stack(
+            p["encoder"], self._enc_block, shared(input_ids), enc_mask, enc_pos, {}
+        )
 
         d_len = decoder_input_ids.shape[1]
         dec_table = p["decoder"]["relative_attention_bias"]["embedding"]
-        dec_bias = self._position_bias(dec_table, d_len, causal=True) + make_causal_bias(d_len, d_len)
-        if decoder_attention_mask is not None:
-            dec_bias = dec_bias + mask_to_bias(decoder_attention_mask)
+        dec_pos = self._position_bias(dec_table, d_len, causal=True)
+        # causality is the attention impl's job (T5Block's decoder
+        # self-attention has causal=True); only the padding mask goes in
+        dec_mask = (
+            mask_to_bias(decoder_attention_mask) if decoder_attention_mask is not None else None
+        )
         cross_bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         hidden = self._run_stack(
-            p["decoder"], self._dec_block, shared(decoder_input_ids), dec_bias,
+            p["decoder"], self._dec_block, shared(decoder_input_ids), dec_mask, dec_pos,
             {"enc": enc, "cross_bias": cross_bias},
         )
         if cfg.tie_word_embeddings:

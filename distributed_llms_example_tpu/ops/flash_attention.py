@@ -11,14 +11,18 @@ Layout/conventions
   - q, k, v: (batch, heads, seq, head_dim); output matches q.
   - ``bias`` is additive, fp32-convertible, with every dim either 1 or the
     full size — e.g. a (B, 1, 1, K) padding mask from
-    ``ops.attention.mask_to_bias`` or a (1, H, Q, K) T5 relative-position
-    bias.  Size-1 dims are handled in the BlockSpec index maps, so the bias
-    is never broadcast in HBM.
+    ``ops.attention.mask_to_bias``.  Size-1 dims are handled in the
+    BlockSpec index maps, so the bias is never broadcast in HBM.
+  - ``learned_bias`` is a second additive bias of shape exactly
+    (1, H, Q, K) — T5's relative-position bias — that DOES receive a
+    gradient: a third backward kernel accumulates dbias = p·(dp − δ)
+    tile-by-tile with batch as the innermost (sequential) grid axis, so
+    the (B, H, Q, K) un-reduced gradient is never materialized in HBM.
   - ``causal=True`` applies the triangular mask inside the kernel (and
     skips fully-masked kv tiles); don't also encode causality in ``bias``.
-  - The backward pass treats ``bias`` as a constant (zero gradient).  All
-    in-tree uses are padding/causal masks; T5's *learned* relative bias
-    keeps the XLA attention path (models/t5.py).
+  - The backward pass treats ``bias`` as a constant (zero gradient) —
+    padding/causal masks only; learned additive biases go through
+    ``learned_bias``.
   - Softmax statistics (running max ``m``, denominator ``l``) live in
     (block_q, 128) fp32 scratch — TPU vector layout wants a full 128-lane
     last dim — and the logsumexp residual is saved as (B, H, S, 128) with
@@ -72,13 +76,13 @@ def _causal_mask(s, qi, ki, block_q: int, block_k: int):
 
 def _fwd_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
-    has_bias: bool,
+    has_bias: bool, has_lbias: bool,
 ):
-    if has_bias:
-        q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-        bias_ref = None
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    lbias_ref = next(it) if has_lbias else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = it
     qi, ki = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -100,6 +104,8 @@ def _fwd_kernel(
         s *= scale
         if bias_ref is not None:
             s += bias_ref[0, 0].astype(jnp.float32)
+        if lbias_ref is not None:
+            s += lbias_ref[0, 0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
 
@@ -127,7 +133,7 @@ def _fwd_kernel(
         lse_ref[0, 0] = jnp.where(l_scr[:] == 0.0, MASK_VALUE, lse)
 
 
-def _fwd(q, k, v, bias, *, scale, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, bias, lbias, *, scale, causal, block_q, block_k, interpret):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     nq, nk = q_len // block_q, kv_len // block_k
@@ -146,6 +152,8 @@ def _fwd(q, k, v, bias, *, scale, causal, block_q, block_k, interpret):
     ]
     if bias is not None:
         in_specs.append(_bias_spec(bias.shape, block_q, block_k))
+    if lbias is not None:
+        in_specs.append(_bias_spec(lbias.shape, block_q, block_k))
     out_shape = [
         jax.ShapeDtypeStruct(q.shape, q.dtype),
         jax.ShapeDtypeStruct((batch, heads, q_len, LANES), jnp.float32),
@@ -156,7 +164,8 @@ def _fwd(q, k, v, bias, *, scale, causal, block_q, block_k, interpret):
     ]
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk, has_bias=bias is not None,
+        block_q=block_q, block_k=block_k, nk=nk,
+        has_bias=bias is not None, has_lbias=lbias is not None,
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -173,7 +182,7 @@ def _fwd(q, k, v, bias, *, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(*[x for x in (q, k, v, bias) if x is not None])
+    )(*[x for x in (q, k, v, bias, lbias) if x is not None])
     return o, lse
 
 
@@ -182,13 +191,13 @@ def _fwd(q, k, v, bias, *, scale, causal, block_q, block_k, interpret):
 
 def _bwd_dq_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
-    has_bias: bool,
+    has_bias: bool, has_lbias: bool,
 ):
-    if has_bias:
-        q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
-    else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
-        bias_ref = None
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    lbias_ref = next(it) if has_lbias else None
+    do_ref, lse_ref, delta_ref, dq_ref, dq_scr = it
     qi, ki = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -207,6 +216,8 @@ def _bwd_dq_kernel(
         s *= scale
         if bias_ref is not None:
             s += bias_ref[0, 0].astype(jnp.float32)
+        if lbias_ref is not None:
+            s += lbias_ref[0, 0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0, 0][:, :1])  # (block_q, block_k)
@@ -226,15 +237,13 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int, nq: int,
-    has_bias: bool,
+    has_bias: bool, has_lbias: bool,
 ):
-    if has_bias:
-        (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-        bias_ref = None
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    lbias_ref = next(it) if has_lbias else None
+    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = it
     ki, qi = pl.program_id(2), pl.program_id(3)
 
     @pl.when(qi == 0)
@@ -254,6 +263,8 @@ def _bwd_dkv_kernel(
         s *= scale
         if bias_ref is not None:
             s += bias_ref[0, 0].astype(jnp.float32)
+        if lbias_ref is not None:
+            s += lbias_ref[0, 0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0, 0][:, :1])
@@ -276,7 +287,113 @@ def _bwd_dkv_kernel(
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_k, interpret):
+def _bwd_dlbias_kernel(
+    *refs, scale: float, causal: bool, block_q: int, block_k: int, nb: int,
+    has_bias: bool,
+):
+    """Gradient of the LEARNED (1, H, Q, K) bias: dbias = Σ_batch p·(dp−δ).
+
+    Grid is (heads, q-tiles, k-tiles, batch) with batch innermost and
+    "arbitrary", so the (block_q, block_k) scratch accumulates the batch
+    reduction across grid steps and the un-reduced (B, H, Q, K) gradient
+    never exists in HBM.  Recomputes s/p per tile from the residuals (same
+    trade the dq/dkv kernels make)."""
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    lbias_ref, do_ref, lse_ref, delta_ref, dlb_ref, dlb_scr = it
+    qi, ki, bi = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+
+    @pl.when(bi == 0)
+    def _init():
+        dlb_scr[:] = jnp.zeros(dlb_scr.shape, jnp.float32)
+
+    diag_ok = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(diag_ok)
+    def _compute():
+        q, kk, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        do = do_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= scale
+        if bias_ref is not None:
+            s += bias_ref[0, 0].astype(jnp.float32)
+        s += lbias_ref[0, 0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        # masked entries have s = MASK_VALUE → p underflows to exactly 0,
+        # so they contribute nothing to the bias gradient
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # ∂s/∂lbias = 1 (no scale factor — scale multiplies only q·k)
+        dlb_scr[:] += p * (dp - delta_ref[0, 0][:, :1])
+
+    @pl.when(bi == nb - 1)
+    def _finish():
+        dlb_ref[0, 0] = dlb_scr[:].astype(dlb_ref.dtype)
+
+
+def _bwd_dlbias(q, k, v, bias, lbias, lse, delta, do, *, scale, causal,
+                block_q, block_k, interpret):
+    batch, heads, q_len, d = q.shape
+    kv_len = k.shape[2]
+    nq, nk = q_len // block_q, kv_len // block_k
+    grid = (heads, nq, nk, batch)
+
+    def q_map(h, qi, ki, b):
+        return (b, h, qi, 0)
+
+    def kv_map(h, qi, ki, b):
+        return (b, h, ki, 0)
+
+    def lb_map(h, qi, ki, b):
+        return (0, h, qi, ki)
+
+    bias_spec = None
+    if bias is not None:
+        inner = _bias_spec(bias.shape, block_q, block_k)
+
+        def reordered(h, qi, ki, b):
+            return inner.index_map(b, h, qi, ki)
+
+        bias_spec = pl.BlockSpec(inner.block_shape, reordered)
+    in_specs = [
+        spec
+        for spec in (
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            bias_spec,
+            pl.BlockSpec((1, 1, block_q, block_k), lb_map),
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_q, LANES), q_map),
+            pl.BlockSpec((1, 1, block_q, LANES), q_map),
+        )
+        if spec is not None
+    ]
+    args = [x for x in (q, k, v, bias, lbias, do, lse, delta) if x is not None]
+    return pl.pallas_call(
+        functools.partial(
+            _bwd_dlbias_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, nb=batch, has_bias=bias is not None,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, block_k), lb_map),
+        out_shape=jax.ShapeDtypeStruct(lbias.shape, lbias.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+
+
+def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, interpret):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     nq, nk = q_len // block_q, kv_len // block_k
@@ -294,6 +411,7 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_k, interpre
         return (b, h, ki, 0)
 
     bias_spec = _bias_spec(bias.shape, block_q, block_k) if bias is not None else None
+    lbias_spec = _bias_spec(lbias.shape, block_q, block_k) if lbias is not None else None
     common_in = [
         spec
         for spec in (
@@ -301,18 +419,20 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_k, interpre
             pl.BlockSpec((1, 1, block_k, d), kv_map_q),
             pl.BlockSpec((1, 1, block_k, d), kv_map_q),
             bias_spec,
+            lbias_spec,
             pl.BlockSpec((1, 1, block_q, d), q_map),
             pl.BlockSpec((1, 1, block_q, LANES), q_map),
             pl.BlockSpec((1, 1, block_q, LANES), q_map),
         )
         if spec is not None
     ]
-    args = [x for x in (q, k, v, bias, do, lse, delta) if x is not None]
+    args = [x for x in (q, k, v, bias, lbias, do, lse, delta) if x is not None]
 
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, nk=nk, has_bias=bias is not None,
+            block_q=block_q, block_k=block_k, nk=nk,
+            has_bias=bias is not None, has_lbias=lbias is not None,
         ),
         grid=(batch, heads, nq, nk),
         in_specs=common_in,
@@ -332,22 +452,24 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_k, interpre
     def kv_map_kv(b, h, ki, qi):
         return (b, h, ki, 0)
 
-    if bias is not None:
-        inner = _bias_spec(bias.shape, block_q, block_k)
+    def _swap_spec(x):
+        if x is None:
+            return None
+        inner = _bias_spec(x.shape, block_q, block_k)
 
         def swapped(b, h, ki, qi):
             return inner.index_map(b, h, qi, ki)
 
-        bias_spec_kv = pl.BlockSpec(inner.block_shape, swapped)
-    else:
-        bias_spec_kv = None
+        return pl.BlockSpec(inner.block_shape, swapped)
+
     dkv_in = [
         spec
         for spec in (
             pl.BlockSpec((1, 1, block_q, d), q_map_kv),
             pl.BlockSpec((1, 1, block_k, d), kv_map_kv),
             pl.BlockSpec((1, 1, block_k, d), kv_map_kv),
-            bias_spec_kv,
+            _swap_spec(bias),
+            _swap_spec(lbias),
             pl.BlockSpec((1, 1, block_q, d), q_map_kv),
             pl.BlockSpec((1, 1, block_q, LANES), q_map_kv),
             pl.BlockSpec((1, 1, block_q, LANES), q_map_kv),
@@ -357,7 +479,8 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_k, interpre
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, nq=nq, has_bias=bias is not None,
+            block_q=block_q, block_k=block_k, nq=nq,
+            has_bias=bias is not None, has_lbias=lbias is not None,
         ),
         grid=(batch, heads, nk, nq),
         in_specs=dkv_in,
@@ -378,44 +501,51 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_k, interpre
         ),
         interpret=interpret,
     )(*args)
-    return dq, dk, dv
+    dlbias = None
+    if lbias is not None:
+        dlbias = _bwd_dlbias(
+            q, k, v, bias, lbias, lse, delta, do,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+    return dq, dk, dv, dlbias
 
 
 # ------------------------------------------------------------- public API
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
 )
-def _flash(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+def _flash(q, k, v, bias, lbias, scale, causal, block_q, block_k, interpret):
     o, _ = _fwd(
-        q, k, v, bias, scale=scale, causal=causal,
+        q, k, v, bias, lbias, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return o
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, lbias, scale, causal, block_q, block_k, interpret):
     o, lse = _fwd(
-        q, k, v, bias, scale=scale, causal=causal,
+        q, k, v, bias, lbias, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     # the kernel replicates lse across all 128 lanes — keep one lane as the
     # residual so HBM between fwd and bwd holds (B,H,S,1), not (B,H,S,128)
-    return o, (q, k, v, bias, o, lse[..., :1])
+    return o, (q, k, v, bias, lbias, o, lse[..., :1])
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, bias, o, lse_lane = res
+    q, k, v, bias, lbias, o, lse_lane = res
     lse = jax.lax.broadcast_in_dim(
         lse_lane[..., 0], (*lse_lane.shape[:-1], LANES), (0, 1, 2)
     )
-    dq, dk, dv = _bwd(
-        q, k, v, bias, o, lse, do, scale=scale, causal=causal,
+    dq, dk, dv, dlbias = _bwd(
+        q, k, v, bias, lbias, o, lse, do, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     dbias = None if bias is None else jnp.zeros_like(bias)  # bias is a mask
-    return dq, dk, dv, dbias
+    return dq, dk, dv, dbias, dlbias
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -450,6 +580,7 @@ def flash_attention(
     v: jnp.ndarray,
     bias: jnp.ndarray | None = None,
     *,
+    learned_bias: jnp.ndarray | None = None,
     causal: bool = False,
     scale: float | None = None,
     block_q: int | None = None,
@@ -470,10 +601,12 @@ def flash_attention(
     drop-in API, not just an internal kernel):
 
     - ``bias`` is treated as a CONSTANT mask: its gradient is zero.  Do not
-      route a *learned* additive bias (ALiBi slopes, T5 relative-position
-      tables) through it — that bias would silently stop training.  All
-      in-tree callers pass padding/causal masks only; T5's learned bias
-      keeps the XLA attention path (models/t5.py).
+      route a *learned* additive bias through it — that bias would silently
+      stop training.  Learned biases go through ``learned_bias``.
+    - ``learned_bias`` must be exactly (1, heads, q_len, kv_len) — T5's
+      relative-position bias shape.  It is differentiable: the backward
+      pass runs a third kernel that accumulates its gradient over the
+      batch grid axis without materializing (B, H, Q, K) in HBM.
     - ``causal=True`` requires ``q_len == kv_len``.  The mask is top-left
       aligned (q_pos >= k_pos with no kv offset), which is only meaningful
       for square self-attention; decode-style bottom-right alignment with
@@ -507,9 +640,16 @@ def flash_attention(
         ):
             if bd not in (1, full):
                 raise ValueError(f"bias dim {i} is {bd}, must be 1 or {full}")
+    if learned_bias is not None:
+        want = (1, q.shape[1], q.shape[2], k.shape[2])
+        if tuple(learned_bias.shape) != want:
+            raise ValueError(
+                f"learned_bias shape {tuple(learned_bias.shape)} must be exactly "
+                f"{want} (batch dim 1 is what the dbias kernel reduces over)"
+            )
     if interpret is None:
         interpret = _default_interpret()
-    out = _flash(q, k, v, bias, float(scale), bool(causal),
+    out = _flash(q, k, v, bias, learned_bias, float(scale), bool(causal),
                  int(block_q), int(block_k), bool(interpret))
     return out if dtype is None else out.astype(dtype)
 

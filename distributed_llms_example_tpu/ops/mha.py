@@ -315,32 +315,49 @@ class MultiHeadAttention(nn.Module):
         causal: bool,
         mesh: Mesh | None,
     ) -> jnp.ndarray:
-        """Run the Pallas kernel — directly on one device, per-shard under
-        ``shard_map`` on a mesh (batch over data×fsdp, heads over tensor;
-        attention itself never mixes batches or heads, so the kernel body
-        needs no collectives)."""
-        if mesh is None or math.prod(mesh.devices.shape) == 1:
-            return flash_attention(q, k, v, bias, causal=causal, dtype=self.dtype)
-        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
-        head_axis = "tensor" if "tensor" in mesh.shape else None
-        qkv_spec = P(batch_axes or None, head_axis, None, None)
+        return flash_run(q, k, v, bias, causal=causal, mesh=mesh, dtype=self.dtype)
 
-        def run(q, k, v, *rest):
-            return flash_attention(
-                q, k, v, rest[0] if rest else None, causal=causal, dtype=self.dtype
-            )
 
-        args = (q, k, v)
-        in_specs = (qkv_spec, qkv_spec, qkv_spec)
-        if bias is not None:
-            bias_spec = P(
-                (batch_axes or None) if bias.shape[0] != 1 else None,
-                head_axis if bias.shape[1] != 1 else None,
-                None,
-                None,
-            )
-            args = (*args, bias)
-            in_specs = (*in_specs, bias_spec)
-        return jax.shard_map(
-            run, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
-        )(*args)
+def flash_run(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None,
+    *,
+    causal: bool,
+    mesh: Mesh | None,
+    dtype: jnp.dtype,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Run the Pallas kernel — directly on one device, per-shard under
+    ``shard_map`` on a mesh (batch over data×fsdp×expert, heads over
+    tensor; attention itself never mixes batches or heads, so the kernel
+    body needs no collectives).  Constant-mask biases only: the shard_map
+    runs with check_vma=False, under which a learned bias's gradient would
+    silently miss its cross-shard psum — learned-bias flash is the
+    single-device path in T5Attention."""
+    if mesh is None or math.prod(mesh.devices.shape) == 1:
+        return flash_attention(q, k, v, bias, causal=causal, dtype=dtype, scale=scale)
+    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
+    head_axis = "tensor" if "tensor" in mesh.shape else None
+    qkv_spec = P(batch_axes or None, head_axis, None, None)
+
+    def run(q, k, v, *rest):
+        return flash_attention(
+            q, k, v, rest[0] if rest else None, causal=causal, dtype=dtype, scale=scale
+        )
+
+    args = (q, k, v)
+    in_specs = (qkv_spec, qkv_spec, qkv_spec)
+    if bias is not None:
+        bias_spec = P(
+            (batch_axes or None) if bias.shape[0] != 1 else None,
+            head_axis if bias.shape[1] != 1 else None,
+            None,
+            None,
+        )
+        args = (*args, bias)
+        in_specs = (*in_specs, bias_spec)
+    return jax.shard_map(
+        run, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
+    )(*args)

@@ -79,6 +79,7 @@ class Trainer:
         self.loaded = load_model(
             cfg.model_ckpt, dtype=compute_dtype, remat=cfg.remat, remat_policy=cfg.remat_policy,
             moe_capacity_factor=cfg.moe_capacity_factor,
+            attention_impl=cfg.attention_impl or None,
         )
         self.model, self.config = self.loaded.module, self.loaded.config
 
@@ -502,10 +503,13 @@ class Trainer:
                         log_json({"event": "profile_trace", "dir": cfg.profile_dir, "steps": cfg.profile_steps})
                         profiling_active = False
                     tokens = self._batch_tokens(batch) * jax.process_count()
+                    # pass DEVICE scalars: converting here (float(...)) would
+                    # block on the step every iteration and serialize JAX's
+                    # async dispatch — the logger converts only on emit
                     logger.step(
                         step,
-                        float(metrics["loss"]),
-                        lr=float(metrics["learning_rate"]),
+                        metrics["loss"],
+                        lr=metrics["learning_rate"],
                         tokens=tokens,
                         epoch=epoch,
                     )

@@ -52,7 +52,11 @@ class MetricLogger:
         self._tokens_since = 0
         self._steps_since = 0
 
-    def step(self, step: int, loss: float, lr: float | None = None, tokens: int = 0, **extra: Any) -> None:
+    def step(self, step: int, loss: Any, lr: Any = None, tokens: int = 0, **extra: Any) -> None:
+        """``loss``/``lr`` may be 0-d device arrays: they are converted to
+        host floats ONLY on emitting steps (``log_json``'s ``.item()``), so
+        non-logging steps cost zero device syncs and async dispatch keeps
+        pipelining across the logging cadence."""
         self._tokens_since += tokens
         self._steps_since += 1
         if step % self.every != 0:

@@ -78,3 +78,40 @@ def test_bart_flash_cached_generation_falls_back():
         gen = make_greedy_generate(mod, dataclasses.replace(cfg, attention_impl=impl), max_new_tokens=12)
         toks[impl] = np.asarray(gen(params, src, src_mask))
     np.testing.assert_array_equal(toks["xla"], toks["flash"])
+
+
+def test_t5_flash_matches_xla_incl_bias_table_grad():
+    """T5 with attention_impl='flash': the learned relative-position bias
+    rides the kernel's differentiable learned_bias input — logits AND
+    gradients (including the bias tables) must match the XLA path, and the
+    table gradients must be nonzero (a silently-constant bias was exactly
+    the round-2 failure mode this guards against)."""
+    from distributed_llms_example_tpu.models.registry import T5_CONFIGS
+    from distributed_llms_example_tpu.models.t5 import T5ForConditionalGeneration
+
+    cfg = dataclasses.replace(T5_CONFIGS["t5-test"], dropout_rate=0.0)
+    mods = _variants(cfg, T5ForConditionalGeneration)
+    rng = np.random.RandomState(2)
+    src = jnp.asarray(rng.randint(3, cfg.vocab_size, (2, 64)), jnp.int32)
+    src_mask = jnp.ones((2, 64), jnp.int32).at[1, 48:].set(0)
+    tgt = jnp.asarray(rng.randint(3, cfg.vocab_size, (2, 32)), jnp.int32)
+    params = mods["xla"].init(jax.random.PRNGKey(3), src, src_mask, tgt)["params"]
+
+    def loss(m):
+        def f(p):
+            logits = m.apply({"params": p}, src, src_mask, tgt)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        return jax.value_and_grad(f)(params)
+
+    (l_x, g_x), (l_f, g_f) = loss(mods["xla"]), loss(mods["flash"])
+    np.testing.assert_allclose(float(l_x), float(l_f), rtol=1e-5)
+    paths_x = jax.tree_util.tree_flatten_with_path(g_x)[0]
+    paths_f = jax.tree.leaves(g_f)
+    for (path, a), b in zip(paths_x, paths_f):
+        name = jax.tree_util.keystr(path)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3, err_msg=name
+        )
+        if "relative_attention_bias" in name:
+            assert np.abs(np.asarray(b)).sum() > 0, f"{name}: zero bias-table grad"

@@ -276,7 +276,14 @@ class Trainer:
             if self.pipelined:
                 from distributed_llms_example_tpu.parallel.pipeline import unstack_for_family
 
-                eval_params = unstack_for_family(self.loaded.family, eval_params)
+                # unstack to the standard per-layer layout, then RE-SHARD
+                # with the default FSDP/TP rules: indexing a stage-sharded
+                # stack yields replicated layers, but generation only needs
+                # params/(fsdp·tensor) per device once resharded — the
+                # eval memory cliff shrinks to the normal FSDP story
+                eval_params = shard_params(
+                    unstack_for_family(self.loaded.family, eval_params), self.mesh
+                )
             eval_batch = self.cfg.eval_batch_size or self.cfg.batch_size
             pc = jax.process_count()
             eval_batch = min(eval_batch, max(pc, len(self.val_ds)))

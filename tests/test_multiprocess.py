@@ -162,6 +162,11 @@ def test_two_process_loss_parity(tmp_path):
         assert eval_multi[k] == pytest.approx(eval_single[k], abs=1e-6)
     # metrics logging is process-0-only: rank 1 must not emit step lines
     assert not _step_losses(_events(outs[1][1]))
+    # the final artifact is an HF checkpoint written collaboratively into
+    # the shared dir (params gathered across hosts, process 0 writes)
+    model_dir = tmp_path / "multi" / "model"
+    assert (model_dir / "model.safetensors").is_file()
+    assert (model_dir / "config.json").is_file()
 
 
 @pytest.mark.slow

@@ -62,3 +62,17 @@ def test_sharded_init_on_mesh(mesh8):
     emb = sharded["shared"]["embedding"]  # (256, 64): vocab over tensor*fsdp=4, d replicated
     assert {s.data.shape for s in emb.addressable_shards} == {(64, 64)}
     assert sorted(T5_CONFIGS) == ["flan-t5-xl", "t5-base", "t5-large", "t5-small", "t5-test"]
+
+
+def test_attention_impl_flag_reaches_config():
+    """--attention-impl threads CLI → TrainConfig → load_model → model
+    config for every family (T5 included since its flash path landed)."""
+    from distributed_llms_example_tpu.core.config import TrainConfig
+    from distributed_llms_example_tpu.models.registry import load_model
+
+    assert TrainConfig().attention_impl == ""  # default: model's own choice
+    for name in ("t5-test", "bart-test", "llama-test"):
+        lm = load_model(name, attention_impl="xla")
+        assert lm.config.attention_impl == "xla", name
+        lm = load_model(name)
+        assert lm.config.attention_impl == "auto", name

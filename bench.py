@@ -70,9 +70,10 @@ def _supervise() -> int:
         # at the full attempt_timeout still leaves room for the degraded
         # (headline-only) retry instead of exhausting the budget outright
         remaining = budget - (time.monotonic() - t_start)
-        if remaining < 120:
+        if i > 0 and remaining < 120:  # always give attempt 1 its shot
             print("bench: total budget exhausted, giving up", file=sys.stderr)
             break
+        remaining = max(remaining, 60.0)
         try:
             proc = subprocess.run(
                 [sys.executable, here],

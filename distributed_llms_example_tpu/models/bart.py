@@ -15,6 +15,7 @@ import dataclasses
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distributed_llms_example_tpu.ops.attention import mask_to_bias
@@ -256,10 +257,12 @@ class PipelinedBart:
     ``stacked_encoder_blocks`` / ``stacked_decoder_blocks``
     (``stack_for_family("bart", ...)``).  Embeddings / logits run outside
     the pipelines under plain GSPMD; ``stage`` composes with data/fsdp and
-    ``tensor`` (partial-manual shard_map), not ``sequence``.  Deterministic
-    only: dropout is disabled under the pipeline (the Trainer logs this) —
-    threading per-microbatch RNGs through the stage loop is not supported.
-    Training + teacher-forced scoring only (no KV-cache generation path).
+    ``tensor`` (partial-manual shard_map), not ``sequence``.  Dropout is
+    fully supported: pass ``deterministic=False`` with a ``dropout`` rng —
+    the key is folded per (pipeline, microbatch, stage, layer) inside the
+    stage loop so every layer of every microbatch draws an independent
+    mask.  Training + teacher-forced scoring only (no KV-cache generation
+    path).
     """
 
     def __init__(self, config: BartConfig, mesh, dtype=jnp.float32,
@@ -288,10 +291,19 @@ class PipelinedBart:
         h = shared * cfg.embed_scale + self._pos.apply({"params": params[pos_key]}, pos)[None]
         return constrain_hidden(self._ln.apply({"params": params[ln_key]}, h))
 
+    def _dropout(self, x, key):
+        from distributed_llms_example_tpu.parallel.pipeline import dropout
+
+        return dropout(x, key, self.config.dropout_rate)
+
     def apply(self, variables, input_ids, attention_mask=None, decoder_input_ids=None,
               decoder_attention_mask=None, *, deterministic: bool = True, rngs=None):
         from distributed_llms_example_tpu.parallel.activation import activation_mesh
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
+
+        rng = None
+        if not deterministic and rngs and "dropout" in rngs and self.config.dropout_rate > 0:
+            rng = rngs["dropout"]
 
         p = variables["params"]
         shared = lambda ids: self._shared.apply({"params": p["shared"]}, ids)  # noqa: E731
@@ -299,34 +311,49 @@ class PipelinedBart:
 
         hidden = self._embed(p, shared(input_ids), input_ids,
                              "encoder_embed_positions", "encoder_layernorm_embedding")
+        if rng is not None:
+            hidden = self._dropout(hidden, jax.random.fold_in(rng, 2))
 
-        def enc_fn(lp, h, ex):
+        def enc_fn(lp, h, ex, key=None):
             with activation_mesh(None):
-                return self._enc_layer.apply({"params": lp}, h, ex.get("bias"), True)
+                if key is None:
+                    return self._enc_layer.apply({"params": lp}, h, ex.get("bias"), True)
+                return self._enc_layer.apply(
+                    {"params": lp}, h, ex.get("bias"), False, rngs={"dropout": key}
+                )
 
         hidden = pipeline_apply(
             enc_fn, p["stacked_encoder_blocks"], hidden,
             {"bias": enc_bias} if enc_bias is not None else {},
             mesh=self.mesh, num_microbatches=self.num_microbatches, checkpoint=self.remat,
+            rng=None if rng is None else jax.random.fold_in(rng, 0),
         )
 
         dh = self._embed(p, shared(decoder_input_ids), decoder_input_ids,
                          "decoder_embed_positions", "decoder_layernorm_embedding")
+        if rng is not None:
+            dh = self._dropout(dh, jax.random.fold_in(rng, 3))
         extras = {"enc": hidden}
         if enc_bias is not None:
             extras["cross_bias"] = enc_bias
         if decoder_attention_mask is not None:
             extras["self_bias"] = mask_to_bias(decoder_attention_mask)
 
-        def dec_fn(lp, h, ex):
+        def dec_fn(lp, h, ex, key=None):
             with activation_mesh(None):
+                if key is None:
+                    return self._dec_layer.apply(
+                        {"params": lp}, h, ex.get("self_bias"), ex["enc"], ex.get("cross_bias"), True
+                    )
                 return self._dec_layer.apply(
-                    {"params": lp}, h, ex.get("self_bias"), ex["enc"], ex.get("cross_bias"), True
+                    {"params": lp}, h, ex.get("self_bias"), ex["enc"], ex.get("cross_bias"),
+                    False, rngs={"dropout": key},
                 )
 
         dh = pipeline_apply(
             dec_fn, p["stacked_decoder_blocks"], dh, extras,
             mesh=self.mesh, num_microbatches=self.num_microbatches, checkpoint=self.remat,
+            rng=None if rng is None else jax.random.fold_in(rng, 1),
         )
         logits = constrain_logits(dh @ p["shared"]["embedding"].astype(self.dtype).T)
         return logits + p["final_logits_bias"].astype(logits.dtype)

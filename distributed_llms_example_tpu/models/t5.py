@@ -489,7 +489,8 @@ class PipelinedT5:
     replicated per-call extra, so the bias table itself still receives
     gradient through the bucket lookup.  Param tree:
     ``stack_for_family("t5", ...)`` (each stack's blocks stacked under
-    ``{encoder,decoder}/stacked_blocks``).  Deterministic only; training +
+    ``{encoder,decoder}/stacked_blocks``).  Dropout supported (key folded
+    per microbatch/stage/layer, see PipelinedBart); training +
     teacher-forced scoring only.
     """
 
@@ -530,7 +531,13 @@ class PipelinedT5:
         bias = jnp.take(table, buckets, axis=0)  # (q, kv, heads)
         return bias.transpose(2, 0, 1)[None].astype(self.dtype)
 
-    def _run_stack(self, stack_params, block, hidden, self_bias, pos_bias, extras):
+    def _dropout(self, x, key):
+        from distributed_llms_example_tpu.parallel.pipeline import dropout
+
+        return dropout(x, key, self.config.dropout_rate)
+
+    def _run_stack(self, stack_params, block, hidden, self_bias, pos_bias, extras,
+                   rng=None):
         from distributed_llms_example_tpu.parallel.activation import activation_mesh
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
 
@@ -543,23 +550,41 @@ class PipelinedT5:
             # mask would zero its gradient on any flash-selected path
             ex["pos_bias"] = pos_bias
 
-        def layer_fn(lp, h, e):
+        # T5Stack applies dropout on the embedded input and after the
+        # final norm; mirror that around the pipeline
+        if rng is not None:
+            hidden = self._dropout(hidden, jax.random.fold_in(rng, 101))
+
+        def layer_fn(lp, h, e, key=None):
             with activation_mesh(None):
+                if key is None:
+                    return block.apply(
+                        {"params": lp}, h, e.get("self_bias"), e.get("enc"),
+                        e.get("cross_bias"), True, False, e.get("pos_bias"),
+                    )
                 return block.apply(
                     {"params": lp}, h, e.get("self_bias"), e.get("enc"),
-                    e.get("cross_bias"), True, False, e.get("pos_bias"),
+                    e.get("cross_bias"), False, False, e.get("pos_bias"),
+                    rngs={"dropout": key},
                 )
 
         hidden = pipeline_apply(
             layer_fn, stack_params["stacked_blocks"], hidden, ex,
             mesh=self.mesh, num_microbatches=self.num_microbatches, checkpoint=self.remat,
+            rng=rng,
         )
-        return self._norm.apply({"params": stack_params["final_norm"]}, hidden)
+        hidden = self._norm.apply({"params": stack_params["final_norm"]}, hidden)
+        if rng is not None:
+            hidden = self._dropout(hidden, jax.random.fold_in(rng, 102))
+        return hidden
 
     def apply(self, variables, input_ids, attention_mask=None, decoder_input_ids=None,
               decoder_attention_mask=None, *, deterministic: bool = True, rngs=None):
         p = variables["params"]
         cfg = self.config
+        rng = None
+        if not deterministic and rngs and "dropout" in rngs and cfg.dropout_rate > 0:
+            rng = rngs["dropout"]
         shared = lambda ids: constrain_hidden(  # noqa: E731
             self._shared.apply({"params": p["shared"]}, ids)
         )
@@ -569,7 +594,8 @@ class PipelinedT5:
         enc_pos = self._position_bias(enc_table, q_len, causal=False)
         enc_mask = mask_to_bias(attention_mask) if attention_mask is not None else None
         enc = self._run_stack(
-            p["encoder"], self._enc_block, shared(input_ids), enc_mask, enc_pos, {}
+            p["encoder"], self._enc_block, shared(input_ids), enc_mask, enc_pos, {},
+            rng=None if rng is None else jax.random.fold_in(rng, 0),
         )
 
         d_len = decoder_input_ids.shape[1]
@@ -584,6 +610,7 @@ class PipelinedT5:
         hidden = self._run_stack(
             p["decoder"], self._dec_block, shared(decoder_input_ids), dec_mask, dec_pos,
             {"enc": enc, "cross_bias": cross_bias},
+            rng=None if rng is None else jax.random.fold_in(rng, 1),
         )
         if cfg.tie_word_embeddings:
             hidden = hidden * (cfg.d_model**-0.5)

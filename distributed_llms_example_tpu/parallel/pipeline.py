@@ -115,16 +115,63 @@ def unstack_for_family(family: str, params: dict) -> dict:
     raise ValueError(f"no pipeline unstacking for family {family!r}")
 
 
+def unstack_for_family_resharded(family: str, params: dict, mesh, rules=None) -> dict:
+    """``unstack_for_family`` that device_puts each layer onto its
+    (default FSDP/TP) rule sharding AS it is unstacked.  Indexing a
+    stage-sharded stack yields a replicated layer; doing all layers before
+    resharding would transiently hold a full replicated copy of the model
+    on every device — exactly the cliff pipelined eval exists to avoid.
+    Here at most ONE replicated layer is live at a time; the resulting
+    tree holds params/(fsdp·tensor) per device."""
+    from distributed_llms_example_tpu.parallel.sharding import resolve_shardings
+
+    def _unstack(tree, prefix="block_", key="stacked_blocks"):
+        stacked = tree[key]
+        rest = {k: v for k, v in tree.items() if k != key}
+        n = jax.tree.leaves(stacked)[0].shape[0]
+        out = dict(rest)
+        for i in range(n):
+            layer = jax.tree.map(lambda x: x[i], stacked)
+            sh = resolve_shardings(layer, mesh, rules)
+            out[f"{prefix}{i}"] = jax.tree.map(jax.device_put, layer, sh)
+        return out
+
+    if family == "llama":
+        out = _unstack(params)
+    elif family == "bart":
+        out = _unstack(params, "encoder_block_", "stacked_encoder_blocks")
+        out = _unstack(out, "decoder_block_", "stacked_decoder_blocks")
+    elif family == "t5":
+        out = {
+            **params,
+            "encoder": _unstack(params["encoder"]),
+            "decoder": _unstack(params["decoder"]),
+        }
+    else:
+        raise ValueError(f"no pipeline unstacking for family {family!r}")
+    # non-stacked leaves (embeddings/norms/head) get their rule shardings
+    # too; the per-layer trees above are already placed, so this final
+    # tree-wide device_put no-ops on them
+    return jax.tree.map(jax.device_put, out, resolve_shardings(out, mesh, rules))
+
+
 def _full_spec(leading, ndim: int) -> P:
     return P(leading, *([None] * (ndim - 1)))
 
 
-VARY_WITH_PCAST = True  # False path: check_vma=False, no explicit pcasts
+def dropout(x: jnp.ndarray, key: jnp.ndarray, rate: float) -> jnp.ndarray:
+    """Inverted dropout for the pipeline adapters' out-of-loop layers
+    (embeddings, final norms) — in-loop dropout goes through each block's
+    own flax ``nn.Dropout`` with a per-layer folded key."""
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x)).astype(x.dtype)
 
 
 def _vary(tree, axis_name: str):
-    if not VARY_WITH_PCAST:
-        return tree
+    """Mark every array stage-varying: the body branches on axis_index, and
+    shard_map's vma checking (check_vma=True) requires the provenance to be
+    explicit rather than inferred."""
     return jax.tree.map(lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
 
 
@@ -139,6 +186,7 @@ def pipeline_apply(
     axis_name: str = "stage",
     batch_axes: tuple[str, ...] = ("data", "fsdp", "expert"),
     checkpoint: bool = True,
+    rng: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Run ``hidden`` through the stacked layers as a pipelined schedule.
 
@@ -149,6 +197,12 @@ def pipeline_apply(
     Requires L % stages == 0 and (local batch) % num_microbatches == 0.
     Output is bit-identical to applying the layers sequentially (the
     schedule only reorders microbatches, never the math within one).
+
+    ``rng``: optional PRNG key enabling stochastic layers (dropout).  When
+    given, ``layer_fn`` must take a fourth argument — a key folded to be
+    unique per (microbatch, stage, local layer), so every layer of every
+    microbatch draws an independent mask while the whole schedule stays a
+    deterministic function of ``rng``.
     """
     S = mesh.shape.get(axis_name, 1)
     L = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -168,16 +222,25 @@ def pipeline_apply(
 
     one_layer = jax.checkpoint(layer_fn) if checkpoint else layer_fn
 
-    def run_stage(local_params: Any, x: jnp.ndarray, ex: Any) -> jnp.ndarray:
-        def step(carry, p):
-            return one_layer(p, carry, ex), None
+    def run_stage(local_params: Any, x: jnp.ndarray, ex: Any,
+                  key: jnp.ndarray | None = None) -> jnp.ndarray:
+        local_l = jax.tree.leaves(local_params)[0].shape[0]
+        if key is None:
+            def step(carry, p):
+                return one_layer(p, carry, ex), None
 
-        y, _ = jax.lax.scan(step, x, local_params)
+            y, _ = jax.lax.scan(step, x, local_params)
+        else:
+            def step(carry, xs):
+                p, i = xs
+                return one_layer(p, carry, ex, jax.random.fold_in(key, i)), None
+
+            y, _ = jax.lax.scan(step, x, (local_params, jnp.arange(local_l)))
         return y
 
     if S == 1:
         # no pipeline: plain scan over the full stack under GSPMD
-        return run_stage(stacked_params, hidden, extras)
+        return run_stage(stacked_params, hidden, extras, rng)
 
     # which extras are per-example (to be microbatched) vs per-call
     # constants (replicated): decided from GLOBAL shapes, outside the body
@@ -195,7 +258,7 @@ def pipeline_apply(
     compute_dtype = hidden.dtype
     plumb_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
 
-    def body(local_params: Any, h: jnp.ndarray, ex: Any) -> jnp.ndarray:
+    def body(local_params: Any, h: jnp.ndarray, ex: Any, key: Any) -> jnp.ndarray:
         # Manual over ``stage`` only: shapes here are GLOBAL in every other
         # dim and every array must be made stage-varying (each stage
         # branches on s_idx), hence the pcasts.  GSPMD still auto-shards
@@ -205,6 +268,9 @@ def pipeline_apply(
             lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, ex
         )
         h, ex = _vary(h.astype(plumb_dtype), axis_name), _vary(ex, axis_name)
+        if key is not None:
+            # unique stream per stage; tick folds in the microbatch index
+            key = jax.random.fold_in(_vary(key, axis_name), s_idx)
         mb = h.shape[0] // M
         micro = h.reshape(M, mb, *h.shape[1:])
         micro_ex = jax.tree.map(
@@ -231,7 +297,10 @@ def pipeline_apply(
                 ex_dtypes,
             )
             inp = jnp.where(s_idx == 0, x0, buf)
-            y = run_stage(local_params, inp.astype(compute_dtype), ex_t).astype(plumb_dtype)
+            key_m = None if key is None else jax.random.fold_in(key, m_idx)
+            y = run_stage(
+                local_params, inp.astype(compute_dtype), ex_t, key_m
+            ).astype(plumb_dtype)
             nxt = jax.lax.ppermute(y, axis_name, perm)
             write = (s_idx == S - 1) & (t >= S - 1)
             upd = jax.lax.dynamic_update_index_in_dim(outputs, y, m_idx, 0)
@@ -251,11 +320,18 @@ def pipeline_apply(
     # batch) ride through untouched
     param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
     extras_specs = jax.tree.map(lambda m: P(), extras)
+    # rng enters as a pytree ({} when absent) so in_specs structure-matches
+    rng_tree = {} if rng is None else {"key": rng}
+    rng_specs = jax.tree.map(lambda _: P(), rng_tree)
+
+    def outer(sp, h, ex, rt):
+        return body(sp, h, ex, rt.get("key"))
+
     return jax.shard_map(
-        body,
+        outer,
         mesh=mesh,
         axis_names={axis_name},
-        in_specs=(param_specs, P(), extras_specs),
+        in_specs=(param_specs, P(), extras_specs, rng_specs),
         out_specs=P(),
-        check_vma=VARY_WITH_PCAST,
-    )(stacked_params, hidden, extras)
+        check_vma=True,
+    )(stacked_params, hidden, extras, rng_tree)

@@ -171,13 +171,6 @@ class Trainer:
             params = stack_for_family(self.loaded.family, params)
             self.model = Adapter(self.config, self.mesh, **adapter_kw)
             self._rules = pipeline_rules()
-            if self.config.dropout_rate > 0.0:
-                # per-microbatch RNG threading through the stage loop is not
-                # supported; the adapters run deterministically
-                log_json({
-                    "event": "pipeline_dropout_disabled",
-                    "dropout_rate": self.config.dropout_rate,
-                })
             log_json({
                 "event": "pipeline_enabled",
                 "family": self.loaded.family,
@@ -209,7 +202,7 @@ class Trainer:
                           f"target_cap={tgt_cap} not all divisible by sequence={seq_axis}",
             })
 
-        self.use_dropout = self.config.dropout_rate > 0.0 and not self.pipelined
+        self.use_dropout = self.config.dropout_rate > 0.0
         build = make_train_step(
             self.model,
             self.config,
@@ -274,15 +267,17 @@ class Trainer:
         if run_rouge:
             eval_params = self.state.params
             if self.pipelined:
-                from distributed_llms_example_tpu.parallel.pipeline import unstack_for_family
+                from distributed_llms_example_tpu.parallel.pipeline import (
+                    unstack_for_family_resharded,
+                )
 
-                # unstack to the standard per-layer layout, then RE-SHARD
-                # with the default FSDP/TP rules: indexing a stage-sharded
-                # stack yields replicated layers, but generation only needs
-                # params/(fsdp·tensor) per device once resharded — the
-                # eval memory cliff shrinks to the normal FSDP story
-                eval_params = shard_params(
-                    unstack_for_family(self.loaded.family, eval_params), self.mesh
+                # unstack to the standard per-layer layout with each layer
+                # device_put onto the default FSDP/TP shardings AS it is
+                # unstacked (at most one replicated layer live at a time) —
+                # generation then needs params/(fsdp·tensor) per device,
+                # the normal FSDP story instead of a whole-model cliff
+                eval_params = unstack_for_family_resharded(
+                    self.loaded.family, eval_params, self.mesh
                 )
             eval_batch = self.cfg.eval_batch_size or self.cfg.batch_size
             pc = jax.process_count()

@@ -71,15 +71,37 @@ def stack_blocks(params: dict, prefix: str = "block_", out_key: str = "stacked_b
     return {**rest, out_key: stacked}
 
 
-def unstack_blocks(params: dict, prefix: str = "block_", key: str = "stacked_blocks") -> dict:
-    """Pipelined tree → standard per-layer tree (for checkpoints/eval)."""
+def unstack_blocks(params: dict, prefix: str = "block_", key: str = "stacked_blocks",
+                   layer_transform=None) -> dict:
+    """Pipelined tree → standard per-layer tree (for checkpoints/eval).
+    ``layer_transform`` (if given) is applied to each layer tree AS it is
+    unstacked — the hook the memory-aware reshard path uses so only one
+    untransformed (replicated) layer is ever live."""
     stacked = params[key]
     rest = {k: v for k, v in params.items() if k != key}
     n = jax.tree.leaves(stacked)[0].shape[0]
     out = dict(rest)
     for i in range(n):
-        out[f"{prefix}{i}"] = jax.tree.map(lambda x: x[i], stacked)
+        layer = jax.tree.map(lambda x: x[i], stacked)
+        out[f"{prefix}{i}"] = layer if layer_transform is None else layer_transform(layer)
     return out
+
+
+def _unstack_dispatch(family: str, params: dict, unstack_one) -> dict:
+    """Shared family layout dispatch: LLaMA's single stack, BART's twin
+    top-level stacks, T5's nested encoder/decoder stacks."""
+    if family == "llama":
+        return unstack_one(params, "block_", "stacked_blocks")
+    if family == "bart":
+        params = unstack_one(params, "encoder_block_", "stacked_encoder_blocks")
+        return unstack_one(params, "decoder_block_", "stacked_decoder_blocks")
+    if family == "t5":
+        return {
+            **params,
+            "encoder": unstack_one(params["encoder"], "block_", "stacked_blocks"),
+            "decoder": unstack_one(params["decoder"], "block_", "stacked_blocks"),
+        }
+    raise ValueError(f"no pipeline unstacking for family {family!r}")
 
 
 def stack_for_family(family: str, params: dict) -> dict:
@@ -101,18 +123,7 @@ def stack_for_family(family: str, params: dict) -> dict:
 
 
 def unstack_for_family(family: str, params: dict) -> dict:
-    if family == "llama":
-        return unstack_blocks(params)
-    if family == "bart":
-        params = unstack_blocks(params, "encoder_block_", "stacked_encoder_blocks")
-        return unstack_blocks(params, "decoder_block_", "stacked_decoder_blocks")
-    if family == "t5":
-        return {
-            **params,
-            "encoder": unstack_blocks(params["encoder"]),
-            "decoder": unstack_blocks(params["decoder"]),
-        }
-    raise ValueError(f"no pipeline unstacking for family {family!r}")
+    return _unstack_dispatch(family, params, unstack_blocks)
 
 
 def unstack_for_family_resharded(family: str, params: dict, mesh, rules=None) -> dict:
@@ -120,35 +131,22 @@ def unstack_for_family_resharded(family: str, params: dict, mesh, rules=None) ->
     (default FSDP/TP) rule sharding AS it is unstacked.  Indexing a
     stage-sharded stack yields a replicated layer; doing all layers before
     resharding would transiently hold a full replicated copy of the model
-    on every device — exactly the cliff pipelined eval exists to avoid.
-    Here at most ONE replicated layer is live at a time; the resulting
-    tree holds params/(fsdp·tensor) per device."""
+    on every device — exactly the cliff pipelined eval/export exists to
+    avoid.  Here at most ONE replicated layer is live at a time; the
+    resulting tree holds params/(fsdp·tensor) per device."""
     from distributed_llms_example_tpu.parallel.sharding import resolve_shardings
 
-    def _unstack(tree, prefix="block_", key="stacked_blocks"):
-        stacked = tree[key]
-        rest = {k: v for k, v in tree.items() if k != key}
-        n = jax.tree.leaves(stacked)[0].shape[0]
-        out = dict(rest)
-        for i in range(n):
-            layer = jax.tree.map(lambda x: x[i], stacked)
-            sh = resolve_shardings(layer, mesh, rules)
-            out[f"{prefix}{i}"] = jax.tree.map(jax.device_put, layer, sh)
-        return out
+    def unstack_one(tree, prefix="block_", key="stacked_blocks"):
+        holder = {}  # all layers of one stack share a structure: resolve once
 
-    if family == "llama":
-        out = _unstack(params)
-    elif family == "bart":
-        out = _unstack(params, "encoder_block_", "stacked_encoder_blocks")
-        out = _unstack(out, "decoder_block_", "stacked_decoder_blocks")
-    elif family == "t5":
-        out = {
-            **params,
-            "encoder": _unstack(params["encoder"]),
-            "decoder": _unstack(params["decoder"]),
-        }
-    else:
-        raise ValueError(f"no pipeline unstacking for family {family!r}")
+        def transform(layer):
+            if not holder:
+                holder["sh"] = resolve_shardings(layer, mesh, rules)
+            return jax.tree.map(jax.device_put, layer, holder["sh"])
+
+        return unstack_blocks(tree, prefix, key, layer_transform=transform)
+
+    out = _unstack_dispatch(family, params, unstack_one)
     # non-stacked leaves (embeddings/norms/head) get their rule shardings
     # too; the per-layer trees above are already placed, so this final
     # tree-wide device_put no-ops on them

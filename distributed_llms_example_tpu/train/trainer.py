@@ -574,10 +574,16 @@ class Trainer:
         final_params = self.state.params
         if self.pipelined:
             # export in the standard per-layer layout so the artifact loads
-            # anywhere (eval, conversion, non-pipelined resume)
-            from distributed_llms_example_tpu.parallel.pipeline import unstack_for_family
+            # anywhere (eval, conversion, non-pipelined resume); resharded
+            # per layer so no full replicated copy ever lives in HBM (the
+            # host-side gather below is where the full tree materializes)
+            from distributed_llms_example_tpu.parallel.pipeline import (
+                unstack_for_family_resharded,
+            )
 
-            final_params = unstack_for_family(self.loaded.family, final_params)
+            final_params = unstack_for_family_resharded(
+                self.loaded.family, final_params, self.mesh
+            )
         if jax.process_count() > 1:
             # shards live on other hosts' devices; a plain device_get of a
             # non-fully-addressable array raises — gather full copies first

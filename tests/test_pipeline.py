@@ -518,3 +518,35 @@ def test_pipelined_dropout_real_and_key_deterministic():
         )
     )
     np.testing.assert_allclose(det, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_unstack_resharded_layers_are_fsdp_sharded():
+    """unstack_for_family_resharded must hand back per-layer params ON the
+    default FSDP/TP shardings (not replicated): the eval-memory contract."""
+    from distributed_llms_example_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from distributed_llms_example_tpu.parallel.pipeline import (
+        stack_for_family,
+        unstack_for_family_resharded,
+    )
+    from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    module = LlamaForCausalLM(cfg)
+    params = jax.device_get(module.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+    mesh = build_mesh(MeshConfig(stage=2, data=1, fsdp=2, sequence=1, tensor=2))
+    stacked = shard_params(stack_for_family("llama", params), mesh, pipeline_rules())
+
+    out = unstack_for_family_resharded("llama", stacked, mesh)
+    q = out["block_0"]["self_attn"]["q_proj"]["kernel"]  # (32, 32)
+    # default rules: P("fsdp", "tensor") → (16, 16) per device, NOT (32, 32)
+    assert {s.data.shape for s in q.addressable_shards} == {(16, 16)}
+    # values round-trip exactly
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(q)),
+        params["block_0"]["self_attn"]["q_proj"]["kernel"],
+        atol=0, rtol=0,
+    )

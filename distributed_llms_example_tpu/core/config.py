@@ -181,7 +181,11 @@ def add_reference_args(p: argparse.ArgumentParser) -> None:
 def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--learning-rate", type=float, default=_D.learning_rate)
     p.add_argument("--weight-decay", type=float, default=_D.weight_decay)
+    p.add_argument("--max-grad-norm", type=float, default=_D.max_grad_norm)
+    p.add_argument("--label-smoothing", type=float, default=_D.label_smoothing)
     p.add_argument("--grad-accum-steps", type=int, default=_D.grad_accum_steps)
+    p.add_argument("--shuffle-seed", type=int, default=_D.shuffle_seed)
+    p.add_argument("--pad-to-multiple", type=int, default=_D.pad_to_multiple)
     p.add_argument("--max-source-length", type=int, default=_D.max_source_length)
     p.add_argument("--max-target-length", type=int, default=_D.max_target_length)
     p.add_argument("--param-dtype", type=str, default=_D.param_dtype)

@@ -153,6 +153,34 @@ def unstack_for_family_resharded(family: str, params: dict, mesh, rules=None) ->
     return jax.tree.map(jax.device_put, out, resolve_shardings(out, mesh, rules))
 
 
+def unstack_for_family_to_host(family: str, params: dict) -> dict:
+    """Unstack a pipelined tree layer-by-layer STRAIGHT TO HOST numpy —
+    the export path.  Device-side resharded unstacking still replicates
+    everything on a pure-pipeline mesh (stage>1 with fsdp=tensor=1, the
+    canonical too-big-for-one-chip config), so the HF export gathers each
+    layer to host RAM as it is unstacked: HBM peak is the training
+    footprint plus ONE gathered layer; the full fp32 tree only ever exists
+    host-side, where the checkpoint writer needs it anyway.  Multi-host:
+    every process gathers (orbax-style collaboration isn't needed — the
+    safetensors writer runs on process 0 only)."""
+    import numpy as np
+
+    def to_host(x):
+        if jax.process_count() > 1 and hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    def unstack_one(tree, prefix="block_", key="stacked_blocks"):
+        return unstack_blocks(
+            tree, prefix, key, layer_transform=lambda layer: jax.tree.map(to_host, layer)
+        )
+
+    out = _unstack_dispatch(family, params, unstack_one)
+    return jax.tree.map(to_host, out)
+
+
 def _full_spec(leading, ndim: int) -> P:
     return P(leading, *([None] * (ndim - 1)))
 

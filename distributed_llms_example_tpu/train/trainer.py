@@ -574,23 +574,24 @@ class Trainer:
         final_params = self.state.params
         if self.pipelined:
             # export in the standard per-layer layout so the artifact loads
-            # anywhere (eval, conversion, non-pipelined resume); resharded
-            # per layer so no full replicated copy ever lives in HBM (the
-            # host-side gather below is where the full tree materializes)
+            # anywhere (eval, conversion, non-pipelined resume), gathering
+            # each layer STRAIGHT to host as it is unstacked — on a
+            # pure-pipeline mesh (fsdp=tensor=1) any device-side unstack
+            # would replicate the whole model; this caps HBM at the
+            # training footprint plus one layer
             from distributed_llms_example_tpu.parallel.pipeline import (
-                unstack_for_family_resharded,
+                unstack_for_family_to_host,
             )
 
-            final_params = unstack_for_family_resharded(
-                self.loaded.family, final_params, self.mesh
-            )
-        if jax.process_count() > 1:
-            # shards live on other hosts' devices; a plain device_get of a
-            # non-fully-addressable array raises — gather full copies first
-            from jax.experimental import multihost_utils
+            final_params = unstack_for_family_to_host(self.loaded.family, final_params)
+        else:
+            if jax.process_count() > 1:
+                # shards live on other hosts' devices; a plain device_get of
+                # a non-fully-addressable array raises — gather copies first
+                from jax.experimental import multihost_utils
 
-            final_params = multihost_utils.process_allgather(final_params, tiled=True)
-        final_params = jax.device_get(final_params)
+                final_params = multihost_utils.process_allgather(final_params, tiled=True)
+            final_params = jax.device_get(final_params)
         if jax.process_index() == 0:
             os.makedirs(out, exist_ok=True)
             save_hf_checkpoint(out, self.loaded.family, self.config, final_params)

@@ -550,3 +550,54 @@ def test_unstack_resharded_layers_are_fsdp_sharded():
         params["block_0"]["self_attn"]["q_proj"]["kernel"],
         atol=0, rtol=0,
     )
+
+
+def test_pipelined_grad_accum_equals_full_batch(pp_mesh, tiny_llama4):
+    """Gradient accumulation (lax.scan microbatching) composed WITH the
+    pipeline must still equal the single-device full-batch step — the
+    token-weighted accumulation is exact, not approximate."""
+    import optax
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.parallel.pipeline import stack_blocks
+    from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    cfg, module, params0 = tiny_llama4
+    rng = np.random.RandomState(21)
+    b, src = 16, 16
+    ids = rng.randint(2, cfg.vocab_size, (b, src)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, :5] = LABEL_PAD
+    batch = {"input_ids": ids, "attention_mask": np.ones((b, src), np.int32), "labels": labels}
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    mesh1 = build_mesh(MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1])
+    build = make_train_step(module, cfg, tx, schedule, mesh1, donate=False, is_seq2seq=False)
+    state = create_train_state(shard_params(params0, mesh1), tx)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh1))
+    step, _ = build(state)
+    _, ref = step(state, put_batch(batch, mesh1))
+
+    piped = PipelinedLlama(cfg, pp_mesh, num_microbatches=2)
+    rules = pipeline_rules()
+    state_p = create_train_state(shard_params(stack_blocks(params0), pp_mesh, rules), tx)
+    state_p = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, pp_mesh, rules)
+    )
+    build_p = make_train_step(
+        piped, cfg, tx, schedule, pp_mesh, rules=rules, donate=False,
+        is_seq2seq=False, grad_accum_steps=2,
+    )
+    step_p, _ = build_p(state_p)
+    _, got = step_p(state_p, put_batch(batch, pp_mesh))
+
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)

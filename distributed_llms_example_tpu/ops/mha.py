@@ -105,6 +105,11 @@ def select_attention_impl(
         if why is None:
             return "ring", ("forced" if attention_impl == "ring" else "auto: sequence-parallel mesh")
         if attention_impl == "ring":
+            if mesh is None:
+                # not a config error: module init and other traces outside a
+                # mesh context legitimately can't ring — fall back quietly
+                # so a forced-ring training run can still initialize
+                return "xla", f"ring requested but {why}"
             raise ValueError(f"attention_impl='ring' but {why}")
         # a sequence-sharded mesh where ring can't run: XLA attention is
         # correct (GSPMD gathers the sequence) but loses the SP memory win

@@ -202,6 +202,22 @@ class Trainer:
                           f"target_cap={tgt_cap} not all divisible by sequence={seq_axis}",
             })
 
+        # forced-ring misconfiguration must fail HERE, loudly: the selection
+        # logic quietly falls back on mesh-less traces (module init, the
+        # pipeline's per-stage bodies), so a bad mesh would otherwise train
+        # the whole run on XLA attention with only a log line to show for it
+        if cfg.attention_impl == "ring":
+            if self.mesh.shape.get("sequence", 1) <= 1:
+                raise ValueError(
+                    "--attention-impl ring requires a mesh with a sequence axis > 1 "
+                    f"(got {dict(self.mesh.shape)})"
+                )
+            if self.pipelined:
+                raise ValueError(
+                    "--attention-impl ring does not compose with stage>1: ring is "
+                    "its own fully-manual shard_map and manual regions don't nest"
+                )
+
         self.use_dropout = self.config.dropout_rate > 0.0
         build = make_train_step(
             self.model,

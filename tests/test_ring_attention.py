@@ -258,3 +258,14 @@ def test_non_divisible_lengths_fall_back(sp_mesh):
     step, _ = build(state)
     _, metrics = step(state, put_batch(batch, sp_mesh, sequence_sharded=False))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_forced_ring_tolerates_meshless_traces():
+    """attention_impl='ring' must not explode during module init (no mesh
+    context) — real config errors raise under a mesh (above) and at
+    Trainer startup (mesh/stage validation in train/trainer.py)."""
+    impl, reason = select_attention_impl(
+        "ring", batch=1, heads=4, head_dim=8, q_len=8, kv_len=8,
+        use_cache=False, mesh=None, backend="cpu", device_count=8,
+    )
+    assert impl == "xla" and "ring requested" in reason

@@ -259,8 +259,8 @@ def load_model(
     ``attention_impl`` overrides the config's attention path ("auto" /
     "flash" / "ring" / "xla", see ops/mha.py) for every family.  T5's
     learned relative-position bias rides the flash kernel's differentiable
-    ``learned_bias`` input on a single device; multi-device meshes keep
-    XLA for T5 self-attention (see T5Attention._attend) while T5
+    ``learned_bias`` input on any mesh (multi-device via the sharded path
+    whose hand-written vjp psums dbias across batch shards); T5
     cross-attention takes the same flash/ring paths as BART/LLaMA.
 
     ``moe_capacity_factor`` overrides the MoE expert capacity factor for

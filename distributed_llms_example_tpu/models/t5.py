@@ -19,6 +19,7 @@ Supports both T5 v1.0 (relu FFN, tied) and v1.1/flan (gated-gelu, untied).
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -57,9 +58,10 @@ class T5Config:
     decoder_start_token_id: int = 0
     # "auto": Pallas flash attention where eligible — the learned
     # relative-position bias rides the kernel's differentiable
-    # ``learned_bias`` input (single-device; multi-device meshes keep XLA
-    # for learned-bias self-attention, see ops/mha.flash_run), and
-    # mask-only cross-attention takes the same paths as BART/LLaMA.
+    # ``learned_bias`` input (multi-device meshes use the sharded path
+    # whose hand-written vjp psums dbias across batch shards,
+    # ops/flash_attention.flash_attention_lbias_sharded), and mask-only
+    # cross-attention takes the same paths as BART/LLaMA.
     attention_impl: str = "auto"
 
     @property
@@ -184,16 +186,19 @@ class T5Attention(nn.Module):
         """T5 attention is UNSCALED (scale=1.0).  Selection mirrors
         MultiHeadAttention: ring on sequence meshes (cross-attention /
         mask-only biases), Pallas flash on TPU where tileable, XLA
-        otherwise.  A learned bias additionally requires a single device —
-        the shard_map flash path runs check_vma=False and would drop the
-        cross-shard psum of dbias."""
+        otherwise.  With a learned bias, multi-device meshes use the
+        dedicated sharded path whose hand-written vjp psums dbias across
+        batch shards (flash_attention_lbias_sharded)."""
+        from distributed_llms_example_tpu.ops.flash_attention import (
+            flash_attention_lbias_sharded,
+        )
         from distributed_llms_example_tpu.ops.mha import (
             _log_impl_once,
             flash_run,
             select_attention_impl,
         )
         from distributed_llms_example_tpu.ops.ring_attention import ring_attention_sharded
-        from distributed_llms_example_tpu.parallel.activation import current_mesh
+        from distributed_llms_example_tpu.parallel.activation import BATCH_AXES, current_mesh
 
         causal_here = self.causal and not use_cache and not causal_in_bias
         mesh = current_mesh()
@@ -215,8 +220,6 @@ class T5Attention(nn.Module):
                 else None if bias is None else (bias.shape[1] == 1 and bias.shape[2] == 1)
             ),
         )
-        if impl == "flash" and learned_bias is not None and jax.device_count() > 1:
-            impl, reason = "xla", "learned bias needs single-device flash (dbias psum)"
         _log_impl_once(f"t5:{impl}", reason)
         if impl == "ring":
             return ring_attention_sharded(
@@ -224,6 +227,13 @@ class T5Attention(nn.Module):
             )
         if impl == "flash":
             if learned_bias is not None:
+                if mesh is not None and math.prod(mesh.devices.shape) > 1:
+                    return flash_attention_lbias_sharded(
+                        q, k, v, bias, learned_bias, mesh=mesh,
+                        batch_axes=tuple(a for a in BATCH_AXES if a in mesh.shape),
+                        head_axis="tensor" if "tensor" in mesh.shape else None,
+                        causal=causal_here, scale=1.0, dtype=self.dtype,
+                    )
                 return flash_attention(
                     q, k, v, bias, learned_bias=learned_bias,
                     causal=causal_here, scale=1.0, dtype=self.dtype,

@@ -669,3 +669,173 @@ def flash_supported(q_len: int, kv_len: int, head_dim: int,
         and bk % 8 == 0
         and head_dim % 8 == 0
     )
+
+
+# --------------------------------------------- multi-device learned bias
+
+
+def make_flash_lbias_sharded(
+    mesh,
+    *,
+    batch_axes: tuple[str, ...],
+    head_axis: str | None,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+    has_bias: bool,
+    out_dtype,
+):
+    """Multi-device flash attention WITH a differentiable (1, H, Q, K)
+    learned bias: per-shard Pallas kernels under ``shard_map`` (batch over
+    ``batch_axes``, heads over ``head_axis``) and a HAND-WRITTEN vjp whose
+    backward psums the per-batch-shard dbias partials inside the manual
+    region.  The generic ``flash_run`` path can't do this: its shard_map
+    runs ``check_vma=False``, under which autodiff would silently drop the
+    cross-shard reduction a replicated input's cotangent needs — here the
+    reduction is explicit, so T5's relative-position bias trains correctly
+    on any mesh, not just a single chip.
+
+    Returns ``f(q, k, v[, bias], lbias) -> o``.  ``bias`` (present iff
+    ``has_bias``) is a constant (b|1, 1, 1, K) mask; ``lbias`` is heads-
+    sharded over ``head_axis`` and replicated across the batch shards.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    qkv_spec = P(batch_axes or None, head_axis, None, None)
+    lb_spec = P(None, head_axis, None, None)
+    lse_spec = P(batch_axes or None, head_axis, None, None)
+
+    def mask_spec(b):
+        return P(
+            (batch_axes or None) if b.shape[0] != 1 else None,
+            None, None, None,
+        )
+
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+              interpret=interpret)
+
+    def split(args):
+        """(q, k, v[, bias], lbias) → (q, k, v, bias|None, lbias)."""
+        if has_bias:
+            q, k, v, bias, lbias = args
+        else:
+            (q, k, v, lbias), bias = args, None
+        return q, k, v, bias, lbias
+
+    def fwd_in_specs(bias):
+        return tuple(
+            s for s in (
+                qkv_spec, qkv_spec, qkv_spec,
+                mask_spec(bias) if has_bias else None, lb_spec,
+            ) if s is not None
+        )
+
+    def fwd_shard(*sargs):
+        sq, sk, sv, sbias, slb = split(sargs)
+        o, lse = _fwd(sq, sk, sv, sbias, slb, **kw)
+        return o, lse[..., :1]
+
+    def run_fwd(args, bias):
+        return jax.shard_map(
+            fwd_shard, mesh=mesh, in_specs=fwd_in_specs(bias),
+            out_specs=(qkv_spec, lse_spec), check_vma=False,
+        )(*args)
+
+    @jax.custom_vjp
+    def f(*args):
+        _, _, _, bias, _ = split(args)
+        return run_fwd(args, bias)[0]
+
+    def f_fwd(*args):
+        q, k, v, bias, lbias = split(args)
+        o, lse1 = run_fwd(args, bias)
+        return o, (q, k, v, bias, lbias, o, lse1)
+
+    def f_bwd(res, do):
+        q, k, v, bias, lbias, o, lse1 = res
+
+        def bwd_shard(*sargs):
+            if has_bias:
+                sq, sk, sv, sbias, slb, so, slse1, sdo = sargs
+            else:
+                (sq, sk, sv, slb, so, slse1, sdo), sbias = sargs, None
+            lse = jax.lax.broadcast_in_dim(
+                slse1[..., 0], (*slse1.shape[:-1], LANES), (0, 1, 2)
+            )
+            dq, dk, dv, dlb = _bwd(sq, sk, sv, sbias, slb, so, lse, sdo, **kw)
+            # each batch shard computed dbias for ITS rows only: the
+            # explicit cross-shard reduction autodiff can't insert here
+            if batch_axes:
+                dlb = jax.lax.psum(dlb, batch_axes)
+            return dq, dk, dv, dlb
+
+        in_specs = (*fwd_in_specs(bias), qkv_spec, lse_spec, qkv_spec)
+        args = tuple(x for x in (q, k, v, bias, lbias, o, lse1, do) if x is not None)
+        dq, dk, dv, dlb = jax.shard_map(
+            bwd_shard, mesh=mesh, in_specs=in_specs,
+            out_specs=(qkv_spec, qkv_spec, qkv_spec, lb_spec), check_vma=False,
+        )(*args)
+        if has_bias:
+            return dq, dk, dv, jnp.zeros_like(bias), dlb
+        return dq, dk, dv, dlb
+
+    f.defvjp(f_fwd, f_bwd)
+    return lambda *args: f(*args).astype(out_dtype)
+
+
+def flash_attention_lbias_sharded(
+    q, k, v, bias, learned_bias, *, mesh,
+    batch_axes: tuple[str, ...], head_axis: str | None,
+    causal: bool = False, scale: float | None = None,
+    block_q: int | None = None, block_k: int | None = None,
+    interpret: bool | None = None, dtype=None,
+):
+    """Front door for the multi-device learned-bias path (see
+    ``make_flash_lbias_sharded``).  Same shape/validation contract as
+    ``flash_attention``; block sizes are the per-shard auto defaults
+    (q and the learned bias's Q dim are full-length per shard — only batch
+    and heads split).  The mask additionally must not carry a head dim
+    (the per-shard BlockSpec would index the wrong heads on non-first
+    tensor shards)."""
+    if causal and q.shape[2] != k.shape[2]:
+        raise ValueError(
+            f"causal=True requires square self-attention, got q_len={q.shape[2]} "
+            f"!= kv_len={k.shape[2]}"
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    block_q = auto_block(q.shape[2]) if block_q is None else min(block_q, q.shape[2])
+    block_k = auto_block(k.shape[2]) if block_k is None else min(block_k, k.shape[2])
+    if (
+        not block_q or not block_k
+        or q.shape[2] % block_q or k.shape[2] % block_k
+        or block_q % 8 or block_k % 8
+    ):
+        raise ValueError(
+            f"seq lens {q.shape[2]}/{k.shape[2]} not divisible into 8-aligned "
+            f"blocks {block_q}/{block_k}"
+        )
+    if bias is not None:
+        for i, (bd, full) in enumerate(
+            zip(bias.shape, (q.shape[0], 1, q.shape[2], k.shape[2]))
+        ):
+            if bd not in (1, full):
+                raise ValueError(
+                    f"bias dim {i} is {bd}, must be 1 or {full} (head/query dims "
+                    "must be 1 on the sharded learned-bias path)"
+                )
+    want = (1, q.shape[1], q.shape[2], k.shape[2])
+    if tuple(learned_bias.shape) != want:
+        raise ValueError(f"learned_bias shape {tuple(learned_bias.shape)} != {want}")
+    if interpret is None:
+        interpret = _default_interpret()
+    f = make_flash_lbias_sharded(
+        mesh, batch_axes=batch_axes, head_axis=head_axis, causal=bool(causal),
+        scale=float(scale), block_q=int(block_q), block_k=int(block_k),
+        interpret=bool(interpret), has_bias=bias is not None,
+        out_dtype=dtype or q.dtype,
+    )
+    args = (q, k, v, bias, learned_bias) if bias is not None else (q, k, v, learned_bias)
+    return f(*args)

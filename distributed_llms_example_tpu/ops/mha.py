@@ -339,8 +339,9 @@ def flash_run(
     tensor; attention itself never mixes batches or heads, so the kernel
     body needs no collectives).  Constant-mask biases only: the shard_map
     runs with check_vma=False, under which a learned bias's gradient would
-    silently miss its cross-shard psum — learned-bias flash is the
-    single-device path in T5Attention."""
+    silently miss its cross-shard psum — learned biases use
+    ops/flash_attention.flash_attention_lbias_sharded, whose hand-written
+    vjp performs that psum explicitly."""
     if mesh is None or math.prod(mesh.devices.shape) == 1:
         return flash_attention(q, k, v, bias, causal=causal, dtype=dtype, scale=scale)
     batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)

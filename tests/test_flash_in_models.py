@@ -115,3 +115,39 @@ def test_t5_flash_matches_xla_incl_bias_table_grad():
         )
         if "relative_attention_bias" in name:
             assert np.abs(np.asarray(b)).sum() > 0, f"{name}: zero bias-table grad"
+
+
+def test_t5_flash_multi_device_bias_table_grads(mesh8):
+    """T5 with attention_impl='flash' on an 8-device mesh: self-attention
+    takes the sharded learned-bias path (hand-written vjp) — logits and
+    grads incl. the relative-position tables match the XLA path."""
+    from distributed_llms_example_tpu.models.registry import T5_CONFIGS
+    from distributed_llms_example_tpu.models.t5 import T5ForConditionalGeneration
+    from distributed_llms_example_tpu.parallel.activation import activation_mesh
+
+    cfg = dataclasses.replace(T5_CONFIGS["t5-test"], dropout_rate=0.0)
+    mods = _variants(cfg, T5ForConditionalGeneration)
+    rng = np.random.RandomState(6)
+    src = jnp.asarray(rng.randint(3, cfg.vocab_size, (8, 128)), jnp.int32)
+    src_mask = jnp.ones((8, 128), jnp.int32).at[1, 96:].set(0)
+    tgt = jnp.asarray(rng.randint(3, cfg.vocab_size, (8, 32)), jnp.int32)
+    params = mods["xla"].init(jax.random.PRNGKey(4), src, src_mask, tgt)["params"]
+
+    def loss(m):
+        def f(p):
+            logits = m.apply({"params": p}, src, src_mask, tgt)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        with activation_mesh(mesh8):
+            return jax.jit(jax.value_and_grad(f))(params)
+
+    (l_x, g_x), (l_f, g_f) = loss(mods["xla"]), loss(mods["flash"])
+    np.testing.assert_allclose(float(l_x), float(l_f), rtol=1e-5)
+    paths_x = jax.tree_util.tree_flatten_with_path(g_x)[0]
+    for (path, a), b in zip(paths_x, jax.tree.leaves(g_f)):
+        name = jax.tree_util.keystr(path)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3, err_msg=name
+        )
+        if "relative_attention_bias" in name:
+            assert np.abs(np.asarray(b)).sum() > 0, f"{name}: zero bias-table grad"

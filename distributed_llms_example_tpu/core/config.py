@@ -123,11 +123,13 @@ class TrainConfig:
     # own setting; HF-converted Mixtral defaults to no-drop, which is exact
     # but memory-hungry — 1.25 restores the capacity trade for training)
     moe_capacity_factor: float | None = None
-    # Under stage>1, generation-based ROUGE eval unstacks the blocks to
-    # replicated per-layer params — fine for models that fit one device,
-    # an OOM for the ones that actually need the pipeline.  False skips
-    # ROUGE there; the stage-sharded teacher-forced val_loss (computed
-    # through the pipeline itself, no unstacking) is always reported.
+    # Under stage>1, generation-based ROUGE eval unstacks the blocks onto
+    # the FSDP/TP shardings (params/(fsdp·tensor) per device).  On a
+    # pure-stage mesh (fsdp×tensor == 1) that would mean a fully replicated
+    # whole-model copy per device, so the Trainer auto-skips ROUGE there
+    # regardless of this flag; the stage-sharded teacher-forced val_loss
+    # (computed through the pipeline itself, no unstacking) is always
+    # reported.  False skips pipelined ROUGE on every mesh.
     pipeline_eval_rouge: bool = True
 
     # --- eval/generation (reference live path: beams=2, max_length=128,

@@ -153,7 +153,32 @@ def unstack_for_family_resharded(family: str, params: dict, mesh, rules=None) ->
     return jax.tree.map(jax.device_put, out, resolve_shardings(out, mesh, rules))
 
 
-def unstack_for_family_to_host(family: str, params: dict) -> dict:
+def gather_tree_to_host(tree, *, writer_only: bool = False):
+    """Copy a (possibly multi-host-sharded) pytree to host numpy, one leaf
+    at a time.  Non-fully-addressable leaves are allgathered — every
+    process enters every collective in the same (tree) order, so this is
+    collectively safe.  With ``writer_only``, non-writing processes free
+    each gathered leaf immediately and get a tree of None leaves back:
+    peak extra host memory on them is ONE leaf, while process 0 (where the
+    checkpoint/safetensors writer runs) accumulates the full tree it needs
+    anyway.  Shared by the pipelined (per-layer) and non-pipelined export
+    paths so the gather semantics cannot drift between them."""
+    import numpy as np
+
+    drop = writer_only and jax.process_count() > 1 and jax.process_index() != 0
+
+    def to_host(x):
+        if jax.process_count() > 1 and hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            g = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            return None if drop else g
+        return None if drop else np.asarray(jax.device_get(x))
+
+    return jax.tree.map(to_host, tree)
+
+
+def unstack_for_family_to_host(family: str, params: dict, *, writer_only: bool = False) -> dict:
     """Unstack a pipelined tree layer-by-layer STRAIGHT TO HOST numpy —
     the export path.  Device-side resharded unstacking still replicates
     everything on a pure-pipeline mesh (stage>1 with fsdp=tensor=1, the
@@ -161,24 +186,17 @@ def unstack_for_family_to_host(family: str, params: dict) -> dict:
     layer to host RAM as it is unstacked: HBM peak is the training
     footprint plus ONE gathered layer; the full fp32 tree only ever exists
     host-side, where the checkpoint writer needs it anyway.  Multi-host:
-    every process gathers (orbax-style collaboration isn't needed — the
-    safetensors writer runs on process 0 only)."""
-    import numpy as np
-
-    def to_host(x):
-        if jax.process_count() > 1 and hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
-            from jax.experimental import multihost_utils
-
-            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-        return np.asarray(jax.device_get(x))
+    see ``gather_tree_to_host`` (with ``writer_only`` the full host copy
+    exists only on process 0)."""
 
     def unstack_one(tree, prefix="block_", key="stacked_blocks"):
         return unstack_blocks(
-            tree, prefix, key, layer_transform=lambda layer: jax.tree.map(to_host, layer)
+            tree, prefix, key,
+            layer_transform=lambda layer: gather_tree_to_host(layer, writer_only=writer_only),
         )
 
     out = _unstack_dispatch(family, params, unstack_one)
-    return jax.tree.map(to_host, out)
+    return gather_tree_to_host(out, writer_only=writer_only)
 
 
 def _full_spec(leading, ndim: int) -> P:

@@ -247,6 +247,23 @@ class Trainer:
                 self.state, self.start_step = restored
                 log_json({"event": "resumed", "step": self.start_step})
 
+        # Generation-based ROUGE under stage>1 unstacks each layer onto the
+        # FSDP/TP rule shardings — but on a PURE-stage mesh (fsdp×tensor==1,
+        # the canonical too-big-for-one-chip config) those rules resolve to
+        # fully replicated, i.e. a whole-model copy per device: exactly the
+        # cliff the pipeline exists to avoid.  Auto-skip ROUGE there (the
+        # stage-sharded teacher-forced val_loss is always reported); an
+        # explicit --no-pipeline-eval-rouge skips it on any mesh.
+        self._pipeline_rouge_ok = self.cfg.pipeline_eval_rouge and (
+            self.mesh.shape.get("fsdp", 1) * self.mesh.shape.get("tensor", 1) > 1
+        )
+        if self.pipelined and self.cfg.pipeline_eval_rouge and not self._pipeline_rouge_ok:
+            log_json({
+                "event": "pipeline_rouge_disabled",
+                "reason": "fsdp*tensor == 1: unstacked eval params would be "
+                          "fully replicated (one whole-model copy per device); "
+                          "reporting stage-sharded val_loss only",
+            })
         # Eval always uses the STANDARD (per-layer) module: under pipeline
         # parallelism evaluate() unstacks the stacked blocks first (layer
         # params then live replicated across stage groups for the eval pass
@@ -278,7 +295,7 @@ class Trainer:
             # works for models too big to replicate (VERDICT r2 weak #4)
             scores["val_loss"] = self._pipelined_val_loss()
         run_rouge = self.evaluator is not None and (
-            not self.pipelined or self.cfg.pipeline_eval_rouge
+            not self.pipelined or self._pipeline_rouge_ok
         )
         if run_rouge:
             eval_params = self.state.params
@@ -599,15 +616,18 @@ class Trainer:
                 unstack_for_family_to_host,
             )
 
-            final_params = unstack_for_family_to_host(self.loaded.family, final_params)
+            final_params = unstack_for_family_to_host(
+                self.loaded.family, final_params, writer_only=True
+            )
         else:
-            if jax.process_count() > 1:
-                # shards live on other hosts' devices; a plain device_get of
-                # a non-fully-addressable array raises — gather copies first
-                from jax.experimental import multihost_utils
+            # multi-host shards live on other hosts' devices; gather each
+            # leaf to host, kept only on the writing process — a whole-tree
+            # allgather would materialize the full fp32 model in EVERY
+            # host's RAM simultaneously (~27 GB/host for llama-2-7b) when
+            # only process 0 writes
+            from distributed_llms_example_tpu.parallel.pipeline import gather_tree_to_host
 
-                final_params = multihost_utils.process_allgather(final_params, tiled=True)
-            final_params = jax.device_get(final_params)
+            final_params = gather_tree_to_host(final_params, writer_only=True)
         if jax.process_index() == 0:
             os.makedirs(out, exist_ok=True)
             save_hf_checkpoint(out, self.loaded.family, self.config, final_params)

@@ -601,3 +601,68 @@ def test_pipelined_grad_accum_equals_full_batch(pp_mesh, tiny_llama4):
 
     assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
     assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+
+
+def test_pure_stage_mesh_skips_generation_rouge(tmp_path):
+    """On a pure-stage mesh (fsdp*tensor == 1 — the canonical config for a
+    model too big to replicate) the Trainer must auto-skip generation ROUGE:
+    the resharded unstack would resolve every layer to fully replicated,
+    one whole-model copy per device.  val_loss (stage-sharded, no
+    unstacking) still reports."""
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    rng = np.random.RandomState(3)
+    records = [
+        {
+            "dialogue": " ".join(f"w{rng.randint(50)}" for _ in range(rng.randint(5, 20))),
+            "summary": "w1 w2",
+        }
+        for _ in range(16)
+    ]
+    cfg = TrainConfig(
+        model_ckpt="llama-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        warmup_steps=0,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        log_every_steps=1,
+        mesh=MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        tokenizer="byte",
+        pipeline_microbatches=2,
+    )
+    trainer = Trainer(cfg, train_records=records, val_records=records[:4])
+    assert trainer.pipelined
+    # flag default is True, but the mesh makes generation eval unsafe
+    assert cfg.pipeline_eval_rouge and not trainer._pipeline_rouge_ok
+    scores = trainer.evaluate(epoch=0)
+    assert np.isfinite(scores["val_loss"])
+    assert not any(k.startswith("rouge") for k in scores)
+
+
+def test_fsdp_stage_mesh_keeps_generation_rouge(tmp_path):
+    """Counter-case: with fsdp*tensor > 1 the unstacked eval params land on
+    real FSDP/TP shardings, so the default keeps generation ROUGE on."""
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    records = [{"dialogue": "a b c d", "summary": "a b"} for _ in range(8)]
+    cfg = TrainConfig(
+        model_ckpt="llama-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        mesh=MeshConfig(stage=2, data=2, fsdp=2, sequence=1, tensor=1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        tokenizer="byte",
+        pipeline_microbatches=2,
+    )
+    trainer = Trainer(cfg, train_records=records, val_records=records[:4])
+    assert trainer.pipelined and trainer._pipeline_rouge_ok

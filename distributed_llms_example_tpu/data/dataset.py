@@ -157,9 +157,10 @@ class SummarizationDataset:
         ex = self._cache[i]
         if ex is None:
             r = self._records[i]
-            eos = self.tokenizer.eos_id
-            src = self.tokenizer.encode(str(r[self._src_col]))[: self._max_source_length - 1] + [eos]
-            tgt = self.tokenizer.encode(str(r[self._tgt_col]))[: self._max_target_length - 1] + [eos]
+            # special-token layout (BART <s>…</s>, T5 …</s>) is the
+            # tokenizer's job — see Tokenizer protocol
+            src = self.tokenizer.encode_source(str(r[self._src_col]), self._max_source_length)
+            tgt = self.tokenizer.encode_target(str(r[self._tgt_col]), self._max_target_length)
             ex = self._cache[i] = Example(src, tgt)
         return ex
 
@@ -204,10 +205,13 @@ class CausalLMDataset:
         ex = self._cache[i]
         if ex is None:
             r = self._records[i]
-            eos = self.tokenizer.eos_id
-            tgt = self.tokenizer.encode(str(r[self._tgt_col]))[: self._max_target_length - 1] + [eos]
+            # layout via the tokenizer: the prompt keeps its leading
+            # specials (LLaMA's BOS) and the continuation ends in EOS
+            tgt = self.tokenizer.encode_continuation(
+                str(r[self._tgt_col]), self._max_target_length
+            )
             max_prompt = max(1, self._max_length - len(tgt))
-            src = self.tokenizer.encode(str(r[self._src_col]))[:max_prompt]
+            src = self.tokenizer.encode_prompt(str(r[self._src_col]), max_prompt)
             ex = self._cache[i] = CausalExample(src + tgt, [-100] * len(src) + tgt, src, tgt)
         return ex
 

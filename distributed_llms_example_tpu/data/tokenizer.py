@@ -18,17 +18,44 @@ from typing import Protocol, Sequence
 
 
 class Tokenizer(Protocol):
+    """Role-based encoding: the SPECIAL-TOKEN LAYOUT is the tokenizer's
+    job, not the dataset's.  Each model family lays out sequences its own
+    way (BART ``<s>…</s>``, T5 ``…</s>``, LLaMA ``<s>…``), and a
+    home-grown "append one EOS" convention silently mismatches the
+    pretraining format when fine-tuning real checkpoints — so datasets ask
+    for ids by ROLE and the tokenizer applies the family's layout."""
+
     vocab_size: int
     pad_id: int
     eos_id: int
 
-    def encode(self, text: str) -> list[int]: ...
+    def encode(self, text: str) -> list[int]:
+        """Plain content ids — no special tokens, no truncation."""
+        ...
+
+    def encode_source(self, text: str, max_length: int) -> list[int]:
+        """Seq2seq encoder input, family layout applied, ≤ max_length."""
+        ...
+
+    def encode_target(self, text: str, max_length: int) -> list[int]:
+        """Seq2seq decoder labels, family layout applied, ≤ max_length."""
+        ...
+
+    def encode_prompt(self, text: str, max_length: int) -> list[int]:
+        """Causal-LM prompt prefix (loss-masked): leading specials only."""
+        ...
+
+    def encode_continuation(self, text: str, max_length: int) -> list[int]:
+        """Causal-LM continuation: content + end-of-sequence, no BOS."""
+        ...
 
     def decode(self, ids: Sequence[int]) -> str: ...
 
 
 class ByteTokenizer:
-    """UTF-8 bytes + {pad=0, eos=1}; ids are byte+2."""
+    """UTF-8 bytes + {pad=0, eos=1}; ids are byte+2.  Its "family layout"
+    is the framework's own: sources/targets end in one EOS, prompts carry
+    no specials at all."""
 
     OFFSET = 2
 
@@ -40,6 +67,15 @@ class ByteTokenizer:
     def encode(self, text: str) -> list[int]:
         return [b + self.OFFSET for b in text.encode("utf-8")]
 
+    def encode_source(self, text: str, max_length: int) -> list[int]:
+        return self.encode(text)[: max_length - 1] + [self.eos_id]
+
+    encode_target = encode_source
+    encode_continuation = encode_source
+
+    def encode_prompt(self, text: str, max_length: int) -> list[int]:
+        return self.encode(text)[:max_length]
+
     def decode(self, ids: Sequence[int]) -> str:
         # ids outside [OFFSET, OFFSET+256) are skipped, not an error: models
         # may have a larger vocab than the tokenizer (padded/rounded vocab
@@ -49,7 +85,15 @@ class ByteTokenizer:
 
 
 class HFTokenizer:
-    """A Hugging Face tokenizer loaded from a local directory."""
+    """A Hugging Face tokenizer loaded from a local directory.
+
+    Layout-bearing roles delegate to the HF tokenizer itself — its
+    post-processor IS the family's special-token layout (BART's
+    ``<s>…</s>``, T5's ``…</s>``, LLaMA's BOS-only), and HF truncation
+    keeps the trailing specials — so ids match
+    ``AutoTokenizer.__call__(text, max_length=…, truncation=True)``
+    exactly (the reference recipe, reference train-accelerator.py:114-133;
+    parity test: tests/test_tokenizer_parity.py)."""
 
     def __init__(self, path: str):
         from transformers import AutoTokenizer
@@ -57,10 +101,39 @@ class HFTokenizer:
         self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
         self.vocab_size = len(self._tok)
         self.pad_id = self._tok.pad_token_id if self._tok.pad_token_id is not None else 0
-        self.eos_id = self._tok.eos_token_id if self._tok.eos_token_id is not None else 1
+        # _has_eos gates EOS-aware layout edits below: when the loaded
+        # tokenizer defines no eos_token, the fallback id 1 is just an
+        # ordinary vocab token and must be neither stripped nor appended
+        self._has_eos = self._tok.eos_token_id is not None
+        self.eos_id = self._tok.eos_token_id if self._has_eos else 1
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=False)
+
+    def encode_source(self, text: str, max_length: int) -> list[int]:
+        return self._tok(text, max_length=max_length, truncation=True)["input_ids"]
+
+    def encode_target(self, text: str, max_length: int) -> list[int]:
+        # text_target routes through the target-side post-processor (for
+        # BART/T5 identical to the source side; kept distinct for families
+        # where it differs) — the reference's `text_target=` call path
+        return self._tok(text_target=text, max_length=max_length, truncation=True)["input_ids"]
+
+    def encode_prompt(self, text: str, max_length: int) -> list[int]:
+        # a causal prompt keeps its leading specials (LLaMA's BOS) but must
+        # NOT end the document — strip any trailing EOS the layout added
+        ids = self._tok(text, max_length=max_length, truncation=True)["input_ids"]
+        while self._has_eos and ids and ids[-1] == self.eos_id:
+            ids = ids[:-1]
+        return ids
+
+    def encode_continuation(self, text: str, max_length: int) -> list[int]:
+        # continuation of an already-started document: content ids only
+        # (a BOS here would be a mid-sequence document restart) + EOS
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if not self._has_eos:
+            return ids[:max_length]
+        return ids[: max_length - 1] + [self.eos_id]
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode([i for i in ids], skip_special_tokens=True)

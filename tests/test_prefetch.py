@@ -16,15 +16,28 @@ from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
 
 
 class CountingTokenizer:
-    """Wraps the byte tokenizer, counting encode calls."""
+    """Wraps the byte tokenizer, counting role-method encode calls (the
+    entry points datasets actually use)."""
 
     def __init__(self):
         self._tok = get_tokenizer("byte", "")
         self.encode_calls = 0
 
-    def encode(self, text):
+    def _count(self, method, *args):
         self.encode_calls += 1
-        return self._tok.encode(text)
+        return getattr(self._tok, method)(*args)
+
+    def encode_source(self, text, max_length):
+        return self._count("encode_source", text, max_length)
+
+    def encode_target(self, text, max_length):
+        return self._count("encode_target", text, max_length)
+
+    def encode_prompt(self, text, max_length):
+        return self._count("encode_prompt", text, max_length)
+
+    def encode_continuation(self, text, max_length):
+        return self._count("encode_continuation", text, max_length)
 
     def __getattr__(self, name):
         return getattr(self._tok, name)

@@ -66,9 +66,13 @@ def _bias_spec(bias_shape, block_q: int, block_k: int):
 
 
 def _causal_mask(s, qi, ki, block_q: int, block_k: int):
+    # -inf, not a large finite value: a finite mask score would dominate
+    # m_next for rows whose every VALID key is -inf-bias-masked, making the
+    # forward average v over causally-forbidden positions.  The online
+    # softmax handles -inf via safe_m (fwd) and the lse sentinel (bwd).
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(q_pos >= k_pos, s, MASK_VALUE)
+    return jnp.where(q_pos >= k_pos, s, -jnp.inf)
 
 
 # ---------------------------------------------------------------- forward
@@ -113,8 +117,13 @@ def _fwd_kernel(
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_next = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_next)
-        p = jnp.exp(s - m_next)  # (block_q, block_k)
+        # a row can still be all -inf here (every key masked by a -inf
+        # bias): -inf - -inf = NaN would poison alpha/p, so substitute a
+        # finite max — exp(-inf - 0) = 0 then zeroes those entries, l
+        # stays 0, and _finish's sentinel takes over
+        safe_m = jnp.where(m_next == -jnp.inf, 0.0, m_next)
+        alpha = jnp.exp(m_prev - safe_m)
+        p = jnp.exp(s - safe_m)  # (block_q, block_k)
         l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[:] = jax.lax.broadcast_in_dim(m_next[:, 0], m_scr.shape, (0,))
         l_scr[:] = jax.lax.broadcast_in_dim(l_next[:, 0], l_scr.shape, (0,))
@@ -220,7 +229,13 @@ def _bwd_dq_kernel(
             s += lbias_ref[0, 0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0][:, :1])  # (block_q, block_k)
+        lse = lse_ref[0, 0][:, :1]
+        p = jnp.exp(s - lse)  # (block_q, block_k)
+        # fully-masked rows save lse = MASK_VALUE (sentinel, fwd kernel):
+        # exp(s - sentinel) is garbage there (overflows to inf when any s
+        # is finite), and inf·0 = NaN would poison the gradient — zero
+        # those rows explicitly
+        p = jnp.where(lse <= MASK_VALUE / 2, 0.0, p)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -267,7 +282,10 @@ def _bwd_dkv_kernel(
             s += lbias_ref[0, 0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        lse = lse_ref[0, 0][:, :1]
+        p = jnp.exp(s - lse)
+        # zero fully-masked rows (lse == MASK_VALUE sentinel) — see dq kernel
+        p = jnp.where(lse <= MASK_VALUE / 2, 0.0, p)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -323,9 +341,12 @@ def _bwd_dlbias_kernel(
         s += lbias_ref[0, 0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        # masked entries have s = MASK_VALUE → p underflows to exactly 0,
-        # so they contribute nothing to the bias gradient
-        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        # masked entries in a live row have s = -inf → p is exactly 0;
+        # FULLY-masked rows save lse = MASK_VALUE (sentinel), so exp(s -
+        # lse) is garbage there — zero those rows explicitly
+        lse = lse_ref[0, 0][:, :1]
+        p = jnp.exp(s - lse)
+        p = jnp.where(lse <= MASK_VALUE / 2, 0.0, p)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -796,9 +817,10 @@ def flash_attention_lbias_sharded(
     ``make_flash_lbias_sharded``).  Same shape/validation contract as
     ``flash_attention``; block sizes are the per-shard auto defaults
     (q and the learned bias's Q dim are full-length per shard — only batch
-    and heads split).  The mask additionally must not carry a head dim
+    and heads split).  The mask additionally must not carry a HEAD dim
     (the per-shard BlockSpec would index the wrong heads on non-first
-    tensor shards)."""
+    tensor shards); a full query dim — a (B, 1, Q, K) mask — is fine, since
+    Q/K are unsharded here."""
     if causal and q.shape[2] != k.shape[2]:
         raise ValueError(
             f"causal=True requires square self-attention, got q_len={q.shape[2]} "
@@ -823,7 +845,7 @@ def flash_attention_lbias_sharded(
         ):
             if bd not in (1, full):
                 raise ValueError(
-                    f"bias dim {i} is {bd}, must be 1 or {full} (head/query dims "
+                    f"bias dim {i} is {bd}, must be 1 or {full} (the head dim "
                     "must be 1 on the sharded learned-bias path)"
                 )
     want = (1, q.shape[1], q.shape[2], k.shape[2])

@@ -155,3 +155,54 @@ def test_parity_non_pow2_length():
     out = flash_attention(q, k, v, causal=True, interpret=True)
     ref = dot_product_attention(q, k, v, make_causal_bias(320, 320))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_fully_masked_rows_give_finite_zero_grads():
+    """The advisor's edge: causal attention plus an additive -inf padding
+    bias that masks EVERY key of example 0.  Its rows' only finite scores
+    are the causally-masked MASK_VALUE entries, so the saved lse lands at
+    ~MASK_VALUE; the backward kernels must zero p for such rows (lse at the
+    sentinel scale) or they contribute garbage — potentially inf/NaN once a
+    learned bias shifts s — to the batch-summed learned-bias gradient.
+    Dead-example grads must be exactly zero and the live example's grads
+    (and the summed dlbias) must equal a run without the dead example."""
+    q_len = kv_len = 64
+    q, k, v = _qkv(q_len, kv_len)
+    mask = np.ones((B, kv_len), np.float32)
+    mask[0, :] = 0  # example 0: every key masked
+    bias = jnp.where(jnp.asarray(mask)[:, None, None, :] > 0, 0.0, -jnp.inf)
+    rng = np.random.RandomState(2)
+    lbias = jnp.asarray(rng.randn(1, H, q_len, kv_len).astype(np.float32) * 0.1)
+
+    def loss(q, k, v, lbias, bias):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, bias, learned_bias=lbias, causal=True, block_q=32, block_k=32
+            )
+            ** 2
+        )
+
+    # the dead example's FORWARD output must be exact zeros (not an
+    # average of v over causally-forbidden positions)
+    out = flash_attention(
+        q, k, v, bias, learned_bias=lbias, causal=True, block_q=32, block_k=32
+    )
+    np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+    assert np.isfinite(np.asarray(out)).all()
+
+    g_full = jax.grad(loss, argnums=(0, 1, 2, 3))(q, k, v, lbias, bias)
+    for g in g_full:
+        assert np.isfinite(np.asarray(g)).all(), "NaN/inf gradient from fully-masked rows"
+    # the dead example contributes nothing to its own q/k/v grads...
+    for g in g_full[:3]:
+        np.testing.assert_array_equal(np.asarray(g[0]), 0.0)
+    # ...and nothing to the batch-summed learned-bias grad: grads must
+    # match a run over the live examples only
+    g_live = jax.grad(loss, argnums=(0, 1, 2, 3))(
+        q[1:], k[1:], v[1:], lbias, bias[1:]
+    )
+    for a, b in zip(g_full[:3], g_live[:3]):
+        np.testing.assert_allclose(np.asarray(a[1:]), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(g_full[3]), np.asarray(g_live[3]), atol=1e-5
+    )

@@ -119,6 +119,11 @@ class TrainConfig:
     # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
     # bubble fraction is (stages-1)/(microbatches+stages-1)
     pipeline_microbatches: int = 0
+    # "gpipe": forward scan + autodiff backward, O(microbatches) activation
+    # memory per stage.  "1f1b": fused schedule interleaving backward with
+    # forward microbatches, O(stages) activation memory — the schedule that
+    # makes large microbatch counts affordable (decoder-only families)
+    pipeline_schedule: str = "gpipe"
     # MoE expert capacity override for fine-tuning (None = keep the model's
     # own setting; HF-converted Mixtral defaults to no-drop, which is exact
     # but memory-hungry — 1.25 restores the capacity trade for training)
@@ -200,6 +205,11 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)
+    p.add_argument(
+        "--pipeline-schedule", type=str, default=_D.pipeline_schedule,
+        choices=("gpipe", "1f1b"),
+        help="stage>1 schedule: gpipe (O(M) activation memory) or 1f1b (O(S))",
+    )
     p.add_argument("--moe-capacity-factor", type=float, default=_D.moe_capacity_factor)
     p.add_argument(
         "--no-pipeline-eval-rouge", action="store_true",

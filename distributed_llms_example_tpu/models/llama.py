@@ -13,6 +13,7 @@ import dataclasses
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distributed_llms_example_tpu.ops.attention import mask_to_bias
@@ -139,9 +140,13 @@ class PipelinedLlama:
     """
 
     def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32,
-                 num_microbatches: int = 0, remat: bool = True):
+                 num_microbatches: int = 0, remat: bool = True,
+                 schedule: str = "gpipe"):
         # imported here so a missing pipeline module fails at construction
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline schedule {schedule!r}: must be gpipe or 1f1b")
 
         if mesh.shape.get("sequence", 1) > 1:
             raise ValueError(
@@ -164,14 +169,85 @@ class PipelinedLlama:
         self.dtype = dtype
         self.num_microbatches = num_microbatches or max(stages, 1)
         self.remat = remat  # per-layer jax.checkpoint inside the pipeline
+        self.pipeline_schedule = schedule
         self._embed = nn.Embed(config.vocab_size, config.hidden_size, dtype=dtype)
         self._block = LlamaBlock(config, dtype=dtype)
         self._norm = RMSNorm(config.rms_norm_eps, dtype)
         self._head = nn.Dense(config.vocab_size, use_bias=False, dtype=dtype)
 
+    def _layer_fn(self):
+        from distributed_llms_example_tpu.parallel.activation import activation_mesh
+
+        def layer_fn(p, h, ex, key=None):
+            # no ambient mesh inside the pipeline body: attention runs its
+            # single-shard path per stage (no nested shard_map).  ``key``
+            # satisfies the pipeline rng contract (layer_fn(p, h, ex[, key]));
+            # LLaMA blocks are dropout-free (config.dropout_rate == 0) so a
+            # provided key changes nothing, but the call must not crash.
+            rngs = {} if key is None else {"dropout": key}
+            with activation_mesh(None):
+                return self._block.apply({"params": p}, h, ex.get("bias"), rngs=rngs)
+
+        return layer_fn
+
+    def make_value_and_grad(self, label_smoothing: float = 0.0,
+                            is_seq2seq: bool = False):
+        """1F1B training path: ``(params, batch, rng) -> (loss_sum, tokens,
+        grads)`` with the schedule owning the backward pass
+        (``pipeline_value_and_grad``).  The embedding runs outside the
+        pipeline under GSPMD with its own ``jax.vjp``; final norm + LM head
+        + next-token CE run per-microbatch on the last stage so each
+        microbatch's activation-gradient enters the backward ring on the
+        tick its forward finishes."""
+        from distributed_llms_example_tpu.parallel.activation import activation_mesh
+        from distributed_llms_example_tpu.parallel.pipeline import pipeline_value_and_grad
+        from distributed_llms_example_tpu.train.step import cross_entropy_sums
+
+        assert not is_seq2seq
+
+        def post_loss(pp, h, mb):
+            with activation_mesh(None):
+                h = self._norm.apply({"params": pp["final_norm"]}, h)
+                logits = self._head.apply({"params": pp["lm_head"]}, h)
+            return cross_entropy_sums(logits[:, :-1], mb["labels"][:, 1:], label_smoothing)
+
+        layer_fn = self._layer_fn()
+
+        def value_and_grad_sums(params, batch, rng=None):
+            hidden, embed_vjp = jax.vjp(
+                lambda ep: constrain_hidden(
+                    self._embed.apply({"params": ep}, batch["input_ids"])
+                ),
+                params["embed_tokens"],
+            )
+            bias = mask_to_bias(batch["attention_mask"])
+            post_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+            lsum, tokens, d_stacked, d_post, d_hidden = pipeline_value_and_grad(
+                layer_fn,
+                post_loss,
+                params["stacked_blocks"],
+                post_params,
+                hidden,
+                {"bias": bias},
+                {"labels": batch["labels"]},
+                mesh=self.mesh,
+                num_microbatches=self.num_microbatches,
+                checkpoint=self.remat,
+                rng=rng,
+            )
+            (d_embed,) = embed_vjp(d_hidden.astype(hidden.dtype))
+            grads = {
+                "embed_tokens": d_embed,
+                "stacked_blocks": d_stacked,
+                "final_norm": d_post["final_norm"],
+                "lm_head": d_post["lm_head"],
+            }
+            return lsum, tokens, grads
+
+        return value_and_grad_sums
+
     def apply(self, variables, input_ids, attention_mask=None, *,
               deterministic: bool = True, rngs=None):
-        from distributed_llms_example_tpu.parallel.activation import activation_mesh
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
 
         params = variables["params"]
@@ -179,14 +255,8 @@ class PipelinedLlama:
         bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         extras = {"bias": bias} if bias is not None else {}
 
-        def layer_fn(p, h, ex):
-            # no ambient mesh inside the pipeline body: attention runs its
-            # single-shard path per stage (no nested shard_map)
-            with activation_mesh(None):
-                return self._block.apply({"params": p}, h, ex.get("bias"))
-
         hidden = pipeline_apply(
-            layer_fn,
+            self._layer_fn(),
             params["stacked_blocks"],
             hidden,
             extras,

@@ -70,6 +70,13 @@ def _causal_mask(s, qi, ki, block_q: int, block_k: int):
     # m_next for rows whose every VALID key is -inf-bias-masked, making the
     # forward average v over causally-forbidden positions.  The online
     # softmax handles -inf via safe_m (fwd) and the lse sentinel (bwd).
+    # NOTE the exact-zero/zero-grad guarantee for fully-masked rows holds
+    # only for true -inf biases; a finite large-negative padding bias
+    # (ops/attention.py NEG_INF = -1e9, chosen because the XLA softmax path
+    # NaNs on all--inf rows) leaves an all-padded row as a garbage-but-
+    # finite uniform average — identical to the XLA path's behavior, and
+    # unreachable from the data pipeline (every example carries ≥1 real
+    # token, so no all-masked rows exist in training).
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return jnp.where(q_pos >= k_pos, s, -jnp.inf)

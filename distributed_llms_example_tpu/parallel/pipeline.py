@@ -219,6 +219,32 @@ def _vary(tree, axis_name: str):
     return jax.tree.map(lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
 
 
+def _make_run_stage(layer_fn: Callable, checkpoint: bool) -> Callable:
+    """One stage's work: an inner ``lax.scan`` over its local layer stack,
+    each layer optionally ``jax.checkpoint``-ed.  With a key, ``layer_fn``
+    takes a fourth argument folded to be unique per local layer (callers
+    fold stage and microbatch in first)."""
+    one_layer = jax.checkpoint(layer_fn) if checkpoint else layer_fn
+
+    def run_stage(local_params: Any, x: jnp.ndarray, ex: Any,
+                  key: jnp.ndarray | None = None) -> jnp.ndarray:
+        local_l = jax.tree.leaves(local_params)[0].shape[0]
+        if key is None:
+            def step(carry, p):
+                return one_layer(p, carry, ex), None
+
+            y, _ = jax.lax.scan(step, x, local_params)
+        else:
+            def step(carry, xs):
+                p, i = xs
+                return one_layer(p, carry, ex, jax.random.fold_in(key, i)), None
+
+            y, _ = jax.lax.scan(step, x, (local_params, jnp.arange(local_l)))
+        return y
+
+    return run_stage
+
+
 def pipeline_apply(
     layer_fn: Callable[[Any, jnp.ndarray, Any], jnp.ndarray],
     stacked_params: Any,
@@ -264,23 +290,7 @@ def pipeline_apply(
             f"× {M} microbatches"
         )
 
-    one_layer = jax.checkpoint(layer_fn) if checkpoint else layer_fn
-
-    def run_stage(local_params: Any, x: jnp.ndarray, ex: Any,
-                  key: jnp.ndarray | None = None) -> jnp.ndarray:
-        local_l = jax.tree.leaves(local_params)[0].shape[0]
-        if key is None:
-            def step(carry, p):
-                return one_layer(p, carry, ex), None
-
-            y, _ = jax.lax.scan(step, x, local_params)
-        else:
-            def step(carry, xs):
-                p, i = xs
-                return one_layer(p, carry, ex, jax.random.fold_in(key, i)), None
-
-            y, _ = jax.lax.scan(step, x, (local_params, jnp.arange(local_l)))
-        return y
+    run_stage = _make_run_stage(layer_fn, checkpoint)
 
     if S == 1:
         # no pipeline: plain scan over the full stack under GSPMD
@@ -379,3 +389,246 @@ def pipeline_apply(
         out_specs=P(),
         check_vma=True,
     )(stacked_params, hidden, extras, rng_tree)
+
+
+def pipeline_value_and_grad(
+    layer_fn: Callable,
+    post_loss_fn: Callable,
+    stacked_params: Any,
+    post_params: Any,
+    hidden: jnp.ndarray,
+    extras: Any,
+    loss_batch: Any,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "stage",
+    batch_axes: tuple[str, ...] = ("data", "fsdp", "expert"),
+    checkpoint: bool = True,
+    rng: jnp.ndarray | None = None,
+):
+    """1F1B pipeline schedule: loss AND parameter gradients in ONE fused
+    scan, backward microbatches interleaved with forward.
+
+    The GPipe path (``pipeline_apply`` + autodiff) must keep every
+    microbatch's stage activations alive between the forward scan and the
+    reversed backward scan — O(M) activations per stage.  Differentiating
+    through the scan cannot reorder that; interleaving requires owning the
+    backward, so this function computes gradients itself:
+
+    - tick ``t``, stage ``s`` FORWARDS microbatch ``t - s`` (saving only
+      the CHUNK INPUT in a ring buffer of ``2S - 1`` slots) and BACKWARDS
+      microbatch ``t - (2(S-1) - s)`` via ``jax.vjp`` of the stage chunk —
+      which recomputes the chunk forward, so per-stage activation memory is
+      O(S) ring slots + one chunk's transient, independent of M;
+    - the last stage folds the loss in-tick: its just-finished forward
+      microbatch immediately drives ``post_loss_fn``'s vjp, and the
+      resulting activation-gradient starts hopping backwards on the SAME
+      tick (the 1F1B signature — microbatch 0's backward begins while
+      microbatch S's forward is still entering the pipe);
+    - activation-gradients ride a second ``ppermute`` ring in the opposite
+      direction; total ticks = M + 2(S-1).
+
+    The trade, honestly: under SPMD every stage executes both the F and B
+    slots of every tick (masked when inactive), so wall-clock is
+    ~(M + 2(S-1)) fused ticks vs GPipe's (M+S-1) forward + (M+S-1)
+    backward ticks — about (S-1) extra tick-equivalents of compute — in
+    exchange for activation memory dropping from O(M) to O(S) microbatches
+    per stage.  That is the trade that makes LARGE microbatch counts (the
+    bubble amortizer) affordable at stage>2.
+
+    ``layer_fn(p, h, ex[, key]) -> h`` as in ``pipeline_apply``.
+    ``post_loss_fn(post_params, h, loss_microbatch) -> (loss_sum, tokens)``
+    runs the model tail + loss for ONE microbatch (token-SUM semantics so
+    microbatch results add exactly).  ``loss_batch``: pytree of per-example
+    arrays (leading dim B) consumed by the loss.  Returns
+    ``(loss_sum, tokens, d_stacked, d_post, d_hidden)`` — unnormalized
+    sums, gradients of loss_sum w.r.t. the three differentiable inputs.
+
+    Schedule-only reordering: the math per microbatch is identical to the
+    sequential computation, so results match GPipe and the single-device
+    step exactly (tests/test_pipeline.py::test_1f1b_*).
+    """
+    S = mesh.shape.get(axis_name, 1)
+    M = num_microbatches
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if L % max(S, 1):
+        raise ValueError(f"{L} layers not divisible into {S} pipeline stages")
+    B = hidden.shape[0]
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
+    batch_shards = 1
+    for a in batch_axes:
+        batch_shards *= mesh.shape[a]
+    if B % (batch_shards * M):
+        raise ValueError(
+            f"global batch {B} not divisible by {batch_shards} batch shards "
+            f"× {M} microbatches"
+        )
+    run_stage = _make_run_stage(layer_fn, checkpoint)
+
+    if S == 1:
+        # no pipeline: one vjp over (blocks ∘ tail) under plain GSPMD
+        def whole(sp, pp, h):
+            return post_loss_fn(pp, run_stage(sp, h, extras, rng), loss_batch)
+
+        (lsum, tokens), vjp = jax.vjp(whole, stacked_params, post_params, hidden)
+        d_sp, d_pp, d_h = vjp((jnp.ones((), lsum.dtype), jnp.zeros((), tokens.dtype)))
+        return lsum, tokens, d_sp, d_pp, d_h
+
+    is_batched = jax.tree.map(lambda m: m.ndim > 0 and m.shape[0] == B, extras)
+    ex_dtypes = jax.tree.map(lambda m: m.dtype, extras)
+    compute_dtype = hidden.dtype
+    # same partitioner workaround as pipeline_apply: plumbing in fp32
+    plumb_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
+    K = 2 * S - 1  # ring depth ≥ max activation lifetime in ticks (stage 0)
+    T = M + 2 * (S - 1)
+
+    def body(sp_local, pp, h, ex, lb, rt):
+        s_idx = jax.lax.axis_index(axis_name)
+        is_last = s_idx == S - 1
+        ex = jax.tree.map(
+            lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, ex
+        )
+        h, ex, lb = _vary(h.astype(plumb_dtype), axis_name), _vary(ex, axis_name), _vary(lb, axis_name)
+        # pp must be stage-VARYING before entering jax.vjp: differentiating
+        # w.r.t. an unvarying input under a varying cotangent transposes
+        # the implicit broadcast into a hidden psum over stage — the
+        # per-stage d_pp would then already contain every OTHER stage's
+        # (garbage) contribution, leaking through the take_loss mask
+        pp = _vary(pp, axis_name)
+        key = rt.get("key")
+        if key is not None:
+            key = jax.random.fold_in(_vary(key, axis_name), s_idx)
+        mb = h.shape[0] // M
+        micro = h.reshape(M, mb, *h.shape[1:])
+        micro_ex = jax.tree.map(
+            lambda m, batched: m.reshape(M, m.shape[0] // M, *m.shape[1:]) if batched else m,
+            ex, is_batched,
+        )
+        micro_lb = jax.tree.map(lambda m: m.reshape(M, m.shape[0] // M, *m.shape[1:]), lb)
+
+        def ex_at(m_idx):
+            return jax.tree.map(
+                lambda m, batched, dt: (
+                    jax.lax.dynamic_index_in_dim(m, m_idx, 0, keepdims=False)
+                    if batched else m
+                ).astype(dt),
+                micro_ex, is_batched, ex_dtypes,
+            )
+
+        zeros_like_f32 = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: _vary(jnp.zeros(x.shape, jnp.float32), axis_name), t
+        )
+        fwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axis_name)
+        bwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axis_name)
+        act = _vary(jnp.zeros((K, mb, *h.shape[1:]), h.dtype), axis_name)
+        d_sp = zeros_like_f32(sp_local)
+        d_pp = zeros_like_f32(pp)
+        d_h = _vary(jnp.zeros((M, mb, *h.shape[1:]), jnp.float32), axis_name)
+        scal0 = _vary(jnp.zeros((), jnp.float32), axis_name)
+        perm_fwd = [(i, i + 1) for i in range(S - 1)]
+        perm_bwd = [(i + 1, i) for i in range(S - 1)]
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks = carry
+            mf = t - s_idx
+            mb_i = t - (2 * (S - 1) - s_idx)
+            act_f = (mf >= 0) & (mf < M)
+            act_b = (mb_i >= 0) & (mb_i < M)
+            mf_c = jnp.clip(mf, 0, M - 1)
+            mb_c = jnp.clip(mb_i, 0, M - 1)
+
+            # ---- forward: one microbatch through this stage's chunk
+            x0 = jax.lax.dynamic_index_in_dim(micro, mf_c, 0, keepdims=False)
+            x_in = jnp.where(s_idx == 0, x0, fwd_buf)
+            ex_f = ex_at(mf_c)
+            key_f = None if key is None else jax.random.fold_in(key, mf_c)
+
+            def chunk_f(p_, x_):
+                return run_stage(
+                    p_, x_.astype(compute_dtype), ex_f, key_f
+                ).astype(plumb_dtype)
+
+            y = chunk_f(sp_local, x_in)
+            act = jax.lax.dynamic_update_index_in_dim(act, x_in, mf_c % K, 0)
+
+            # ---- last stage: loss fwd+vjp for the microbatch it just
+            # finished (1F then immediately 1B of the same microbatch)
+            lb_f = jax.tree.map(
+                lambda m: jax.lax.dynamic_index_in_dim(m, mf_c, 0, keepdims=False),
+                micro_lb,
+            )
+
+            def loss_f(pp_, y_):
+                return post_loss_fn(pp_, y_.astype(compute_dtype), lb_f)
+
+            (ls_m, tk_m), loss_vjp = jax.vjp(loss_f, pp, y)
+            # cotangents must carry exactly the outputs' vma type (varying
+            # or not, depending on what post_loss_fn computes) — derive
+            # them from the outputs themselves
+            d_pp_m, dy_loss = loss_vjp((ls_m * 0 + 1, tk_m * 0))
+            take_loss = is_last & act_f
+            lsum = lsum + jnp.where(take_loss, ls_m.astype(jnp.float32), 0.0)
+            toks = toks + jnp.where(take_loss, tk_m.astype(jnp.float32), 0.0)
+            d_pp = jax.tree.map(
+                lambda a, g: a + jnp.where(take_loss, g.astype(jnp.float32), 0.0),
+                d_pp, d_pp_m,
+            )
+
+            # ---- backward: vjp of this stage's chunk for an EARLIER
+            # microbatch (recomputes the chunk forward — remat)
+            x_b = jax.lax.dynamic_index_in_dim(act, mb_c % K, 0, keepdims=False)
+            ex_b = ex_at(mb_c)
+            key_b = None if key is None else jax.random.fold_in(key, mb_c)
+
+            def chunk_b(p_, x_):
+                return run_stage(
+                    p_, x_.astype(compute_dtype), ex_b, key_b
+                ).astype(plumb_dtype)
+
+            _, chunk_vjp = jax.vjp(chunk_b, sp_local, x_b)
+            dy_in = jnp.where(is_last, dy_loss.astype(plumb_dtype), bwd_buf)
+            d_sp_m, dx = chunk_vjp(dy_in)
+            d_sp = jax.tree.map(
+                lambda a, g: a + jnp.where(act_b, g.astype(jnp.float32), 0.0),
+                d_sp, d_sp_m,
+            )
+            d_h_upd = jax.lax.dynamic_update_index_in_dim(
+                d_h, dx.astype(jnp.float32), mb_c, 0
+            )
+            d_h = jnp.where(act_b & (s_idx == 0), d_h_upd, d_h)
+
+            # ---- hops: activations forward, activation-grads backward
+            fwd_buf = jax.lax.ppermute(y, axis_name, perm_fwd)
+            bwd_buf = jax.lax.ppermute(dx.astype(plumb_dtype), axis_name, perm_bwd)
+            return (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks), None
+
+        carry = (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, scal0, scal0)
+        (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks), _ = jax.lax.scan(
+            tick, carry, jnp.arange(T)
+        )
+        # loss/tail grads live on the last stage, d_hidden on stage 0 (its
+        # updates are already masked to those stages); psum replicates
+        lsum = jax.lax.psum(lsum, axis_name)
+        toks = jax.lax.psum(toks, axis_name)
+        d_pp = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), d_pp)
+        d_h = jax.lax.psum(d_h, axis_name)
+        return lsum, toks, d_sp, d_pp, d_h.reshape(h.shape)
+
+    param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
+    rng_tree = {} if rng is None else {"key": rng}
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        axis_names={axis_name},
+        in_specs=(
+            param_specs,
+            jax.tree.map(lambda _: P(), post_params),
+            P(),
+            jax.tree.map(lambda _: P(), extras),
+            jax.tree.map(lambda _: P(), loss_batch),
+            jax.tree.map(lambda _: P(), rng_tree),
+        ),
+        out_specs=(P(), P(), param_specs, jax.tree.map(lambda _: P(), post_params), P()),
+        check_vma=True,
+    )(stacked_params, post_params, hidden, extras, loss_batch, rng_tree)

@@ -164,13 +164,23 @@ def make_train_step(
         mesh, P(None, ("data", "fsdp", "expert"), "sequence" if seq_sharded else None)
     )
 
-    def value_and_grad_sums(params: Any, batch: dict, rng: jax.Array | None) -> tuple:
-        def wrapped(p):
-            lsum, tokens = loss_sums(p, batch, rng)
-            return lsum, tokens
+    if getattr(model, "pipeline_schedule", "gpipe") == "1f1b":
+        # the 1F1B pipeline owns its backward pass (forward/backward
+        # microbatches interleave inside one fused schedule — autodiff
+        # cannot reorder its backward, so the adapter computes gradients
+        # itself); same (loss_sum, tokens, grads) contract as the
+        # jax.value_and_grad path below
+        value_and_grad_sums = model.make_value_and_grad(
+            label_smoothing, is_seq2seq=is_seq2seq
+        )
+    else:
+        def value_and_grad_sums(params: Any, batch: dict, rng: jax.Array | None) -> tuple:
+            def wrapped(p):
+                lsum, tokens = loss_sums(p, batch, rng)
+                return lsum, tokens
 
-        (lsum, tokens), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
-        return lsum, tokens, grads
+            (lsum, tokens), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
+            return lsum, tokens, grads
 
     def step_fn(state: TrainState, batch: dict, rng: jax.Array | None = None) -> tuple[TrainState, dict]:
         if grad_accum_steps > 1:

@@ -157,6 +157,14 @@ class Trainer:
                 num_microbatches=cfg.pipeline_microbatches,
                 remat=cfg.remat,
             )
+            if cfg.pipeline_schedule == "1f1b":
+                if self.loaded.family != "llama":
+                    raise ValueError(
+                        "--pipeline-schedule 1f1b currently supports decoder-only "
+                        f"(llama) families, not {self.loaded.family!r}; the seq2seq "
+                        "adapters' twin encoder/decoder pipelines use gpipe"
+                    )
+                adapter_kw["schedule"] = "1f1b"
             if self.loaded.family == "llama":
                 from distributed_llms_example_tpu.models.llama import PipelinedLlama as Adapter
             elif self.loaded.family == "bart":
@@ -176,6 +184,7 @@ class Trainer:
                 "family": self.loaded.family,
                 "stages": self.mesh.shape["stage"],
                 "num_microbatches": self.model.num_microbatches,
+                "schedule": getattr(self.model, "pipeline_schedule", "gpipe"),
             })
 
         params = shard_params(params, self.mesh, self._rules)

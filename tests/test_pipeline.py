@@ -666,3 +666,186 @@ def test_fsdp_stage_mesh_keeps_generation_rouge(tmp_path):
     )
     trainer = Trainer(cfg, train_records=records, val_records=records[:4])
     assert trainer.pipelined and trainer._pipeline_rouge_ok
+
+
+@pytest.mark.parametrize("stages,micro", [(2, 4), (4, 2)])
+def test_1f1b_train_step_equals_single_device(tiny_llama4, stages, micro):
+    """1F1B is a SCHEDULE-only change: interleaving backward microbatches
+    with forward must reproduce the single-device loss, grad norm, and the
+    gpipe path's metrics exactly (same math, different order)."""
+    import optax
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    cfg, module, params0 = tiny_llama4
+    rng = np.random.RandomState(13)
+    b, src = 16, 16
+    ids = rng.randint(2, cfg.vocab_size, (b, src)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, :4] = LABEL_PAD
+    mask = np.ones((b, src), np.int32)
+    mask[:2, -3:] = 0
+    batch = {"input_ids": ids, "attention_mask": mask, "labels": labels}
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    mesh1 = build_mesh(MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1])
+    build = make_train_step(module, cfg, tx, schedule, mesh1, donate=False, is_seq2seq=False)
+    state = create_train_state(shard_params(params0, mesh1), tx)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh1))
+    step, _ = build(state)
+    ref_state, ref = step(state, put_batch(batch, mesh1))
+
+    mesh_p = build_mesh(MeshConfig(stage=stages, data=8 // stages, fsdp=1, sequence=1, tensor=1))
+    piped = PipelinedLlama(cfg, mesh_p, num_microbatches=micro, schedule="1f1b")
+    assert piped.pipeline_schedule == "1f1b"
+    rules = pipeline_rules()
+    state_p = create_train_state(shard_params(stack_blocks(params0), mesh_p, rules), tx)
+    state_p = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, mesh_p, rules)
+    )
+    build_p = make_train_step(
+        piped, cfg, tx, schedule, mesh_p, rules=rules, donate=False, is_seq2seq=False
+    )
+    step_p, _ = build_p(state_p)
+    new_state_p, got = step_p(state_p, put_batch(batch, mesh_p))
+
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+    assert float(got["target_tokens"]) == float(ref["target_tokens"])
+    # updated params match layer-for-layer after unstacking
+    upd = unstack_blocks(jax.device_get(new_state_p.params))
+    ref_upd = jax.device_get(ref_state.params)
+    for lyr in ("block_0", f"block_{cfg.num_hidden_layers - 1}"):
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(upd[lyr])[0]),
+            np.asarray(jax.tree.leaves(ref_upd[lyr])[0]),
+            atol=1e-5, rtol=1e-4,
+        )
+    np.testing.assert_allclose(
+        np.asarray(upd["lm_head"]["kernel"]),
+        np.asarray(ref_upd["lm_head"]["kernel"]),
+        atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_1f1b_composes_with_tensor_parallel(tiny_llama4):
+    """1F1B on stage=2 × tensor=2 × data=2: the chunk vjps run under GSPMD
+    auto-partitioning over tensor, same as the gpipe body."""
+    import optax
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    cfg, module, params0 = tiny_llama4
+    rng = np.random.RandomState(17)
+    b, src = 8, 16
+    ids = rng.randint(2, cfg.vocab_size, (b, src)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, :6] = LABEL_PAD
+    batch = {"input_ids": ids, "attention_mask": np.ones((b, src), np.int32), "labels": labels}
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    mesh1 = build_mesh(MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1])
+    build = make_train_step(module, cfg, tx, schedule, mesh1, donate=False, is_seq2seq=False)
+    state = create_train_state(shard_params(params0, mesh1), tx)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh1))
+    step, _ = build(state)
+    _, ref = step(state, put_batch(batch, mesh1))
+
+    mesh_p = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=1, tensor=2))
+    piped = PipelinedLlama(cfg, mesh_p, num_microbatches=2, schedule="1f1b")
+    rules = pipeline_rules()
+    state_p = create_train_state(shard_params(stack_blocks(params0), mesh_p, rules), tx)
+    state_p = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, mesh_p, rules)
+    )
+    build_p = make_train_step(
+        piped, cfg, tx, schedule, mesh_p, rules=rules, donate=False, is_seq2seq=False
+    )
+    step_p, _ = build_p(state_p)
+    _, got = step_p(state_p, put_batch(batch, mesh_p))
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+
+
+def test_trainer_1f1b_end_to_end(tmp_path):
+    """Trainer with --pipeline-schedule 1f1b on stage=2 × data=4: trains,
+    evaluates (stage-sharded val loss), exports per-layer HF layout."""
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    rng = np.random.RandomState(7)
+    records = [
+        {
+            "dialogue": " ".join(f"w{rng.randint(50)}" for _ in range(rng.randint(5, 20))),
+            "summary": "w1 w2",
+        }
+        for _ in range(16)
+    ]
+    cfg = TrainConfig(
+        model_ckpt="llama-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        warmup_steps=0,
+        learning_rate=1e-3,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        log_every_steps=1,
+        mesh=MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        tokenizer="byte",
+        pipeline_microbatches=2,
+        pipeline_schedule="1f1b",
+    )
+    trainer = Trainer(cfg, train_records=records, val_records=records[:4])
+    assert trainer.pipelined
+    assert trainer.model.pipeline_schedule == "1f1b"
+    result = trainer.train()
+    assert result["steps"] == trainer.total_steps
+    assert np.isfinite(result["final_eval"]["val_loss"])
+    from distributed_llms_example_tpu.models.registry import load_model
+
+    reloaded = load_model(os.path.join(str(tmp_path), "model"))
+    assert "block_0" in reloaded.params
+
+
+def test_1f1b_rejected_for_seq2seq(tmp_path):
+    """The twin-pipeline seq2seq adapters are gpipe-only; asking for 1f1b
+    must fail loudly at Trainer construction, not silently degrade."""
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    records = [{"dialogue": "a b c", "summary": "a"} for _ in range(8)]
+    cfg = TrainConfig(
+        model_ckpt="bart-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        max_source_length=32,
+        max_target_length=16,
+        pad_to_multiple=16,
+        mesh=MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        tokenizer="byte",
+    )
+    with pytest.raises(ValueError, match="1f1b"):
+        Trainer(cfg.replace(pipeline_schedule="1f1b"), train_records=records)

@@ -131,9 +131,11 @@ class PipelinedLlama:
     ``unstack_blocks`` to return to the per-layer layout).  Embedding,
     final norm, and LM head run outside the pipeline body under plain
     GSPMD.  The pipeline shard_map is manual over ``stage`` ONLY, so
-    ``stage`` composes with data/fsdp (batch) AND ``tensor`` (megatron
+    ``stage`` composes with data/fsdp (batch), ``tensor`` (megatron
     splits on the stacked kernels, partitioned automatically by GSPMD
-    inside each stage) — the stage×tensor topology 7B+ models use.
+    inside each stage — the stage×tensor topology 7B+ models use) AND
+    ``expert`` (MoE configs on the gpipe schedule: the load-balance loss
+    rides out of the pipeline as an explicit output, see ``_layer_fn``).
     ``sequence`` is still excluded (ring attention is its own fully-manual
     shard_map; manual regions don't nest).  Training + teacher-forced
     scoring only: no KV-cache generation path (unstack for decoding).
@@ -152,12 +154,11 @@ class PipelinedLlama:
             raise ValueError(
                 "pipeline (stage>1) does not compose with sequence parallelism"
             )
-        if getattr(config, "num_experts", 0) > 0:
+        if getattr(config, "num_experts", 0) > 0 and schedule == "1f1b":
             raise ValueError(
-                "pipeline (stage>1) does not support MoE configs yet: the "
-                "load-balance loss sown inside the pipeline body cannot reach "
-                "the loss fn (and the train step's mutable-apply path is not "
-                "wired through this adapter)"
+                "pipeline schedule 1f1b does not support MoE configs: the "
+                "load-balance aux loss is carried as an explicit pipeline "
+                "output on the gpipe path only"
             )
         stages = mesh.shape.get("stage", 1)
         if config.num_hidden_layers % max(stages, 1):
@@ -175,7 +176,7 @@ class PipelinedLlama:
         self._norm = RMSNorm(config.rms_norm_eps, dtype)
         self._head = nn.Dense(config.vocab_size, use_bias=False, dtype=dtype)
 
-    def _layer_fn(self):
+    def _layer_fn(self, with_aux: bool = False):
         from distributed_llms_example_tpu.parallel.activation import activation_mesh
 
         def layer_fn(p, h, ex, key=None):
@@ -186,6 +187,16 @@ class PipelinedLlama:
             # provided key changes nothing, but the call must not crash.
             rngs = {} if key is None else {"dropout": key}
             with activation_mesh(None):
+                if with_aux:
+                    # sown collections cannot cross the pipeline shard_map;
+                    # surface the MoE load-balance loss as an explicit
+                    # layer output the schedule accumulates
+                    h, mut = self._block.apply(
+                        {"params": p}, h, ex.get("bias"), rngs=rngs, mutable=["losses"]
+                    )
+                    leaves = jax.tree.leaves(mut.get("losses", {}))
+                    aux = sum(leaves, jnp.zeros((), jnp.float32))
+                    return h, aux
                 return self._block.apply({"params": p}, h, ex.get("bias"), rngs=rngs)
 
         return layer_fn
@@ -247,25 +258,37 @@ class PipelinedLlama:
         return value_and_grad_sums
 
     def apply(self, variables, input_ids, attention_mask=None, *,
-              deterministic: bool = True, rngs=None):
+              deterministic: bool = True, rngs=None, mutable=None):
+        """Flax-compatible: with ``mutable=["losses"]`` (the loss fn's MoE
+        path) returns ``(logits, {"losses": {"moe_aux": aux}})`` where
+        ``aux`` is the per-(layer, microbatch) mean carried OUT of the
+        pipeline as an explicit scan output — matching the standard
+        module's mean-over-layers sow semantics at grad-accumulation
+        (per-microbatch) granularity."""
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
 
         params = variables["params"]
         hidden = constrain_hidden(self._embed.apply({"params": params["embed_tokens"]}, input_ids))
         bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         extras = {"bias": bias} if bias is not None else {}
+        with_aux = bool(mutable) and getattr(self.config, "num_experts", 0) > 0
 
-        hidden = pipeline_apply(
-            self._layer_fn(),
+        out = pipeline_apply(
+            self._layer_fn(with_aux=with_aux),
             params["stacked_blocks"],
             hidden,
             extras,
             mesh=self.mesh,
             num_microbatches=self.num_microbatches,
             checkpoint=self.remat,
+            with_aux=with_aux,
         )
+        hidden, aux = out if with_aux else (out, None)
         hidden = self._norm.apply({"params": params["final_norm"]}, hidden)
-        return constrain_logits(self._head.apply({"params": params["lm_head"]}, hidden))
+        logits = constrain_logits(self._head.apply({"params": params["lm_head"]}, hidden))
+        if mutable:
+            return logits, ({"losses": {"moe_aux": aux}} if with_aux else {})
+        return logits
 
 
 class LlamaForCausalLM(nn.Module):

@@ -31,10 +31,12 @@ Composition (v2): the ``shard_map`` is manual over ``stage`` ONLY
 GSPMD keeps partitioning the per-stage compute over ``data``/``fsdp``
 (batch) and ``tensor`` (megatron splits on the stacked kernels, the
 standard stage×tensor 7B+ topology) inside the pipeline body, inserting
-the collectives itself.  Only ``sequence`` (ring attention is its own
-fully-manual shard_map — nesting manual regions is not supported) and
-MoE (sown aux losses can't cross the shard_map) remain excluded; the
-adapters validate that.
+the collectives itself.  MoE composes too (stage × expert): sown aux
+losses can't cross the shard_map, so ``with_aux`` layer_fns return the
+load-balance loss as an explicit output the schedule accumulates (bubble
+ticks masked) and psums.  Only ``sequence`` (ring attention is its own
+fully-manual shard_map — nesting manual regions is not supported) remains
+excluded; the adapters validate that.
 """
 
 from __future__ import annotations
@@ -219,28 +221,42 @@ def _vary(tree, axis_name: str):
     return jax.tree.map(lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
 
 
-def _make_run_stage(layer_fn: Callable, checkpoint: bool) -> Callable:
+def _make_run_stage(layer_fn: Callable, checkpoint: bool,
+                    with_aux: bool = False) -> Callable:
     """One stage's work: an inner ``lax.scan`` over its local layer stack,
     each layer optionally ``jax.checkpoint``-ed.  With a key, ``layer_fn``
     takes a fourth argument folded to be unique per local layer (callers
-    fold stage and microbatch in first)."""
+    fold stage and microbatch in first).  ``with_aux``: ``layer_fn``
+    returns ``(h, aux_scalar)`` (e.g. an MoE load-balance loss) and
+    ``run_stage`` returns ``(y, aux_sum_over_local_layers)``."""
     one_layer = jax.checkpoint(layer_fn) if checkpoint else layer_fn
 
+    def call(p, x, ex, k):
+        out = one_layer(p, x, ex) if k is None else one_layer(p, x, ex, k)
+        return out if with_aux else (out, jnp.zeros((), jnp.float32))
+
     def run_stage(local_params: Any, x: jnp.ndarray, ex: Any,
-                  key: jnp.ndarray | None = None) -> jnp.ndarray:
+                  key: jnp.ndarray | None = None):
         local_l = jax.tree.leaves(local_params)[0].shape[0]
+        # derive the zero from x so its vma type (stage-varying inside the
+        # pipeline body, plain outside) matches the aux the scan carries
+        aux0 = (x.ravel()[0] * 0).astype(jnp.float32)
         if key is None:
             def step(carry, p):
-                return one_layer(p, carry, ex), None
+                y, aux = call(p, carry[0], ex, None)
+                return (y, carry[1] + aux), None
 
-            y, _ = jax.lax.scan(step, x, local_params)
+            (y, aux), _ = jax.lax.scan(step, (x, aux0), local_params)
         else:
             def step(carry, xs):
                 p, i = xs
-                return one_layer(p, carry, ex, jax.random.fold_in(key, i)), None
+                y, aux = call(p, carry[0], ex, jax.random.fold_in(key, i))
+                return (y, carry[1] + aux), None
 
-            y, _ = jax.lax.scan(step, x, (local_params, jnp.arange(local_l)))
-        return y
+            (y, aux), _ = jax.lax.scan(
+                step, (x, aux0), (local_params, jnp.arange(local_l))
+            )
+        return (y, aux) if with_aux else y
 
     return run_stage
 
@@ -257,11 +273,20 @@ def pipeline_apply(
     batch_axes: tuple[str, ...] = ("data", "fsdp", "expert"),
     checkpoint: bool = True,
     rng: jnp.ndarray | None = None,
+    with_aux: bool = False,
 ) -> jnp.ndarray:
     """Run ``hidden`` through the stacked layers as a pipelined schedule.
 
     ``layer_fn(layer_params, h, extras_microbatch) -> h`` applies ONE
-    layer.  ``hidden``: (B, ...) global batch; ``extras``: optional pytree
+    layer.  ``with_aux``: ``layer_fn`` instead returns ``(h, aux_scalar)``
+    (an MoE load-balance loss term); the call then returns
+    ``(out, aux_mean)`` where ``aux_mean`` averages the per-(layer,
+    microbatch) scalars over all L layers and M microbatches, bubble
+    ticks excluded.  The mean is UNWEIGHTED over microbatches: it equals
+    the grad-accumulation objective (which token-weights each
+    microbatch's aux) exactly when microbatch token counts are uniform,
+    and is otherwise an equal-weight estimator of the same batch-level
+    statistic.  ``hidden``: (B, ...) global batch; ``extras``: optional pytree
     of per-example arrays (leading dim B, e.g. an attention padding bias)
     or per-call constants (leading dim != B, replicated to every stage).
     Requires L % stages == 0 and (local batch) % num_microbatches == 0.
@@ -290,10 +315,13 @@ def pipeline_apply(
             f"× {M} microbatches"
         )
 
-    run_stage = _make_run_stage(layer_fn, checkpoint)
+    run_stage = _make_run_stage(layer_fn, checkpoint, with_aux)
 
     if S == 1:
         # no pipeline: plain scan over the full stack under GSPMD
+        if with_aux:
+            y, aux = run_stage(stacked_params, hidden, extras, rng)
+            return y, aux / L
         return run_stage(stacked_params, hidden, extras, rng)
 
     # which extras are per-example (to be microbatched) vs per-call
@@ -334,10 +362,11 @@ def pipeline_apply(
         )
         buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axis_name)
         outputs = _vary(jnp.zeros((M, mb, *h.shape[1:]), h.dtype), axis_name)
+        aux_acc = _vary(jnp.zeros((), jnp.float32), axis_name)
         perm = [(i, i + 1) for i in range(S - 1)]
 
         def tick(carry, t):
-            buf, outputs = carry
+            buf, outputs, aux_acc = carry
             # stage s processes microbatch (t - s); clamp covers bubble ticks
             m_idx = jnp.clip(t - s_idx, 0, M - 1)
             x0 = jax.lax.dynamic_index_in_dim(micro, m_idx, 0, keepdims=False)
@@ -352,22 +381,33 @@ def pipeline_apply(
             )
             inp = jnp.where(s_idx == 0, x0, buf)
             key_m = None if key is None else jax.random.fold_in(key, m_idx)
-            y = run_stage(
-                local_params, inp.astype(compute_dtype), ex_t, key_m
-            ).astype(plumb_dtype)
+            y = run_stage(local_params, inp.astype(compute_dtype), ex_t, key_m)
+            if with_aux:
+                y, aux_t = y
+                # bubble ticks run the layers on clamped garbage; only
+                # ticks where this stage holds a real microbatch count
+                active = (t >= s_idx) & (t - s_idx < M)
+                aux_acc = aux_acc + jnp.where(active, aux_t, 0.0)
+            y = y.astype(plumb_dtype)
             nxt = jax.lax.ppermute(y, axis_name, perm)
             write = (s_idx == S - 1) & (t >= S - 1)
             upd = jax.lax.dynamic_update_index_in_dim(outputs, y, m_idx, 0)
             outputs = jnp.where(write, upd, outputs)
-            return (nxt, outputs), None
+            return (nxt, outputs, aux_acc), None
 
-        (_, outputs), _ = jax.lax.scan(tick, (buf, outputs), jnp.arange(M + S - 1))
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (buf, outputs, aux_acc), jnp.arange(M + S - 1)
+        )
         # only the last stage holds real results; replicate them to every
         # stage so downstream (final norm / head / loss) is stage-uniform
         outputs = jax.lax.psum(
             jnp.where(s_idx == S - 1, outputs, jnp.zeros_like(outputs)), axis_name
         )
-        return outputs.reshape(h.shape).astype(compute_dtype)
+        out = outputs.reshape(h.shape).astype(compute_dtype)
+        if with_aux:
+            # every (layer, microbatch) contributed once across all stages
+            return out, jax.lax.psum(aux_acc, axis_name) / (L * M)
+        return out
 
     # in/out specs name ONLY the manual axis; shardings over the automatic
     # axes (fsdp/tensor splits on the stacked kernels, data/fsdp on the
@@ -381,12 +421,14 @@ def pipeline_apply(
     def outer(sp, h, ex, rt):
         return body(sp, h, ex, rt.get("key"))
 
+    out_specs = (P(), P()) if with_aux else P()
+
     return jax.shard_map(
         outer,
         mesh=mesh,
         axis_names={axis_name},
         in_specs=(param_specs, P(), extras_specs, rng_specs),
-        out_specs=P(),
+        out_specs=out_specs,
         check_vma=True,
     )(stacked_params, hidden, extras, rng_tree)
 

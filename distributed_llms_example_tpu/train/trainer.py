@@ -144,11 +144,6 @@ class Trainer:
         self.pipelined = self.mesh.shape.get("stage", 1) > 1
         self._rules = None  # None → default FSDP/TP rules everywhere below
         if self.pipelined:
-            if getattr(self.config, "num_experts", 0) > 0:
-                raise ValueError(
-                    "pipeline parallelism (stage>1) does not support MoE "
-                    "configs (sown aux losses cannot cross the stage loop)"
-                )
             from distributed_llms_example_tpu.parallel.pipeline import stack_for_family
             from distributed_llms_example_tpu.parallel.sharding import pipeline_rules
 

@@ -849,3 +849,100 @@ def test_1f1b_rejected_for_seq2seq(tmp_path):
     )
     with pytest.raises(ValueError, match="1f1b"):
         Trainer(cfg.replace(pipeline_schedule="1f1b"), train_records=records)
+
+
+def test_pipelined_moe_equals_grad_accum_single_device():
+    """stage=2 × expert=2 × data=2 with a Mixtral-class MoE model: the
+    load-balance aux loss rides OUT of the pipeline as an explicit scan
+    output (sown collections can't cross the shard_map).  Reference:
+    the standard module on one device with grad_accum = num_microbatches —
+    the same per-microbatch aux statistics the pipeline computes, so loss
+    and grad norm must match exactly."""
+    import optax
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    lm = load_model("mixtral-test")
+    cfg, module = lm.config, lm.module
+    assert cfg.num_experts > 0 and cfg.moe_aux_weight > 0
+    params0 = jax.device_get(lm.init_params(0))
+    M = 2
+    rng = np.random.RandomState(23)
+    b, src = 8, 16
+    ids = rng.randint(2, cfg.vocab_size, (b, src)).astype(np.int32)
+    labels = ids.copy()
+    # uniform loss mask across examples: the pipelined aux is a plain
+    # microbatch mean, exact vs grad-accum only when tokens/microbatch
+    # are equal
+    labels[:, :4] = LABEL_PAD
+    batch = {"input_ids": ids, "attention_mask": np.ones((b, src), np.int32), "labels": labels}
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    mesh1 = build_mesh(MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1])
+    build = make_train_step(
+        module, cfg, tx, schedule, mesh1, donate=False, is_seq2seq=False, grad_accum_steps=M
+    )
+    state = create_train_state(shard_params(params0, mesh1), tx)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh1))
+    step, _ = build(state)
+    _, ref = step(state, put_batch(batch, mesh1))
+
+    mesh_p = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, expert=2, sequence=1, tensor=1))
+    piped = PipelinedLlama(cfg, mesh_p, num_microbatches=M)
+    rules = pipeline_rules()
+    state_p = create_train_state(shard_params(stack_blocks(params0), mesh_p, rules), tx)
+    state_p = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, mesh_p, rules)
+    )
+    build_p = make_train_step(
+        piped, cfg, tx, schedule, mesh_p, rules=rules, donate=False, is_seq2seq=False
+    )
+    step_p, _ = build_p(state_p)
+    _, got = step_p(state_p, put_batch(batch, mesh_p))
+
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+
+
+def test_pipelined_moe_aux_actually_contributes():
+    """The aux loss must actually reach the pipelined objective: zeroing
+    the router weights' aux coefficient changes the loss."""
+    import dataclasses as dc
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.parallel.activation import activation_mesh
+    from distributed_llms_example_tpu.parallel.pipeline import stack_blocks as _stack
+    from distributed_llms_example_tpu.train.step import make_loss_fn
+
+    lm = load_model("mixtral-test")
+    rng = np.random.RandomState(3)
+    ids = rng.randint(2, lm.config.vocab_size, (8, 16)).astype(np.int32)
+    labels = ids.copy(); labels[:, :4] = LABEL_PAD
+    batch = {
+        "input_ids": jnp.asarray(ids),
+        "attention_mask": jnp.asarray(np.ones((8, 16), np.int32)),
+        "labels": jnp.asarray(labels),
+    }
+    mesh_p = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, expert=2, sequence=1, tensor=1))
+    params = _stack(jax.device_get(lm.init_params(0)))
+    piped = PipelinedLlama(lm.config, mesh_p, num_microbatches=2)
+    with activation_mesh(mesh_p):
+        with_aux = make_loss_fn(piped, lm.config, is_seq2seq=False)(params, batch)
+        cfg0 = dc.replace(lm.config, moe_aux_weight=0.0)
+        piped0 = PipelinedLlama(cfg0, mesh_p, num_microbatches=2)
+        without = make_loss_fn(piped0, cfg0, is_seq2seq=False)(params, batch)
+    assert float(with_aux[0]) != pytest.approx(float(without[0]), rel=1e-9)
+    # aux is positive (load-balance penalty) so the objective only grows
+    assert float(with_aux[0]) > float(without[0])

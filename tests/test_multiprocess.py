@@ -142,6 +142,11 @@ def test_multiprocess_loss_parity(tmp_path, single_reference, world):
     train, val, ev_single, losses_single = single_reference
 
     port = _free_port()
+    # stderr to FILES, not pipes: communicate() drains ranks sequentially,
+    # and an undrained 64 KB stderr pipe (gloo/XLA chatter) on a waiting
+    # rank would block it mid-write and deadlock a collective — the same
+    # hazard the preemption test documents, ×world writers here
+    errs = [open(str(tmp_path / f"err{r}.log"), "w") for r in range(world)]
     procs = [
         subprocess.Popen(
             # one SHARED output dir for all ranks: orbax's multi-process
@@ -150,15 +155,19 @@ def test_multiprocess_loss_parity(tmp_path, single_reference, world):
             # deadlock its finalize barrier
             _cli_args(str(tmp_path / "multi"), train, val),
             env=_child_env(8 // world, rank=r, world=world, port=port),
-            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, stdout=subprocess.PIPE, stderr=errs[r], text=True,
         )
         for r in range(world)
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=600)
-        outs.append((p.returncode, out, err))
-    assert all(rc == 0 for rc, _, _ in outs), "\n".join(e[-2000:] for _, _, e in outs)
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=600)
+        outs.append((p.returncode, out))
+    for f in errs:
+        f.close()
+    assert all(rc == 0 for rc, _ in outs), "\n".join(
+        open(str(tmp_path / f"err{r}.log")).read()[-2000:] for r in range(world)
+    )
 
     ev0 = _events(outs[0][1])
     report = next(e for e in ev0 if e.get("event") == "device_report")
@@ -175,7 +184,7 @@ def test_multiprocess_loss_parity(tmp_path, single_reference, world):
     for k in ("rouge1", "rougeL"):
         assert eval_multi[k] == pytest.approx(eval_single[k], abs=1e-6)
     # metrics logging is process-0-only: ranks 1+ must not emit step lines
-    for rc, out, _ in outs[1:]:
+    for rc, out in outs[1:]:
         assert not _step_losses(_events(out))
     # the final artifact is an HF checkpoint written collaboratively into
     # the shared dir (params gathered across hosts, process 0 writes)

@@ -1,0 +1,46 @@
+"""scripts/rouge_parity.py smoke: the one-command parity runner must
+exercise every stage after the download boundary (data load, Trainer
+fine-tune, generation eval, JSON report) with no network and no weights."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from _dllm_env import cpu_mesh_env  # noqa: E402
+
+
+@pytest.mark.slow
+def test_smoke_runs_end_to_end(tmp_path):
+    env = cpu_mesh_env(os.environ, n_devices=8)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "rouge_parity.py"),
+         "--smoke", "--output-dir", str(tmp_path)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    report = json.loads(line)
+    assert set(report) == {"ours", "reference", "delta"}
+    assert "rougeL" in report["ours"]
+    assert report["reference"] is None and report["delta"] is None
+
+
+def test_acquire_model_air_gapped_message(tmp_path, monkeypatch):
+    """Without egress, a hub name must fail with the pre-staging recipe,
+    not an opaque network traceback."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    # force the hub client offline so the test never issues a live request
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    import rouge_parity
+
+    with pytest.raises(SystemExit, match="pre-stage"):
+        rouge_parity.acquire_model("nonexistent/model-name-xyz")
+    local = tmp_path / "ckpt"
+    local.mkdir()
+    assert rouge_parity.acquire_model(str(local)) == str(local)

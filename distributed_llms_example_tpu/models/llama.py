@@ -136,9 +136,13 @@ class PipelinedLlama:
     inside each stage — the stage×tensor topology 7B+ models use) AND
     ``expert`` (MoE configs on the gpipe schedule: the load-balance loss
     rides out of the pipeline as an explicit output, see ``_layer_fn``).
-    ``sequence`` is still excluded (ring attention is its own fully-manual
-    shard_map; manual regions don't nest).  Training + teacher-forced
-    scoring only: no KV-cache generation path (unstack for decoding).
+    ``sequence`` composes on the gpipe schedule via ONE combined manual
+    region over {stage, sequence}: the pipeline installs a
+    ``manual_sequence`` context and the blocks' attention switches to the
+    in-region ring body with RoPE offset to global positions — long-context
+    LLaMA training with the layer stack ALSO split across stages.
+    Training + teacher-forced scoring only: no KV-cache generation path
+    (unstack for decoding).
     """
 
     def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32,
@@ -150,10 +154,18 @@ class PipelinedLlama:
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"pipeline schedule {schedule!r}: must be gpipe or 1f1b")
 
-        if mesh.shape.get("sequence", 1) > 1:
-            raise ValueError(
-                "pipeline (stage>1) does not compose with sequence parallelism"
-            )
+        if mesh.shape.get("sequence", 1) > 1 and mesh.shape.get("stage", 1) > 1:
+            if schedule != "gpipe":
+                raise ValueError(
+                    "pipeline stage×sequence composition runs on the gpipe "
+                    "schedule only (1f1b owns its backward pass; the ring "
+                    "attention inside it is not yet wired through its vjp)"
+                )
+            if getattr(config, "num_experts", 0) > 0:
+                raise ValueError(
+                    "pipeline stage×sequence does not compose with MoE "
+                    "(per-shard router statistics need their own reduction)"
+                )
         if getattr(config, "num_experts", 0) > 0 and schedule == "1f1b":
             raise ValueError(
                 "pipeline schedule 1f1b does not support MoE configs: the "
@@ -282,6 +294,10 @@ class PipelinedLlama:
             num_microbatches=self.num_microbatches,
             checkpoint=self.remat,
             with_aux=with_aux,
+            # stage×sequence: ONE manual region over both axes; the K-only
+            # padding bias shards its K dim and rides the ring with K/V
+            seq_axis="sequence",
+            extras_seq_dims={"bias": 3} if "bias" in extras else {},
         )
         hidden, aux = out if with_aux else (out, None)
         hidden = self._norm.apply({"params": params["final_norm"]}, hidden)

@@ -25,8 +25,12 @@ from distributed_llms_example_tpu.ops.flash_attention import (
     flash_attention,
     flash_supported,
 )
-from distributed_llms_example_tpu.ops.ring_attention import ring_attention_sharded
-from distributed_llms_example_tpu.parallel.activation import BATCH_AXES, current_mesh
+from distributed_llms_example_tpu.ops.ring_attention import ring_attention, ring_attention_sharded
+from distributed_llms_example_tpu.parallel.activation import (
+    BATCH_AXES,
+    current_manual_seq,
+    current_mesh,
+)
 from distributed_llms_example_tpu.utils.jsonlog import log_json
 
 _IMPL_LOGGED: set[tuple] = set()
@@ -266,7 +270,15 @@ class MultiHeadAttention(nn.Module):
             step_bias = jnp.where(valid & causal, 0.0, NEG_INF)
             bias = step_bias if bias is None else bias + step_bias
         elif self.use_rope:
-            pos = jnp.arange(q.shape[2])[None, :] if positions is None else positions
+            if positions is None:
+                pos = jnp.arange(q.shape[2])[None, :]
+                manual = current_manual_seq()
+                if manual is not None:
+                    # inside a manual sequence region q holds a LOCAL shard;
+                    # RoPE must see absolute positions
+                    pos = pos + jax.lax.axis_index(manual[0]) * q.shape[2]
+            else:
+                pos = positions
             cos, sin = rope_cos_sin(pos, self.head_dim, self.rope_theta)
             cos, sin = cos[:, None], sin[:, None]  # add heads axis
             q = apply_rope(q, cos, sin)
@@ -281,6 +293,50 @@ class MultiHeadAttention(nn.Module):
         # path built step_bias above): natively by the flash kernel, or as an
         # additive bias for the XLA path.
         causal_here = self.causal and not use_cache
+        manual = current_manual_seq()
+        if manual is not None and use_cache:
+            # no KV-cache path inside the manual region: cache slots would
+            # be indexed with LOCAL shard positions — fail loudly rather
+            # than decode silently wrong logits
+            raise ValueError(
+                "use_cache is not supported inside a manual sequence region "
+                "(pipeline stage×sequence is training/teacher-forced only; "
+                "unstack the pipelined params to decode)"
+            )
+        if manual is not None:
+            if self.attention_impl in ("xla", "flash"):
+                # the region is manual over the sequence axis: activations
+                # hold local shards and only the ring body can run.  A
+                # forced non-ring impl must fail loudly, not be silently
+                # overridden (same contract as the trainer's forced-ring
+                # startup validation).
+                raise ValueError(
+                    f"attention_impl={self.attention_impl!r} cannot run inside a "
+                    "manual sequence region (pipeline stage×sequence executes "
+                    "ring attention only); use 'auto' or 'ring'"
+                )
+            # Tracing inside a shard_map that is manual over the sequence
+            # axis (the stage×sequence pipeline): q/k/v hold LOCAL sequence
+            # shards and the normal dispatch (which opens its own shard_map
+            # over global arrays) cannot run.  Use the in-region ring body
+            # directly — collectives over the manual axis are exactly what
+            # is legal here.
+            if bias is not None and (bias.shape[1] != 1 or bias.shape[2] != 1):
+                raise ValueError(
+                    "manual sequence region needs a K-only bias (b|1, 1, 1, K); "
+                    f"got {bias.shape}"
+                )
+            _log_impl_once("ring", "manual sequence region (pipeline stage×sequence)")
+            out = ring_attention(
+                q, k, v, bias,
+                axis_name=manual[0], axis_size=manual[1],
+                causal=causal_here, dtype=self.dtype,
+                # partial-manual region: bf16 ppermute transposes hit the
+                # partitioner's copy-chain bug — ride the ring in fp32
+                plumb_fp32=True,
+            )
+            b, h, s, d = out.shape
+            return self.o_proj(out.transpose(0, 2, 1, 3).reshape(b, s, h * d))
         mesh = current_mesh()
         impl, reason = select_attention_impl(
             self.attention_impl,

@@ -50,7 +50,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_llms_example_tpu.ops.attention import NEG_INF
 
 
-def _block_update(carry, q, k, v, bias_blk, q_pos, k_pos, *, scale: float, causal: bool):
+def _block_update(carry, q, k, v, bias_blk, q_pos, k_pos, *, scale: float, causal: bool,
+                  compute_dtype=None):
     """Fold one (q_blk, kv_blk) attention tile into the running softmax state.
 
     ``q_pos``/``k_pos`` are *global* positions of the local rows / the
@@ -59,6 +60,11 @@ def _block_update(carry, q, k, v, bias_blk, q_pos, k_pos, *, scale: float, causa
     dtype (bf16 on TPU) on the MXU, like the flash kernel.
     """
     m, l, acc = carry
+    # q/k/v may ride the ring (and the causal lax.cond) in fp32
+    # (plumb_fp32 below); the matmuls run in the compute dtype so the MXU
+    # path is unchanged
+    cd = compute_dtype or q.dtype
+    q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * scale
     if bias_blk is not None:
@@ -86,6 +92,7 @@ def ring_attention(
     causal: bool = False,
     scale: float | None = None,
     dtype: jnp.dtype | None = None,
+    plumb_fp32: bool = False,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded across ``axis_name``.
 
@@ -97,6 +104,15 @@ def ring_attention(
     masked yields a near-uniform average of V, not zeros — such rows are
     padding queries and the caller must loss-mask them (the train step's
     label mask does).
+
+    ``plumb_fp32``: rotate K/V/bias around the ring in fp32 even when the
+    compute dtype is bf16.  Needed inside PARTIAL-manual regions (the
+    stage×sequence pipeline): the XLA SPMD partitioner miscompiles bf16
+    copy chains there ("Invalid binary instruction opcode copy" — the same
+    bug the pipeline plumbing works around, parallel/pipeline.py), and the
+    transpose of a bf16 ``ppermute`` hits it in the backward pass.  The
+    matmuls still run in the compute dtype (``_block_update`` casts back),
+    so only ring-hop bandwidth is affected.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -108,11 +124,26 @@ def ring_attention(
     m = jnp.full((b, h, q_blk, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((b, h, q_blk, 1), jnp.float32)
     acc = jnp.zeros((b, h, q_blk, d), jnp.float32)
+    # fresh zeros carry no varying-manual-axes provenance; inside a
+    # check_vma region (the stage×sequence pipeline) the running state must
+    # match q's vma or the causal lax.cond's branches disagree on types
+    from distributed_llms_example_tpu.parallel.activation import pvary_to
 
-    update = jax.checkpoint(functools.partial(_block_update, scale=scale, causal=causal))
+    want = tuple(getattr(jax.typeof(q), "vma", frozenset()))
+    m, l, acc = pvary_to((m, l, acc), want)
+
+    compute_dtype = q.dtype
+    update = jax.checkpoint(
+        functools.partial(_block_update, scale=scale, causal=causal, compute_dtype=compute_dtype)
+    )
     # each step sends the held K/V block to the left neighbor; after t steps
     # device i holds the block that started on device (i + t) mod n
     perm = [(i, (i - 1) % n) for i in range(n)]
+    if plumb_fp32 and compute_dtype == jnp.bfloat16:
+        q = q.astype(jnp.float32)
+        k = k.astype(jnp.float32)
+        v = v.astype(jnp.float32)
+        bias = None if bias is None else bias.astype(jnp.float32)
     kv: Any = (k, v, bias)
     for t in range(n):
         # issue next rotation before this tile's compute → XLA overlaps the
@@ -137,7 +168,7 @@ def ring_attention(
     # never drops the diagonal tile) and the running max makes the max
     # element contribute exp(0) = 1, so no division guard is needed
     out = acc / l
-    return out.astype(dtype or q.dtype)
+    return out.astype(dtype or compute_dtype)
 
 
 def ring_attention_sharded(

@@ -52,6 +52,47 @@ def activation_mesh(mesh: Mesh | None):
         _state.mesh = prev
 
 
+def pvary_to(tree, axes):
+    """Mark every array in ``tree`` varying over ``axes`` (a name or tuple
+    of names) for shard_map's vma checking (check_vma=True), skipping axes
+    an array is ALREADY varying over — so values that enter a manual region
+    sharded (hence varying) over some axis can be upcast to the full set
+    without double-marking.  The single home for this logic: the pipeline
+    body and the ring-attention carry init both need it."""
+    if isinstance(axes, str):
+        axes = (axes,)
+
+    def mark(x):
+        have = getattr(jax.typeof(x), "vma", frozenset())
+        missing = tuple(a for a in axes if a not in have)
+        return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+    return jax.tree.map(mark, tree)
+
+
+def current_manual_seq() -> tuple[str, int] | None:
+    """(axis_name, axis_size) when tracing inside a manual region that owns
+    the sequence axis (the stage×sequence pipeline), else None."""
+    return getattr(_state, "manual_seq", None)
+
+
+@contextlib.contextmanager
+def manual_sequence(axis_name: str, axis_size: int):
+    """Declare that the enclosing ``shard_map`` is manual over the sequence
+    axis: activations carry LOCAL sequence shards and collectives over
+    ``axis_name`` are legal.  Attention modules switch to the in-region
+    ring-attention body (``ops.ring_attention.ring_attention``) instead of
+    opening their own ``shard_map`` — nesting manual regions is not
+    supported, which is why the pipeline installs this context rather than
+    relying on the modules' normal global-shape dispatch."""
+    prev = current_manual_seq()
+    _state.manual_seq = (axis_name, axis_size)
+    try:
+        yield
+    finally:
+        _state.manual_seq = prev
+
+
 def constrain(x: jax.Array, spec: P) -> jax.Array:
     """Constrain ``x`` to ``spec`` on the ambient mesh (no-op without one).
 

@@ -34,9 +34,12 @@ standard stage×tensor 7B+ topology) inside the pipeline body, inserting
 the collectives itself.  MoE composes too (stage × expert): sown aux
 losses can't cross the shard_map, so ``with_aux`` layer_fns return the
 load-balance loss as an explicit output the schedule accumulates (bubble
-ticks masked) and psums.  Only ``sequence`` (ring attention is its own
-fully-manual shard_map — nesting manual regions is not supported) remains
-excluded; the adapters validate that.
+ticks masked) and psums.  ``sequence`` composes on the gpipe schedule via
+``seq_axis``: the region goes manual over {stage, sequence} — ONE combined
+manual region instead of (unsupported) nested ones — hidden shards its
+sequence dim, and attention runs the in-region ring body under a
+``manual_sequence`` context (see ``pipeline_apply``); long-context models
+can then ALSO split their layer stack across stages.
 """
 
 from __future__ import annotations
@@ -214,11 +217,13 @@ def dropout(x: jnp.ndarray, key: jnp.ndarray, rate: float) -> jnp.ndarray:
     return jnp.where(mask, x / keep, jnp.zeros_like(x)).astype(x.dtype)
 
 
-def _vary(tree, axis_name: str):
-    """Mark every array stage-varying: the body branches on axis_index, and
-    shard_map's vma checking (check_vma=True) requires the provenance to be
-    explicit rather than inferred."""
-    return jax.tree.map(lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
+def _vary(tree, axes):
+    """Mark every array varying over ``axes``: the body branches on
+    axis_index, and shard_map's vma checking (check_vma=True) requires the
+    provenance to be explicit rather than inferred.  See ``pvary_to``."""
+    from distributed_llms_example_tpu.parallel.activation import pvary_to
+
+    return pvary_to(tree, axes)
 
 
 def _make_run_stage(layer_fn: Callable, checkpoint: bool,
@@ -274,6 +279,8 @@ def pipeline_apply(
     checkpoint: bool = True,
     rng: jnp.ndarray | None = None,
     with_aux: bool = False,
+    seq_axis: str | None = None,
+    extras_seq_dims: Any = None,
 ) -> jnp.ndarray:
     """Run ``hidden`` through the stacked layers as a pipelined schedule.
 
@@ -298,6 +305,18 @@ def pipeline_apply(
     unique per (microbatch, stage, local layer), so every layer of every
     microbatch draws an independent mask while the whole schedule stays a
     deterministic function of ``rng``.
+
+    ``seq_axis``: compose with sequence/context parallelism by making the
+    shard_map manual over {stage, seq_axis} — ONE combined manual region
+    instead of (unsupported) nested ones.  ``hidden`` dim 1 is then sharded
+    over ``seq_axis``; inside the body every activation holds a local
+    sequence shard and ``layer_fn`` is traced under a ``manual_sequence``
+    context, which switches attention modules onto the in-region ring body
+    (ops/ring_attention.py) with collectives over the manual axis.
+    ``extras_seq_dims``: pytree matching ``extras`` giving, per leaf, the
+    dim sharded over ``seq_axis`` (None = replicated along sequence) — e.g.
+    a K-aligned padding bias (B, 1, 1, K) shards dim 3 and then rotates
+    around the ring with K/V.
     """
     S = mesh.shape.get(axis_name, 1)
     L = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -317,12 +336,31 @@ def pipeline_apply(
 
     run_stage = _make_run_stage(layer_fn, checkpoint, with_aux)
 
+    n_seq = mesh.shape.get(seq_axis, 1) if seq_axis else 1
+    if n_seq <= 1:
+        seq_axis = None
+
     if S == 1:
-        # no pipeline: plain scan over the full stack under GSPMD
+        # no pipeline: plain scan over the full stack under GSPMD (a
+        # sequence axis, if any, is handled by the modules' own global-shape
+        # ring dispatch — no manual region to compose with)
         if with_aux:
             y, aux = run_stage(stacked_params, hidden, extras, rng)
             return y, aux / L
         return run_stage(stacked_params, hidden, extras, rng)
+
+    if seq_axis is not None and with_aux:
+        raise ValueError(
+            "pipeline with_aux (MoE load-balance loss) does not compose with "
+            "sequence parallelism: per-shard router statistics would need "
+            "their own cross-sequence reduction"
+        )
+    if seq_axis is not None and hidden.ndim >= 2 and hidden.shape[1] % n_seq:
+        raise ValueError(
+            f"sequence length {hidden.shape[1]} not divisible by "
+            f"{seq_axis}={n_seq}"
+        )
+    axes_all = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
 
     # which extras are per-example (to be microbatched) vs per-call
     # constants (replicated): decided from GLOBAL shapes, outside the body
@@ -339,6 +377,16 @@ def pipeline_apply(
     # matmuls anyway.  Layer compute still happens in the caller's dtype.
     compute_dtype = hidden.dtype
     plumb_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
+    if seq_axis is not None:
+        # sequence-sharded region boundary: the hidden/extras in- and
+        # out-specs are SHARDED here (not replicated as on the stage-only
+        # path), and a bf16 array crossing a sharded partial-manual
+        # boundary feeds the same partitioner copy-chain bug — convert
+        # OUTSIDE the shard_map so the boundary only ever carries fp32
+        hidden = hidden.astype(plumb_dtype)
+        extras = jax.tree.map(
+            lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, extras
+        )
 
     def body(local_params: Any, h: jnp.ndarray, ex: Any, key: Any) -> jnp.ndarray:
         # Manual over ``stage`` only: shapes here are GLOBAL in every other
@@ -346,13 +394,30 @@ def pipeline_apply(
         # branches on s_idx), hence the pcasts.  GSPMD still auto-shards
         # the per-stage compute over data/fsdp/tensor.
         s_idx = jax.lax.axis_index(axis_name)
+        if seq_axis is not None:
+            # Params enter stage-varying but sequence-UNvarying; the first
+            # op mixing them with sequence-varying activations would insert
+            # an implicit pvary whose TRANSPOSE is a psum of the (bf16)
+            # parameter cotangent over the sequence axis — and a bf16 psum
+            # over a manual axis is exactly the partitioner copy-chain
+            # crash.  Pre-vary every bf16 param through an fp32 bridge so
+            # the transpose psum runs in fp32 (the converts fuse).
+            def seq_vary_param(p):
+                if p.dtype == jnp.bfloat16:
+                    return _vary(p.astype(jnp.float32), axes_all).astype(p.dtype)
+                return _vary(p, axes_all)
+
+            local_params = jax.tree.map(seq_vary_param, local_params)
         ex = jax.tree.map(
             lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, ex
         )
-        h, ex = _vary(h.astype(plumb_dtype), axis_name), _vary(ex, axis_name)
+        h, ex = _vary(h.astype(plumb_dtype), axes_all), _vary(ex, axes_all)
         if key is not None:
-            # unique stream per stage; tick folds in the microbatch index
-            key = jax.random.fold_in(_vary(key, axis_name), s_idx)
+            # unique stream per stage (and per sequence shard, so local
+            # dropout masks are independent); tick folds in the microbatch
+            key = jax.random.fold_in(_vary(key, axes_all), s_idx)
+            if seq_axis is not None:
+                key = jax.random.fold_in(key, jax.lax.axis_index(seq_axis))
         mb = h.shape[0] // M
         micro = h.reshape(M, mb, *h.shape[1:])
         micro_ex = jax.tree.map(
@@ -360,9 +425,9 @@ def pipeline_apply(
             ex,
             is_batched,
         )
-        buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axis_name)
-        outputs = _vary(jnp.zeros((M, mb, *h.shape[1:]), h.dtype), axis_name)
-        aux_acc = _vary(jnp.zeros((), jnp.float32), axis_name)
+        buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axes_all)
+        outputs = _vary(jnp.zeros((M, mb, *h.shape[1:]), h.dtype), axes_all)
+        aux_acc = _vary(jnp.zeros((), jnp.float32), axes_all)
         perm = [(i, i + 1) for i in range(S - 1)]
 
         def tick(carry, t):
@@ -403,34 +468,63 @@ def pipeline_apply(
         outputs = jax.lax.psum(
             jnp.where(s_idx == S - 1, outputs, jnp.zeros_like(outputs)), axis_name
         )
-        out = outputs.reshape(h.shape).astype(compute_dtype)
+        # on the sequence-sharded path the output boundary stays fp32 too
+        # (cast back outside the region, same bug as the input boundary)
+        out = outputs.reshape(h.shape)
+        if seq_axis is None:
+            out = out.astype(compute_dtype)
         if with_aux:
             # every (layer, microbatch) contributed once across all stages
             return out, jax.lax.psum(aux_acc, axis_name) / (L * M)
         return out
 
-    # in/out specs name ONLY the manual axis; shardings over the automatic
+    # in/out specs name ONLY the manual axes; shardings over the automatic
     # axes (fsdp/tensor splits on the stacked kernels, data/fsdp on the
     # batch) ride through untouched
     param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
-    extras_specs = jax.tree.map(lambda m: P(), extras)
+    if seq_axis is None:
+        hidden_spec = P()
+        extras_specs = jax.tree.map(lambda m: P(), extras)
+    else:
+        hidden_spec = P(None, seq_axis, *([None] * (hidden.ndim - 2)))
+        # extras_seq_dims: matching pytree of ints; dim < 0 = replicated
+        seq_dims = (
+            jax.tree.map(lambda _: -1, extras)
+            if extras_seq_dims is None
+            else extras_seq_dims
+        )
+        extras_specs = jax.tree.map(
+            lambda m, d: P() if d is None or d < 0 else P(*([None] * d), seq_axis),
+            extras,
+            seq_dims,
+        )
     # rng enters as a pytree ({} when absent) so in_specs structure-matches
     rng_tree = {} if rng is None else {"key": rng}
     rng_specs = jax.tree.map(lambda _: P(), rng_tree)
 
     def outer(sp, h, ex, rt):
-        return body(sp, h, ex, rt.get("key"))
+        if seq_axis is None:
+            return body(sp, h, ex, rt.get("key"))
+        from distributed_llms_example_tpu.parallel.activation import manual_sequence
 
-    out_specs = (P(), P()) if with_aux else P()
+        with manual_sequence(seq_axis, n_seq):
+            return body(sp, h, ex, rt.get("key"))
 
-    return jax.shard_map(
+    out_specs = (hidden_spec, P()) if with_aux else hidden_spec
+
+    result = jax.shard_map(
         outer,
         mesh=mesh,
-        axis_names={axis_name},
-        in_specs=(param_specs, P(), extras_specs, rng_specs),
+        axis_names=set(axes_all),
+        in_specs=(param_specs, hidden_spec, extras_specs, rng_specs),
         out_specs=out_specs,
         check_vma=True,
     )(stacked_params, hidden, extras, rng_tree)
+    if seq_axis is None:
+        return result
+    if with_aux:
+        return result[0].astype(compute_dtype), result[1]
+    return result.astype(compute_dtype)
 
 
 def pipeline_value_and_grad(

@@ -199,6 +199,17 @@ class Trainer:
             for dim in (cfg.pad_to_multiple, cfg.max_source_length, tgt_cap)
         )
         if seq_axis > 1 and not self.sequence_sharded:
+            if self.pipelined:
+                # the stage×sequence pipeline hard-shards hidden over the
+                # sequence axis (shard_map in_specs) — there is no graceful
+                # unsharded fallback, so a non-divisible setup must fail at
+                # startup, not at first dispatch
+                raise ValueError(
+                    f"pipeline stage×sequence needs pad_to_multiple="
+                    f"{cfg.pad_to_multiple}, max_source_length="
+                    f"{cfg.max_source_length} and target cap {tgt_cap} all "
+                    f"divisible by the sequence axis ({seq_axis})"
+                )
             log_json({
                 "event": "sequence_sharding_disabled",
                 "reason": f"pad_to_multiple={cfg.pad_to_multiple}/"
@@ -216,11 +227,30 @@ class Trainer:
                     "--attention-impl ring requires a mesh with a sequence axis > 1 "
                     f"(got {dict(self.mesh.shape)})"
                 )
-            if self.pipelined:
+            if self.pipelined and not (
+                self.loaded.family == "llama"
+                and getattr(self.model, "pipeline_schedule", "gpipe") == "gpipe"
+            ):
                 raise ValueError(
-                    "--attention-impl ring does not compose with stage>1: ring is "
-                    "its own fully-manual shard_map and manual regions don't nest"
+                    "--attention-impl ring composes with stage>1 only for the "
+                    "llama family on the gpipe schedule (ONE manual region over "
+                    "{stage, sequence}); other families/schedules run ring as "
+                    "its own fully-manual shard_map, which does not nest"
                 )
+        elif (
+            cfg.attention_impl in ("xla", "flash")
+            and self.pipelined
+            and self.mesh.shape.get("sequence", 1) > 1
+            and self.loaded.family == "llama"
+        ):
+            # stage×sequence executes ring attention inside the manual
+            # region — a forced non-ring impl would only fail at first
+            # trace; fail here at startup with the config named
+            raise ValueError(
+                f"--attention-impl {cfg.attention_impl} cannot run on a "
+                "stage×sequence mesh (the pipeline's manual region executes "
+                "ring attention only); use auto or ring"
+            )
 
         self.use_dropout = self.config.dropout_rate > 0.0
         build = make_train_step(

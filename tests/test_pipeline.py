@@ -946,3 +946,119 @@ def test_pipelined_moe_aux_actually_contributes():
     assert float(with_aux[0]) != pytest.approx(float(without[0]), rel=1e-9)
     # aux is positive (load-balance penalty) so the objective only grows
     assert float(with_aux[0]) > float(without[0])
+
+
+def test_pipelined_stage_x_sequence_logits_parity(tiny_llama4):
+    """stage=2 × sequence=2 × data=2: ONE manual region over both axes,
+    ring attention inside the pipeline body (RoPE offset to global
+    positions, padding bias riding the ring with K/V) — logits must match
+    the standard sequential module."""
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.parallel.pipeline import stack_blocks
+
+    cfg, module, params = tiny_llama4
+    rng = np.random.RandomState(23)
+    ids = rng.randint(2, cfg.vocab_size, (8, 16)).astype(np.int32)
+    mask = np.ones((8, 16), np.int32)
+    mask[:4, -5:] = 0  # padding spanning the second sequence shard
+    ref = module.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask))
+
+    mesh_sp = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=2, tensor=1))
+    piped = PipelinedLlama(cfg, mesh_sp, num_microbatches=2)
+    out = piped.apply({"params": stack_blocks(params)}, jnp.asarray(ids), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_pipelined_stage_x_sequence_train_step(tiny_llama4):
+    """Full train step on stage=2 × sequence=2 × data=2 == single device:
+    autodiff through the combined manual region (pipeline transpose AND the
+    ring's rotated-K/V transpose in one backward) is exact."""
+    import optax
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    cfg, module, params0 = tiny_llama4
+    rng = np.random.RandomState(29)
+    b, src = 8, 16
+    ids = rng.randint(2, cfg.vocab_size, (b, src)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, :4] = LABEL_PAD
+    mask = np.ones((b, src), np.int32)
+    mask[:3, -6:] = 0
+    batch = {"input_ids": ids, "attention_mask": mask, "labels": labels}
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    mesh1 = build_mesh(MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1])
+    build = make_train_step(module, cfg, tx, schedule, mesh1, donate=False, is_seq2seq=False)
+    state = create_train_state(shard_params(params0, mesh1), tx)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh1))
+    step, _ = build(state)
+    ref_state, ref = step(state, put_batch(batch, mesh1))
+
+    mesh_sp = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=2, tensor=1))
+    piped = PipelinedLlama(cfg, mesh_sp, num_microbatches=2)
+    rules = pipeline_rules()
+    state_p = create_train_state(shard_params(stack_blocks(params0), mesh_sp, rules), tx)
+    state_p = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, mesh_sp, rules)
+    )
+    build_p = make_train_step(
+        piped, cfg, tx, schedule, mesh_sp, rules=rules, donate=False, is_seq2seq=False
+    )
+    step_p, _ = build_p(state_p)
+    new_state_p, got = step_p(state_p, put_batch(batch, mesh_sp, sequence_sharded=True))
+
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+    assert float(got["target_tokens"]) == float(ref["target_tokens"])
+    upd = unstack_blocks(jax.device_get(new_state_p.params))
+    ref_upd = jax.device_get(ref_state.params)
+    for lyr in ("block_0", f"block_{cfg.num_hidden_layers - 1}"):
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(upd[lyr])[0]),
+            np.asarray(jax.tree.leaves(ref_upd[lyr])[0]),
+            atol=1e-5, rtol=1e-4,
+        )
+
+
+def test_stage_x_sequence_validation():
+    """1F1B and MoE do not compose with the sequence axis — loud errors,
+    not silent wrong numbers."""
+    from distributed_llms_example_tpu.models.llama import LlamaConfig, PipelinedLlama
+
+    mesh_sp = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=2, tensor=1))
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=4, num_attention_heads=2,
+    )
+    with pytest.raises(ValueError, match="gpipe"):
+        PipelinedLlama(cfg, mesh_sp, num_microbatches=2, schedule="1f1b")
+    moe_cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=4, num_attention_heads=2,
+        num_experts=2, moe_aux_weight=0.01,
+    )
+    with pytest.raises(ValueError, match="MoE"):
+        PipelinedLlama(moe_cfg, mesh_sp, num_microbatches=2)
+    # a forced non-ring impl inside the manual region must raise, not be
+    # silently overridden to ring
+    from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
+    from distributed_llms_example_tpu.parallel.activation import manual_sequence
+
+    mha = MultiHeadAttention(
+        num_heads=2, head_dim=8, model_dim=16, causal=True, attention_impl="xla"
+    )
+    x = jnp.zeros((2, 8, 16), jnp.float32)
+    variables = mha.init(jax.random.PRNGKey(0), x)
+    with manual_sequence("sequence", 2):
+        with pytest.raises(ValueError, match="manual sequence region"):
+            mha.apply(variables, x)

@@ -114,6 +114,11 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"
     # "" = model default; else "auto" | "flash" | "ring" | "xla" (ops/mha.py)
     attention_impl: str = ""
+    # PRNG implementation for the in-step dropout stream: "threefry"
+    # (default — counter-based, bit-reproducible across backends) or "rbg"
+    # (TPU hardware RNG; much cheaper mask generation when dropout sits on
+    # the critical path, different — still deterministic — bit stream)
+    prng_impl: str = "threefry"
     remat: bool = False  # jax.checkpoint the transformer blocks
     remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
     # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
@@ -202,6 +207,11 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
         "--attention-impl", type=str, default=_D.attention_impl,
         choices=("", "auto", "flash", "ring", "xla"),
         help="attention path override; empty = model default (auto)",
+    )
+    p.add_argument(
+        "--prng-impl", type=str, default=_D.prng_impl,
+        choices=("threefry", "rbg"),
+        help="dropout PRNG: threefry (bit-reproducible) or rbg (TPU hardware RNG, faster)",
     )
     p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_llms_example_tpu.ops.attention import NEG_INF
+from distributed_llms_example_tpu.parallel.activation import pvary_to
 
 
 def _block_update(carry, q, k, v, bias_blk, q_pos, k_pos, *, scale: float, causal: bool,
@@ -127,8 +128,6 @@ def ring_attention(
     # fresh zeros carry no varying-manual-axes provenance; inside a
     # check_vma region (the stage×sequence pipeline) the running state must
     # match q's vma or the causal lax.cond's branches disagree on types
-    from distributed_llms_example_tpu.parallel.activation import pvary_to
-
     want = tuple(getattr(jax.typeof(q), "vma", frozenset()))
     m, l, acc = pvary_to((m, l, acc), want)
 

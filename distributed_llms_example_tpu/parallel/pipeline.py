@@ -50,6 +50,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributed_llms_example_tpu.parallel.activation import manual_sequence, pvary_to
+
 
 def stack_blocks(params: dict, prefix: str = "block_", out_key: str = "stacked_blocks") -> dict:
     """Standard per-layer tree ({block_0: t, block_1: t, ...}) → pipelined
@@ -221,8 +223,6 @@ def _vary(tree, axes):
     """Mark every array varying over ``axes``: the body branches on
     axis_index, and shard_map's vma checking (check_vma=True) requires the
     provenance to be explicit rather than inferred.  See ``pvary_to``."""
-    from distributed_llms_example_tpu.parallel.activation import pvary_to
-
     return pvary_to(tree, axes)
 
 
@@ -505,8 +505,6 @@ def pipeline_apply(
     def outer(sp, h, ex, rt):
         if seq_axis is None:
             return body(sp, h, ex, rt.get("key"))
-        from distributed_llms_example_tpu.parallel.activation import manual_sequence
-
         with manual_sequence(seq_axis, n_seq):
             return body(sp, h, ex, rt.get("key"))
 
@@ -522,8 +520,7 @@ def pipeline_apply(
     )(stacked_params, hidden, extras, rng_tree)
     if seq_axis is None:
         return result
-    if with_aux:
-        return result[0].astype(compute_dtype), result[1]
+    # with_aux cannot reach here (seq_axis + with_aux raises above)
     return result.astype(compute_dtype)
 
 

@@ -315,7 +315,15 @@ class Trainer:
             if self.val_ds
             else None
         )
-        self._rng = jax.random.PRNGKey(cfg.shuffle_seed)
+        # dropout stream: legacy uint32 threefry keys by default (bit-
+        # reproducible across backends); --prng-impl rbg swaps in the TPU
+        # hardware RNG — mask generation is then nearly free, where
+        # threefry's counter math can cost ~20% of a dropout-on step
+        self._rng = (
+            jax.random.PRNGKey(cfg.shuffle_seed)
+            if cfg.prng_impl == "threefry"
+            else jax.random.key(cfg.shuffle_seed, impl=cfg.prng_impl)
+        )
 
     # ------------------------------------------------------------------
 

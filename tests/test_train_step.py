@@ -174,6 +174,24 @@ def test_dropout_step_runs(mesh8, setup):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_dropout_step_accepts_rbg_key(mesh8, setup):
+    """--prng-impl rbg hands the step a TYPED key array (TPU hardware RNG
+    stream); the jitted step's replicated rng sharding must accept it and
+    grad accumulation's fold_in must work on it."""
+    lm, params = setup
+    tx, schedule = make_optimizer(learning_rate=1e-3, warmup_steps=0, total_steps=100)
+    build = make_train_step(
+        lm.module, lm.config, tx, schedule, mesh8, with_dropout=True, grad_accum_steps=2
+    )
+    state = create_train_state(shard_params(params, mesh8), tx)
+    sh = state_shardings(state, mesh8)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+    step, _ = build(state)
+    key = jax.random.key(3, impl="rbg")
+    state, metrics = step(state, put_batch(_toy_batch(), mesh8), key)
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_remat_policies_match_no_remat(mesh8):
     """Remat never changes math — 'full' and 'dots' policies must produce
     the identical loss as no remat at all."""

@@ -369,6 +369,16 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
             trainer.train_ds._cache = [None] * len(trainer.train_ds)
             dt = timed_pass()
             out[f"tokens_per_sec_chip_prefetch{prefetch}"] = round(tokens / dt / n_chips, 1)
+        if trainer.use_dropout and os.environ.get("BENCH_TRAINER_RBG", "1") != "0":
+            # the --prng-impl rbg trainer path: hardware-RNG dropout masks.
+            # Swap the key impl and warm once (the step retraces for the
+            # typed-key argument) before timing.
+            trainer.cfg = cfg.replace(prefetch_batches=2)
+            trainer._rng = jax.random.key(7, impl="rbg")
+            timed_pass()
+            trainer.train_ds._cache = [None] * len(trainer.train_ds)
+            dt = timed_pass()
+            out["tokens_per_sec_chip_rbg"] = round(tokens / dt / n_chips, 1)
         out["steps"] = steps
         return out
 

@@ -120,6 +120,27 @@ class LlamaBlock(nn.Module):
         return hidden + self.mlp(self.mlp_norm(hidden))
 
 
+def _seq_shift_labels(labels: jnp.ndarray, axis_name: str, n: int) -> jnp.ndarray:
+    """Next-token targets for a LOCAL sequence shard inside a manual region.
+
+    Global convention: position t's logits predict ``labels[t+1]``.  Shard
+    i holds positions [i·T, (i+1)·T); the target of its LAST position is
+    the FIRST label of shard i+1 — fetched with a one-column ``ppermute``.
+    The global final position has no target: the last shard's final column
+    is set to LABEL_PAD (exactly the position ``logits[:, :-1]`` drops in
+    the unsharded objective)."""
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+
+    idx = jax.lax.axis_index(axis_name)
+    nxt = jax.lax.ppermute(
+        labels[:, :1], axis_name, [(i + 1, i) for i in range(n - 1)]
+    )
+    shifted = jnp.concatenate([labels[:, 1:], nxt], axis=1)
+    t_loc = labels.shape[1]
+    drop = (idx == n - 1) & (jnp.arange(t_loc)[None, :] == t_loc - 1)
+    return jnp.where(drop, LABEL_PAD, shifted)
+
+
 class PipelinedLlama:
     """Train-time ``apply()`` adapter running the LLaMA block stack as a
     GPipe pipeline over the ``stage`` mesh axis (parallel/pipeline.py).
@@ -136,13 +157,15 @@ class PipelinedLlama:
     inside each stage — the stage×tensor topology 7B+ models use) AND
     ``expert`` (MoE configs on the gpipe schedule: the load-balance loss
     rides out of the pipeline as an explicit output, see ``_layer_fn``).
-    ``sequence`` composes on the gpipe schedule via ONE combined manual
-    region over {stage, sequence}: the pipeline installs a
-    ``manual_sequence`` context and the blocks' attention switches to the
-    in-region ring body with RoPE offset to global positions — long-context
-    LLaMA training with the layer stack ALSO split across stages.
-    Training + teacher-forced scoring only: no KV-cache generation path
-    (unstack for decoding).
+    ``sequence`` composes on BOTH schedules via ONE combined manual region
+    over {stage, sequence}: the pipeline installs a ``manual_sequence``
+    context and the blocks' attention switches to the in-region ring body
+    with RoPE offset to global positions — long-context LLaMA training
+    with the layer stack ALSO split across stages.  On 1f1b the per-chunk
+    vjps differentiate the ring in place and the next-token loss handles
+    the cross-shard target shift (``_seq_shift_labels``).  Training +
+    teacher-forced scoring only: no KV-cache generation path (unstack for
+    decoding).
     """
 
     def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32,
@@ -155,12 +178,6 @@ class PipelinedLlama:
             raise ValueError(f"pipeline schedule {schedule!r}: must be gpipe or 1f1b")
 
         if mesh.shape.get("sequence", 1) > 1 and mesh.shape.get("stage", 1) > 1:
-            if schedule != "gpipe":
-                raise ValueError(
-                    "pipeline stage×sequence composition runs on the gpipe "
-                    "schedule only (1f1b owns its backward pass; the ring "
-                    "attention inside it is not yet wired through its vjp)"
-                )
             if getattr(config, "num_experts", 0) > 0:
                 raise ValueError(
                     "pipeline stage×sequence does not compose with MoE "
@@ -221,17 +238,28 @@ class PipelinedLlama:
         pipeline under GSPMD with its own ``jax.vjp``; final norm + LM head
         + next-token CE run per-microbatch on the last stage so each
         microbatch's activation-gradient enters the backward ring on the
-        tick its forward finishes."""
+        tick its forward finishes.
+
+        Under stage×sequence the loss runs on LOCAL sequence shards: the
+        next-token target of a shard's last position lives in the NEXT
+        shard, so the labels are pre-shifted with a one-column ``ppermute``
+        (``_seq_shift_labels``) and the CE covers every local position —
+        summing to exactly the global ``logits[:, :-1]`` vs
+        ``labels[:, 1:]`` objective."""
         from distributed_llms_example_tpu.parallel.activation import activation_mesh
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_value_and_grad
         from distributed_llms_example_tpu.train.step import cross_entropy_sums
 
         assert not is_seq2seq
+        n_seq = self.mesh.shape.get("sequence", 1)
 
         def post_loss(pp, h, mb):
             with activation_mesh(None):
                 h = self._norm.apply({"params": pp["final_norm"]}, h)
                 logits = self._head.apply({"params": pp["lm_head"]}, h)
+            if n_seq > 1:
+                labels = _seq_shift_labels(mb["labels"], "sequence", n_seq)
+                return cross_entropy_sums(logits, labels, label_smoothing)
             return cross_entropy_sums(logits[:, :-1], mb["labels"][:, 1:], label_smoothing)
 
         layer_fn = self._layer_fn()
@@ -257,6 +285,9 @@ class PipelinedLlama:
                 num_microbatches=self.num_microbatches,
                 checkpoint=self.remat,
                 rng=rng,
+                seq_axis="sequence",
+                extras_seq_dims={"bias": 3},
+                loss_seq_dims={"labels": 1},
             )
             (d_embed,) = embed_vjp(d_hidden.astype(hidden.dtype))
             grads = {

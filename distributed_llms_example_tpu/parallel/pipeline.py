@@ -34,7 +34,7 @@ standard stage×tensor 7B+ topology) inside the pipeline body, inserting
 the collectives itself.  MoE composes too (stage × expert): sown aux
 losses can't cross the shard_map, so ``with_aux`` layer_fns return the
 load-balance loss as an explicit output the schedule accumulates (bubble
-ticks masked) and psums.  ``sequence`` composes on the gpipe schedule via
+ticks masked) and psums.  ``sequence`` composes on both schedules via
 ``seq_axis``: the region goes manual over {stage, sequence} — ONE combined
 manual region instead of (unsupported) nested ones — hidden shards its
 sequence dim, and attention runs the in-region ring body under a
@@ -208,6 +208,26 @@ def unstack_for_family_to_host(family: str, params: dict, *, writer_only: bool =
 
 def _full_spec(leading, ndim: int) -> P:
     return P(leading, *([None] * (ndim - 1)))
+
+
+def _seq_specs(seq_axis: str, hidden_ndim: int, *dim_trees) -> tuple:
+    """Shard_map specs for the sequence-parallel boundary, shared by the
+    gpipe and 1f1b paths so the convention cannot drift: hidden shards dim
+    1 over ``seq_axis``; each ``(tree, dims)`` pair in ``dim_trees`` maps
+    per-leaf dims (int, <0 or None = replicated) to PartitionSpecs,
+    defaulting every leaf to replicated when ``dims`` is None."""
+    hidden_spec = P(None, seq_axis, *([None] * (hidden_ndim - 2)))
+
+    def dim_spec(m, d):
+        return P() if d is None or d < 0 else P(*([None] * d), seq_axis)
+
+    out = [hidden_spec]
+    for tree, dims in dim_trees:
+        out.append(jax.tree.map(
+            dim_spec, tree,
+            jax.tree.map(lambda _: -1, tree) if dims is None else dims,
+        ))
+    return tuple(out)
 
 
 def dropout(x: jnp.ndarray, key: jnp.ndarray, rate: float) -> jnp.ndarray:
@@ -486,17 +506,8 @@ def pipeline_apply(
         hidden_spec = P()
         extras_specs = jax.tree.map(lambda m: P(), extras)
     else:
-        hidden_spec = P(None, seq_axis, *([None] * (hidden.ndim - 2)))
-        # extras_seq_dims: matching pytree of ints; dim < 0 = replicated
-        seq_dims = (
-            jax.tree.map(lambda _: -1, extras)
-            if extras_seq_dims is None
-            else extras_seq_dims
-        )
-        extras_specs = jax.tree.map(
-            lambda m, d: P() if d is None or d < 0 else P(*([None] * d), seq_axis),
-            extras,
-            seq_dims,
+        hidden_spec, extras_specs = _seq_specs(
+            seq_axis, hidden.ndim, (extras, extras_seq_dims)
         )
     # rng enters as a pytree ({} when absent) so in_specs structure-matches
     rng_tree = {} if rng is None else {"key": rng}
@@ -539,6 +550,9 @@ def pipeline_value_and_grad(
     batch_axes: tuple[str, ...] = ("data", "fsdp", "expert"),
     checkpoint: bool = True,
     rng: jnp.ndarray | None = None,
+    seq_axis: str | None = None,
+    extras_seq_dims: Any = None,
+    loss_seq_dims: Any = None,
 ):
     """1F1B pipeline schedule: loss AND parameter gradients in ONE fused
     scan, backward microbatches interleaved with forward.
@@ -581,6 +595,16 @@ def pipeline_value_and_grad(
     Schedule-only reordering: the math per microbatch is identical to the
     sequential computation, so results match GPipe and the single-device
     step exactly (tests/test_pipeline.py::test_1f1b_*).
+
+    ``seq_axis``/``extras_seq_dims``: sequence-parallel composition, same
+    contract as ``pipeline_apply`` — ONE manual region over {stage,
+    seq_axis}, ``layer_fn``/``post_loss_fn`` traced under a
+    ``manual_sequence`` context with LOCAL sequence shards.
+    ``loss_seq_dims``: like ``extras_seq_dims`` but for ``loss_batch``
+    (e.g. next-token labels shard dim 1; the loss fn must handle the
+    cross-shard target shift itself — see models/llama.py).  All manual-
+    axis gradient reductions run in fp32 (bf16 psums over manual axes
+    crash the partitioner, see ``pipeline_apply``).
     """
     S = mesh.shape.get(axis_name, 1)
     M = num_microbatches
@@ -608,11 +632,28 @@ def pipeline_value_and_grad(
         d_sp, d_pp, d_h = vjp((jnp.ones((), lsum.dtype), jnp.zeros((), tokens.dtype)))
         return lsum, tokens, d_sp, d_pp, d_h
 
+    n_seq = mesh.shape.get(seq_axis, 1) if seq_axis else 1
+    if n_seq <= 1:
+        seq_axis = None
+    if seq_axis is not None and hidden.ndim >= 2 and hidden.shape[1] % n_seq:
+        raise ValueError(
+            f"sequence length {hidden.shape[1]} not divisible by "
+            f"{seq_axis}={n_seq}"
+        )
+    axes_all = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
+
     is_batched = jax.tree.map(lambda m: m.ndim > 0 and m.shape[0] == B, extras)
     ex_dtypes = jax.tree.map(lambda m: m.dtype, extras)
     compute_dtype = hidden.dtype
     # same partitioner workaround as pipeline_apply: plumbing in fp32
     plumb_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
+    if seq_axis is not None:
+        # sharded-boundary bf16 crossings feed the partitioner copy-chain
+        # bug — convert outside the region (see pipeline_apply)
+        hidden = hidden.astype(plumb_dtype)
+        extras = jax.tree.map(
+            lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, extras
+        )
     K = 2 * S - 1  # ring depth ≥ max activation lifetime in ticks (stage 0)
     T = M + 2 * (S - 1)
 
@@ -622,16 +663,22 @@ def pipeline_value_and_grad(
         ex = jax.tree.map(
             lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, ex
         )
-        h, ex, lb = _vary(h.astype(plumb_dtype), axis_name), _vary(ex, axis_name), _vary(lb, axis_name)
+        h, ex, lb = _vary(h.astype(plumb_dtype), axes_all), _vary(ex, axes_all), _vary(lb, axes_all)
         # pp must be stage-VARYING before entering jax.vjp: differentiating
         # w.r.t. an unvarying input under a varying cotangent transposes
         # the implicit broadcast into a hidden psum over stage — the
         # per-stage d_pp would then already contain every OTHER stage's
-        # (garbage) contribution, leaking through the take_loss mask
-        pp = _vary(pp, axis_name)
+        # (garbage) contribution, leaking through the take_loss mask.
+        # Same over seq: pre-varying keeps the per-shard cotangents local
+        # (and the implicit-psum it avoids would be bf16 — the crash); the
+        # explicit fp32 psums at the end do the cross-shard reduction.
+        pp = _vary(pp, axes_all)
+        sp_local = _vary(sp_local, axes_all)
         key = rt.get("key")
         if key is not None:
-            key = jax.random.fold_in(_vary(key, axis_name), s_idx)
+            key = jax.random.fold_in(_vary(key, axes_all), s_idx)
+            if seq_axis is not None:
+                key = jax.random.fold_in(key, jax.lax.axis_index(seq_axis))
         mb = h.shape[0] // M
         micro = h.reshape(M, mb, *h.shape[1:])
         micro_ex = jax.tree.map(
@@ -650,15 +697,15 @@ def pipeline_value_and_grad(
             )
 
         zeros_like_f32 = lambda t: jax.tree.map(  # noqa: E731
-            lambda x: _vary(jnp.zeros(x.shape, jnp.float32), axis_name), t
+            lambda x: _vary(jnp.zeros(x.shape, jnp.float32), axes_all), t
         )
-        fwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axis_name)
-        bwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axis_name)
-        act = _vary(jnp.zeros((K, mb, *h.shape[1:]), h.dtype), axis_name)
+        fwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axes_all)
+        bwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axes_all)
+        act = _vary(jnp.zeros((K, mb, *h.shape[1:]), h.dtype), axes_all)
         d_sp = zeros_like_f32(sp_local)
         d_pp = zeros_like_f32(pp)
-        d_h = _vary(jnp.zeros((M, mb, *h.shape[1:]), jnp.float32), axis_name)
-        scal0 = _vary(jnp.zeros((), jnp.float32), axis_name)
+        d_h = _vary(jnp.zeros((M, mb, *h.shape[1:]), jnp.float32), axes_all)
+        scal0 = _vary(jnp.zeros((), jnp.float32), axes_all)
         perm_fwd = [(i, i + 1) for i in range(S - 1)]
         perm_bwd = [(i + 1, i) for i in range(S - 1)]
 
@@ -741,27 +788,52 @@ def pipeline_value_and_grad(
             tick, carry, jnp.arange(T)
         )
         # loss/tail grads live on the last stage, d_hidden on stage 0 (its
-        # updates are already masked to those stages); psum replicates
-        lsum = jax.lax.psum(lsum, axis_name)
-        toks = jax.lax.psum(toks, axis_name)
-        d_pp = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), d_pp)
+        # updates are already masked to those stages); psum replicates.
+        # Under sequence parallelism the scalar sums and the param/tail
+        # grads additionally reduce over the seq shards (all in fp32 —
+        # bf16 psums over manual axes crash the partitioner); d_h stays
+        # seq-sharded (it IS the local positions' gradient).
+        lsum = jax.lax.psum(lsum, axes_all)
+        toks = jax.lax.psum(toks, axes_all)
+        d_pp = jax.tree.map(lambda g: jax.lax.psum(g, axes_all), d_pp)
         d_h = jax.lax.psum(d_h, axis_name)
+        if seq_axis is not None:
+            d_sp = jax.tree.map(lambda g: jax.lax.psum(g, seq_axis), d_sp)
         return lsum, toks, d_sp, d_pp, d_h.reshape(h.shape)
 
     param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
     rng_tree = {} if rng is None else {"key": rng}
+    if seq_axis is None:
+        hidden_spec = P()
+        extras_specs = jax.tree.map(lambda m: P(), extras)
+        loss_specs = jax.tree.map(lambda m: P(), loss_batch)
+    else:
+        hidden_spec, extras_specs, loss_specs = _seq_specs(
+            seq_axis, hidden.ndim, (extras, extras_seq_dims), (loss_batch, loss_seq_dims)
+        )
+
+    def outer(sp, pp, h, ex, lb, rt):
+        if seq_axis is None:
+            return body(sp, pp, h, ex, lb, rt)
+        with manual_sequence(seq_axis, n_seq):
+            return body(sp, pp, h, ex, lb, rt)
+
     return jax.shard_map(
-        body,
+        outer,
         mesh=mesh,
-        axis_names={axis_name},
+        axis_names=set(axes_all),
         in_specs=(
             param_specs,
             jax.tree.map(lambda _: P(), post_params),
-            P(),
-            jax.tree.map(lambda _: P(), extras),
-            jax.tree.map(lambda _: P(), loss_batch),
+            hidden_spec,
+            extras_specs,
+            loss_specs,
             jax.tree.map(lambda _: P(), rng_tree),
         ),
-        out_specs=(P(), P(), param_specs, jax.tree.map(lambda _: P(), post_params), P()),
+        out_specs=(
+            P(), P(), param_specs,
+            jax.tree.map(lambda _: P(), post_params),
+            hidden_spec,
+        ),
         check_vma=True,
     )(stacked_params, post_params, hidden, extras, loss_batch, rng_tree)

@@ -227,15 +227,12 @@ class Trainer:
                     "--attention-impl ring requires a mesh with a sequence axis > 1 "
                     f"(got {dict(self.mesh.shape)})"
                 )
-            if self.pipelined and not (
-                self.loaded.family == "llama"
-                and getattr(self.model, "pipeline_schedule", "gpipe") == "gpipe"
-            ):
+            if self.pipelined and self.loaded.family != "llama":
                 raise ValueError(
                     "--attention-impl ring composes with stage>1 only for the "
-                    "llama family on the gpipe schedule (ONE manual region over "
-                    "{stage, sequence}); other families/schedules run ring as "
-                    "its own fully-manual shard_map, which does not nest"
+                    "llama family (ONE manual region over {stage, sequence}, "
+                    "gpipe or 1f1b); the seq2seq families run ring as its own "
+                    "fully-manual shard_map, which does not nest"
                 )
         elif (
             cfg.attention_impl in ("xla", "flash")

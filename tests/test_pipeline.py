@@ -969,10 +969,13 @@ def test_pipelined_stage_x_sequence_logits_parity(tiny_llama4):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_pipelined_stage_x_sequence_train_step(tiny_llama4):
+@pytest.mark.parametrize("pp_schedule", ["gpipe", "1f1b"])
+def test_pipelined_stage_x_sequence_train_step(tiny_llama4, pp_schedule):
     """Full train step on stage=2 × sequence=2 × data=2 == single device:
     autodiff through the combined manual region (pipeline transpose AND the
-    ring's rotated-K/V transpose in one backward) is exact."""
+    ring's rotated-K/V transpose in one backward) is exact.  On 1f1b the
+    schedule owns the backward — per-chunk vjps with the ring inside, and
+    the cross-shard next-token label shift (``_seq_shift_labels``)."""
     import optax
 
     from distributed_llms_example_tpu.data.batching import LABEL_PAD
@@ -1005,7 +1008,7 @@ def test_pipelined_stage_x_sequence_train_step(tiny_llama4):
     ref_state, ref = step(state, put_batch(batch, mesh1))
 
     mesh_sp = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=2, tensor=1))
-    piped = PipelinedLlama(cfg, mesh_sp, num_microbatches=2)
+    piped = PipelinedLlama(cfg, mesh_sp, num_microbatches=2, schedule=pp_schedule)
     rules = pipeline_rules()
     state_p = create_train_state(shard_params(stack_blocks(params0), mesh_sp, rules), tx)
     state_p = jax.tree.map(
@@ -1031,17 +1034,11 @@ def test_pipelined_stage_x_sequence_train_step(tiny_llama4):
 
 
 def test_stage_x_sequence_validation():
-    """1F1B and MoE do not compose with the sequence axis — loud errors,
-    not silent wrong numbers."""
+    """MoE does not compose with the sequence axis — loud errors, not
+    silent wrong numbers."""
     from distributed_llms_example_tpu.models.llama import LlamaConfig, PipelinedLlama
 
     mesh_sp = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=2, tensor=1))
-    cfg = LlamaConfig(
-        vocab_size=64, hidden_size=16, intermediate_size=32,
-        num_hidden_layers=4, num_attention_heads=2,
-    )
-    with pytest.raises(ValueError, match="gpipe"):
-        PipelinedLlama(cfg, mesh_sp, num_microbatches=2, schedule="1f1b")
     moe_cfg = LlamaConfig(
         vocab_size=64, hidden_size=16, intermediate_size=32,
         num_hidden_layers=4, num_attention_heads=2,

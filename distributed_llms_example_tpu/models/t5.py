@@ -219,6 +219,7 @@ class T5Attention(nn.Module):
                 if learned_bias is not None
                 else None if bias is None else (bias.shape[1] == 1 and bias.shape[2] == 1)
             ),
+            has_learned_bias=learned_bias is not None,
         )
         _log_impl_once(f"t5:{impl}", reason)
         if impl == "ring":

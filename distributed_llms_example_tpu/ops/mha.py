@@ -76,6 +76,7 @@ def select_attention_impl(
     device_count: int,
     causal: bool = False,
     bias_kv_only: bool | None = None,
+    has_learned_bias: bool = False,
 ) -> tuple[str, str]:
     """(impl, reason) — pure selection logic, unit-testable without TPUs.
 
@@ -118,7 +119,9 @@ def select_attention_impl(
         # a sequence-sharded mesh where ring can't run: XLA attention is
         # correct (GSPMD gathers the sequence) but loses the SP memory win
         return "xla", f"sequence axis present but {why}"
-    if not flash_supported(q_len, kv_len, head_dim):
+    if not flash_supported(
+        q_len, kv_len, head_dim, causal=causal, has_learned_bias=has_learned_bias
+    ):
         # 'flash' means "wherever eligible": single-token decode steps and
         # other non-tileable shapes silently use the XLA path
         return "xla", f"shape not tileable (q={q_len}, kv={kv_len}, d={head_dim})"

@@ -148,6 +148,51 @@ def test_auto_block_selection():
     assert not flash_supported(1048, 1048, 64)
 
 
+def test_noncausal_block_cap():
+    """Non-causal attention without a learned bias tiles up to 1024 (measured
+    faster on v5e); causal and learned-bias paths stay at the 512 cap."""
+    from distributed_llms_example_tpu.ops.flash_attention import (
+        MAX_BLOCK,
+        MAX_BLOCK_NONCAUSAL,
+        auto_block,
+    )
+
+    assert MAX_BLOCK == 512 and MAX_BLOCK_NONCAUSAL == 1024
+    assert auto_block(1024, MAX_BLOCK_NONCAUSAL) == 1024
+    assert auto_block(2048, MAX_BLOCK_NONCAUSAL) == 1024
+    assert auto_block(512, MAX_BLOCK_NONCAUSAL) == 512
+    # flash_supported mirrors the per-path cap: 592 = 16*37 tiles only
+    # above 512, so it is eligible non-causal but NOT causal/learned-bias
+    assert flash_supported(592, 592, 64)
+    assert not flash_supported(592, 592, 64, causal=True)
+    assert not flash_supported(592, 592, 64, has_learned_bias=True)
+    # correctness at the 1024 tile, interpret-mode (CPU): square + cross
+    rng = np.random.RandomState(3)
+    for q_len in (1024, 128):
+        q = jnp.asarray(rng.randn(1, 2, q_len, 32), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 2, 1024, 32), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 2, 1024, 32), jnp.float32)
+        got = flash_attention(q, k, v, causal=False)
+        want = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    # gradients through the 1024-tile bwd kernels (dq/dkv grids run ONE
+    # k/q block each at this size — the production bart encoder shape)
+    q = jnp.asarray(rng.randn(1, 2, 1024, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 1024, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 1024, 32), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=False) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v) ** 2)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-4)
+
+
 def test_parity_non_pow2_length():
     """Auto-blocked parity at a length divisible by neither 128 nor 512."""
     rng = np.random.RandomState(0)

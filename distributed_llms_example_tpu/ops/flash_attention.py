@@ -590,9 +590,21 @@ MAX_BLOCK_NONCAUSAL = 1024  # v5e sweep at (16, 16, 1024, 64) fwd+bwd:
 #                  tile).  CAUSAL stays at 512: the tile-skip guard works
 #                  per-block, so 1024-tiles waste half of each diagonal
 #                  block on masked work (74.5 ms vs 71.0 at 512).  The
-#                  learned-bias path also stays at 512 — its dlbias kernel
-#                  carries an extra (block_q, block_k) fp32 accumulator and
-#                  is only validated at 512.
+#                  learned-bias path caps block_q at 512 but block_k at
+#                  1024 (71.1 ms vs 73.9 at 512x512): its backward carries
+#                  the (1, H, Q, K) bias tile + dlbias accumulator on top
+#                  of the plain path's scratch, and 1024x1024 overflows
+#                  the 16 MB VMEM stack (measured 18.07 MB on v5e).
+
+
+def _block_caps(causal: bool, has_learned_bias: bool) -> tuple[int, int]:
+    """(cap_q, cap_k) for the given attention flavor — see the constants'
+    comments for the v5e measurements behind each choice."""
+    if causal:
+        return MAX_BLOCK, MAX_BLOCK
+    if has_learned_bias:
+        return MAX_BLOCK, MAX_BLOCK_NONCAUSAL
+    return MAX_BLOCK_NONCAUSAL, MAX_BLOCK_NONCAUSAL
 
 
 def auto_block(seq_len: int, cap: int = MAX_BLOCK) -> int:
@@ -629,12 +641,12 @@ def flash_attention(
     """Blockwise-softmax attention; drop-in for ``dot_product_attention``.
 
     ``block_q``/``block_k`` default to ``auto_block``: the largest
-    16-aligned tile dividing each sequence length, capped at 512 for
-    causal/learned-bias attention and 1024 otherwise (one seq-sized tile
-    for short sequences) — see ``MAX_BLOCK_NONCAUSAL``.  Requires seq lens
-    divisible by the (auto-clamped) block sizes — the framework's bucketed
-    batching guarantees this for training shapes; call ``flash_supported``
-    first for arbitrary shapes.
+    16-aligned tile dividing each sequence length, capped per attention
+    flavor (512 causal, 512/1024 learned-bias, 1024 otherwise — see
+    ``_block_caps``; one seq-sized tile for short sequences).  Each seq
+    len must divide by its (auto-clamped) block size — the framework's
+    bucketed batching guarantees this for training shapes; call
+    ``flash_supported`` first for arbitrary shapes.
 
     Contract notes (both enforced or documented because this is a public
     drop-in API, not just an internal kernel):
@@ -659,9 +671,9 @@ def flash_attention(
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    cap = MAX_BLOCK if (causal or learned_bias is not None) else MAX_BLOCK_NONCAUSAL
-    block_q = auto_block(q.shape[2], cap) if block_q is None else min(block_q, q.shape[2])
-    block_k = auto_block(k.shape[2], cap) if block_k is None else min(block_k, k.shape[2])
+    cap_q, cap_k = _block_caps(causal, learned_bias is not None)
+    block_q = auto_block(q.shape[2], cap_q) if block_q is None else min(block_q, q.shape[2])
+    block_k = auto_block(k.shape[2], cap_k) if block_k is None else min(block_k, k.shape[2])
     if (
         not block_q
         or not block_k
@@ -700,13 +712,13 @@ def flash_supported(q_len: int, kv_len: int, head_dim: int,
                     has_learned_bias: bool = False) -> bool:
     """True when shapes are flash-eligible (divisible seqs, sane head_dim).
     ``None`` blocks mirror ``flash_attention``'s ``auto_block`` defaults,
-    including its block cap: 512 for causal/learned-bias attention, 1024
-    otherwise — pass ``causal``/``has_learned_bias`` as the eventual kernel
-    call will, or a length only tileable above 512 (e.g. 592 = 16*37) would
-    be reported eligible for a path whose cap rejects it."""
-    cap = MAX_BLOCK if (causal or has_learned_bias) else MAX_BLOCK_NONCAUSAL
-    bq = auto_block(q_len, cap) if block_q is None else min(block_q, q_len)
-    bk = auto_block(kv_len, cap) if block_k is None else min(block_k, kv_len)
+    including its per-path block caps (``_block_caps``) — pass ``causal``/
+    ``has_learned_bias`` as the eventual kernel call will, or a length only
+    tileable above 512 (e.g. 592 = 16*37) would be reported eligible for a
+    path whose cap rejects it."""
+    cap_q, cap_k = _block_caps(causal, has_learned_bias)
+    bq = auto_block(q_len, cap_q) if block_q is None else min(block_q, q_len)
+    bk = auto_block(kv_len, cap_k) if block_k is None else min(block_k, kv_len)
     return (
         bq > 0
         and bk > 0
@@ -854,8 +866,9 @@ def flash_attention_lbias_sharded(
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    block_q = auto_block(q.shape[2]) if block_q is None else min(block_q, q.shape[2])
-    block_k = auto_block(k.shape[2]) if block_k is None else min(block_k, k.shape[2])
+    cap_q, cap_k = _block_caps(bool(causal), True)
+    block_q = auto_block(q.shape[2], cap_q) if block_q is None else min(block_q, q.shape[2])
+    block_k = auto_block(k.shape[2], cap_k) if block_k is None else min(block_k, k.shape[2])
     if (
         not block_q or not block_k
         or q.shape[2] % block_q or k.shape[2] % block_k

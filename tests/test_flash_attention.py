@@ -150,7 +150,8 @@ def test_auto_block_selection():
 
 def test_noncausal_block_cap():
     """Non-causal attention without a learned bias tiles up to 1024 (measured
-    faster on v5e); causal and learned-bias paths stay at the 512 cap."""
+    faster on v5e); causal stays at 512, and learned-bias caps block_q at
+    512 (dlbias VMEM) while its block_k may reach 1024."""
     from distributed_llms_example_tpu.ops.flash_attention import (
         MAX_BLOCK,
         MAX_BLOCK_NONCAUSAL,
@@ -161,11 +162,13 @@ def test_noncausal_block_cap():
     assert auto_block(1024, MAX_BLOCK_NONCAUSAL) == 1024
     assert auto_block(2048, MAX_BLOCK_NONCAUSAL) == 1024
     assert auto_block(512, MAX_BLOCK_NONCAUSAL) == 512
-    # flash_supported mirrors the per-path cap: 592 = 16*37 tiles only
-    # above 512, so it is eligible non-causal but NOT causal/learned-bias
+    # flash_supported mirrors the per-path caps: 592 = 16*37 tiles only
+    # above 512, so it is eligible non-causal but NOT causal; learned-bias
+    # caps block_q at 512 (dlbias VMEM) while block_k may reach 1024
     assert flash_supported(592, 592, 64)
     assert not flash_supported(592, 592, 64, causal=True)
     assert not flash_supported(592, 592, 64, has_learned_bias=True)
+    assert flash_supported(512, 592, 64, has_learned_bias=True)
     # correctness at the 1024 tile, interpret-mode (CPU): square + cross
     rng = np.random.RandomState(3)
     for q_len in (1024, 128):
@@ -189,6 +192,29 @@ def test_noncausal_block_cap():
 
     got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-4)
+
+
+def test_lbias_asymmetric_tiles_grad_parity():
+    """The learned-bias default tiling is now ASYMMETRIC (block_q capped at
+    512, block_k at 1024) — run its backward (dq/dkv/dlbias kernels) with
+    block_k > block_q and check gradients against plain attention."""
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 128, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 128, 32), jnp.float32)
+    lb = jnp.asarray(rng.randn(1, 2, 64, 128).astype(np.float32) * 0.1)
+
+    def loss_flash(q, k, v, lb):
+        out = flash_attention(q, k, v, learned_bias=lb, block_q=64, block_k=128)
+        return jnp.sum(out ** 2)
+
+    def loss_ref(q, k, v, lb):
+        return jnp.sum(dot_product_attention(q, k, v, lb) ** 2)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, lb)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, lb)
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-4)
 

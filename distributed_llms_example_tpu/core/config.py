@@ -127,8 +127,14 @@ class TrainConfig:
     # "gpipe": forward scan + autodiff backward, O(microbatches) activation
     # memory per stage.  "1f1b": fused schedule interleaving backward with
     # forward microbatches, O(stages) activation memory — the schedule that
-    # makes large microbatch counts affordable (decoder-only families)
+    # makes large microbatch counts affordable (decoder-only families).
+    # "interleaved": 1f1b with pipeline_virtual_stages non-contiguous layer
+    # chunks per device (parallel/interleave.py) — shorter schedule at
+    # stage >= 4, ~v× more buffered chunk inputs (decoder-only families).
+    # NOTE: checkpoints store the stacked blocks in the schedule's storage
+    # order; resume with the same schedule/virtual-stages flags.
     pipeline_schedule: str = "gpipe"
+    pipeline_virtual_stages: int = 2  # chunks per device (interleaved only)
     # MoE expert capacity override for fine-tuning (None = keep the model's
     # own setting; HF-converted Mixtral defaults to no-drop, which is exact
     # but memory-hungry — 1.25 restores the capacity trade for training)
@@ -217,8 +223,13 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)
     p.add_argument(
         "--pipeline-schedule", type=str, default=_D.pipeline_schedule,
-        choices=("gpipe", "1f1b"),
-        help="stage>1 schedule: gpipe (O(M) activation memory) or 1f1b (O(S))",
+        choices=("gpipe", "1f1b", "interleaved"),
+        help="stage>1 schedule: gpipe (O(M) activation memory), 1f1b (O(S)), "
+             "or interleaved (1f1b with virtual layer chunks per device)",
+    )
+    p.add_argument(
+        "--pipeline-virtual-stages", type=int, default=_D.pipeline_virtual_stages,
+        help="layer chunks per device for --pipeline-schedule interleaved",
     )
     p.add_argument("--moe-capacity-factor", type=float, default=_D.moe_capacity_factor)
     p.add_argument(

@@ -170,12 +170,14 @@ class PipelinedLlama:
 
     def __init__(self, config: LlamaConfig, mesh, dtype=jnp.float32,
                  num_microbatches: int = 0, remat: bool = True,
-                 schedule: str = "gpipe"):
+                 schedule: str = "gpipe", virtual_stages: int = 2):
         # imported here so a missing pipeline module fails at construction
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
 
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"pipeline schedule {schedule!r}: must be gpipe or 1f1b")
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
+            raise ValueError(
+                f"pipeline schedule {schedule!r}: must be gpipe, 1f1b, or interleaved"
+            )
 
         if mesh.shape.get("sequence", 1) > 1 and mesh.shape.get("stage", 1) > 1:
             if getattr(config, "num_experts", 0) > 0:
@@ -183,9 +185,9 @@ class PipelinedLlama:
                     "pipeline stage×sequence does not compose with MoE "
                     "(per-shard router statistics need their own reduction)"
                 )
-        if getattr(config, "num_experts", 0) > 0 and schedule == "1f1b":
+        if getattr(config, "num_experts", 0) > 0 and schedule in ("1f1b", "interleaved"):
             raise ValueError(
-                "pipeline schedule 1f1b does not support MoE configs: the "
+                f"pipeline schedule {schedule} does not support MoE configs: the "
                 "load-balance aux loss is carried as an explicit pipeline "
                 "output on the gpipe path only"
             )
@@ -194,6 +196,23 @@ class PipelinedLlama:
             raise ValueError(
                 f"{config.num_hidden_layers} layers not divisible into {stages} stages"
             )
+        self.virtual_stages = int(virtual_stages) if schedule == "interleaved" else 1
+        if schedule == "interleaved":
+            # the schedule generator needs stage >= 2; v chunks per device.
+            # NOTE: stacked_blocks must be in INTERLEAVED storage order
+            # (interleave.interleave_tree) — the Trainer permutes at setup
+            # and un-permutes for eval/export.
+            if stages < 2:
+                raise ValueError("pipeline schedule interleaved needs stage >= 2")
+            if self.virtual_stages < 1:
+                raise ValueError(
+                    f"--pipeline-virtual-stages must be >= 1, got {self.virtual_stages}"
+                )
+            if config.num_hidden_layers % (stages * self.virtual_stages):
+                raise ValueError(
+                    f"{config.num_hidden_layers} layers not divisible into "
+                    f"{stages} stages x {self.virtual_stages} virtual chunks"
+                )
         self.config = config
         self.mesh = mesh
         self.dtype = dtype
@@ -247,7 +266,10 @@ class PipelinedLlama:
         summing to exactly the global ``logits[:, :-1]`` vs
         ``labels[:, 1:]`` objective."""
         from distributed_llms_example_tpu.parallel.activation import activation_mesh
-        from distributed_llms_example_tpu.parallel.pipeline import pipeline_value_and_grad
+        from distributed_llms_example_tpu.parallel.pipeline import (
+            pipeline_value_and_grad,
+            pipeline_value_and_grad_interleaved,
+        )
         from distributed_llms_example_tpu.train.step import cross_entropy_sums
 
         assert not is_seq2seq
@@ -273,14 +295,7 @@ class PipelinedLlama:
             )
             bias = mask_to_bias(batch["attention_mask"])
             post_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
-            lsum, tokens, d_stacked, d_post, d_hidden = pipeline_value_and_grad(
-                layer_fn,
-                post_loss,
-                params["stacked_blocks"],
-                post_params,
-                hidden,
-                {"bias": bias},
-                {"labels": batch["labels"]},
+            common = dict(
                 mesh=self.mesh,
                 num_microbatches=self.num_microbatches,
                 checkpoint=self.remat,
@@ -288,6 +303,21 @@ class PipelinedLlama:
                 seq_axis="sequence",
                 extras_seq_dims={"bias": 3},
                 loss_seq_dims={"labels": 1},
+            )
+            if self.pipeline_schedule == "interleaved":
+                run = pipeline_value_and_grad_interleaved
+                common["virtual_stages"] = self.virtual_stages
+            else:
+                run = pipeline_value_and_grad
+            lsum, tokens, d_stacked, d_post, d_hidden = run(
+                layer_fn,
+                post_loss,
+                params["stacked_blocks"],
+                post_params,
+                hidden,
+                {"bias": bias},
+                {"labels": batch["labels"]},
+                **common,
             )
             (d_embed,) = embed_vjp(d_hidden.astype(hidden.dtype))
             grads = {
@@ -311,6 +341,22 @@ class PipelinedLlama:
         from distributed_llms_example_tpu.parallel.pipeline import pipeline_apply
 
         params = variables["params"]
+        stacked = params["stacked_blocks"]
+        if self.pipeline_schedule == "interleaved" and self.virtual_stages > 1:
+            # apply() runs the gpipe forward, which assumes TRUE layer
+            # order — un-permute the interleaved storage first (v == 1 is
+            # already true order).  NOTE this take() executes on EVERY
+            # call (one full stacked-params gather per compiled
+            # invocation); the Trainer's val-loss path hoists it to once
+            # per evaluate() via a gpipe-view adapter, and the training
+            # step never comes through here.
+            from distributed_llms_example_tpu.parallel.interleave import (
+                uninterleave_tree,
+            )
+
+            stacked = uninterleave_tree(
+                stacked, self.mesh.shape["stage"], self.virtual_stages
+            )
         hidden = constrain_hidden(self._embed.apply({"params": params["embed_tokens"]}, input_ids))
         bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         extras = {"bias": bias} if bias is not None else {}
@@ -318,7 +364,7 @@ class PipelinedLlama:
 
         out = pipeline_apply(
             self._layer_fn(with_aux=with_aux),
-            params["stacked_blocks"],
+            stacked,
             hidden,
             extras,
             mesh=self.mesh,

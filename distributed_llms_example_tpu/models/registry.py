@@ -67,6 +67,18 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=128,
     ),
+    # 4/8 layers: enough depth for stage x virtual_stages interleaved-
+    # pipeline tests (llama-test's 2 layers only split into 2 plain stages)
+    "llama-test-4l": LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    ),
+    "llama-test-8l": LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=8, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    ),
     "llama-2-7b": LlamaConfig(),
     "llama-2-13b": LlamaConfig(
         hidden_size=5120, intermediate_size=13824, num_hidden_layers=40, num_attention_heads=40

@@ -1,0 +1,463 @@
+"""Interleaved (virtual-stage) 1F1B pipeline schedule generation.
+
+Plain 1F1B (``pipeline_value_and_grad``) gives each of the S ``stage``
+devices ONE contiguous block of L/S layers, so the pipeline fill/drain
+bubble is (S-1) chunk-times long.  Interleaving (the Megatron-LM
+"virtual pipeline" refinement — reimplemented here from the published
+schedule shape, not from any code) gives each device ``v`` NON-contiguous
+chunks of L/(S*v) layers: global chunk ``g = c*S + s`` lives on device
+``s``, so a microbatch hops device 0..S-1 v times.  Each schedule slot
+then moves 1/v of the work, cutting the fill/drain bubble toward
+(S-1)/v chunk-times — the standard way to make deep pipelines affordable
+at small microbatch counts.  The price, stated honestly: up to ~v times
+more in-flight chunk inputs buffered per device (each one microbatch
+hidden; the per-slot remat transient shrinks by v), and v times more
+ppermute hops per microbatch.
+
+The reference has no pipeline parallelism at all (SURVEY.md §2); this
+module is part of going past parity, like ops/ring_attention.py.
+
+Design: schedules are PRECOMPUTED here in pure Python as numpy tables
+(one row per tick, one column per device) and executed by a table-driven
+``lax.scan`` in ``parallel/pipeline.py``.  All correctness constraints —
+dependency order, one F and one B slot per device per tick, hop latency,
+buffer slot lifetimes — are enforced by construction and independently
+re-checked by ``validate_schedule`` from the tables alone, so the
+on-device executor contains no scheduling logic, only masked dynamic
+indexing.  A greedy backward-first list scheduler reproduces 1F1B
+behavior (backwards drain as soon as dependencies allow) without
+hand-deriving Megatron's closed-form warmup counts.
+
+Execution model the tables assume (mirrors the 1F1B executor):
+
+- Each tick every device runs one FORWARD slot then one BACKWARD slot
+  (masked when inactive, so SPMD compute is uniform).
+- The F slot's output hops +1 on the stage ring between ticks; the B
+  slot's activation-gradient hops -1.  Arrivals are written into fixed
+  queue slots at the START of the next tick.
+- The F slot saves its INPUT into an act-buffer slot; the B slot
+  recomputes the chunk forward from that slot under ``jax.vjp``.
+- The LAST global chunk's backward runs in the SAME tick as its forward
+  (the executor computes F before B within a tick): the loss vjp consumes
+  the in-tick forward output, exactly like the non-interleaved 1F1B.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = [
+    "InterleavedSchedule",
+    "interleave_order",
+    "interleave_tree",
+    "make_interleaved_schedule",
+    "uninterleave_order",
+    "uninterleave_tree",
+    "validate_schedule",
+]
+
+
+def _check(cond, msg: str) -> None:
+    """Schedule-validation check that survives ``python -O`` (a stripped
+    ``assert`` here would silently drop the independent safety net the
+    table executor relies on)."""
+    if not cond:
+        raise ValueError(f"invalid interleaved schedule: {msg}")
+
+
+def interleave_order(L: int, S: int, v: int) -> np.ndarray:
+    """Row permutation for interleaved storage: ``order[new_row]`` is the
+    TRUE layer index.  Device ``s``'s shard (rows ``s*L/S .. (s+1)*L/S``)
+    then holds its v chunks contiguously — chunk ``c`` at local offset
+    ``c*Lc`` covering true layers ``(c*S + s)*Lc .. + Lc`` — which is what
+    ``pipeline_value_and_grad_interleaved``'s ``(v, Lc)`` reshape assumes."""
+    if S < 1 or v < 1:
+        raise ValueError(f"stages and virtual chunks must be >= 1, got S={S} v={v}")
+    if L % (S * v):
+        raise ValueError(f"{L} layers not divisible into {S} stages x {v} chunks")
+    Lc = L // (S * v)
+    order = np.empty(L, np.int64)
+    for s in range(S):
+        for c in range(v):
+            for j in range(Lc):
+                order[s * (L // S) + c * Lc + j] = (c * S + s) * Lc + j
+    return order
+
+
+def interleave_tree(stacked, S: int, v: int):
+    """Reorder every leaf's leading (layer) dim into interleaved storage
+    order.  Works on numpy or jax arrays (``take`` along axis 0)."""
+    import jax
+
+    L = jax.tree.leaves(stacked)[0].shape[0]
+    order = interleave_order(L, S, v)
+    return jax.tree.map(lambda a: a.take(order, axis=0), stacked)
+
+
+def uninterleave_order(L: int, S: int, v: int) -> np.ndarray:
+    """Inverse of ``interleave_order``: ``inv[true_layer]`` is the storage
+    row holding that layer — the single shared definition every
+    storage→true-order consumer (eval unstack, export, tree un-permute)
+    must use."""
+    return np.argsort(interleave_order(L, S, v))
+
+
+def uninterleave_tree(stacked, S: int, v: int):
+    """Inverse of ``interleave_tree`` — back to true layer order (for
+    eval/export unstacking)."""
+    import jax
+
+    L = jax.tree.leaves(stacked)[0].shape[0]
+    inv = uninterleave_order(L, S, v)
+    return jax.tree.map(lambda a: a.take(inv, axis=0), stacked)
+
+
+@dataclass(frozen=True)
+class InterleavedSchedule:
+    """Table-driven schedule: all arrays are (T, S) int32.
+
+    Forward slot of device s at tick t:
+      f_active[t, s]  — 1 when the slot runs a real unit
+      f_micro[t, s]   — microbatch index m
+      f_chunk[t, s]   — LOCAL chunk index c (global chunk g = c*S + s)
+      f_src_q[t, s]   — fwd-queue slot holding the chunk input (-1: read
+                        the microbatch store; global chunk 0 only)
+      f_save[t, s]    — act-buffer slot the chunk INPUT is saved to
+      arr_f[t, s]     — fwd-queue slot the value arriving on the forward
+                        ring this tick is written to (-1: nothing arrives)
+    Backward slot mirrors forward:
+      b_active, b_micro, b_chunk,
+      b_act[t, s]     — act-buffer slot holding the saved chunk input
+      b_src_q[t, s]   — bwd-queue slot holding the incoming activation
+                        gradient (-1: in-tick loss vjp; last chunk only)
+      arr_b[t, s]     — bwd-queue arrival slot this tick (-1: none)
+      b_emit_dh[t, s] — 1 when this backward's dx is d_hidden (chunk 0)
+    Sizes: T ticks; fq_depth/bq_depth/act_depth buffer slot counts.
+    """
+
+    S: int
+    v: int
+    M: int
+    T: int
+    fq_depth: int
+    bq_depth: int
+    act_depth: int
+    f_active: np.ndarray
+    f_micro: np.ndarray
+    f_chunk: np.ndarray
+    f_src_q: np.ndarray
+    f_save: np.ndarray
+    arr_f: np.ndarray
+    b_active: np.ndarray
+    b_micro: np.ndarray
+    b_chunk: np.ndarray
+    b_act: np.ndarray
+    b_src_q: np.ndarray
+    arr_b: np.ndarray
+    b_emit_dh: np.ndarray
+    meta: dict = field(default_factory=dict)
+
+
+class _SlotPool:
+    """Free-list of buffer slots; grows on demand, records peak size."""
+
+    def __init__(self):
+        self.free: list[int] = []
+        self.next = 0
+
+    def take(self) -> int:
+        if self.free:
+            return self.free.pop()
+        s = self.next
+        self.next += 1
+        return s
+
+    def give(self, s: int) -> None:
+        self.free.append(s)
+
+    @property
+    def peak(self) -> int:
+        return self.next
+
+
+def make_interleaved_schedule(S: int, v: int, M: int) -> InterleavedSchedule:
+    """Greedy backward-first list schedule for S devices, v chunks each,
+    M microbatches.  ``validate_schedule`` runs on the result before it is
+    returned."""
+    if S < 2:
+        raise ValueError(f"interleaving needs stage >= 2, got {S}")
+    if v < 1:
+        raise ValueError(f"virtual stages must be >= 1, got {v}")
+    if M < 1:
+        raise ValueError(f"need at least one microbatch, got {M}")
+    G = v * S
+    LAST = G - 1  # the loss chunk, on device S-1, local chunk v-1
+
+    f_done = [[None] * M for _ in range(G)]
+    b_done = [[None] * M for _ in range(G)]
+    fq = [dict() for _ in range(S)]      # (g, m) -> queue slot
+    bq = [dict() for _ in range(S)]
+    fq_pool = [_SlotPool() for _ in range(S)]
+    bq_pool = [_SlotPool() for _ in range(S)]
+    act_pool = [_SlotPool() for _ in range(S)]
+    act_slot = [dict() for _ in range(S)]
+
+    def dev(g: int) -> int:
+        return g % S
+
+    def fwd_order(g: int, m: int) -> tuple:
+        # Megatron-style grouping: microbatches advance in rounds of S per
+        # chunk — (round, chunk, member) aligns chunk order across devices
+        # so chunk-boundary queue waits stay bounded.
+        return (m // S, g // S, m % S)
+
+    rows: list[dict] = []
+    hop_f: list[tuple] = []
+    hop_b: list[tuple] = []
+    t = 0
+    total = G * M
+    done_b = 0
+    max_ticks = 6 * (v * M + 2 * S) + 32
+    while done_b < total:
+        if t > max_ticks:
+            raise RuntimeError(
+                f"schedule did not converge: S={S} v={v} M={M} tick={t}"
+            )
+        row = {"arr_f": [-1] * S, "arr_b": [-1] * S, "f": [None] * S, "b": [None] * S}
+
+        # deliver last tick's hops into queues (visible this tick)
+        for (g, m) in hop_f:
+            if g + 1 < G:
+                d = dev(g + 1)
+                slot = fq_pool[d].take()
+                fq[d][(g + 1, m)] = slot
+                row["arr_f"][d] = slot
+        for (g, m) in hop_b:
+            if g - 1 >= 0:
+                d = dev(g - 1)
+                slot = bq_pool[d].take()
+                bq[d][(g - 1, m)] = slot
+                row["arr_b"][d] = slot
+        hop_f, hop_b = [], []
+
+        for s in range(S):
+            # ---- backward slot first (1F1B drain): earliest microbatch,
+            # deepest chunk; the loss chunk is handled by F/B pairing below
+            cand_b = []
+            for g in range(s, G, S):
+                if g == LAST:
+                    continue
+                for m in range(M):
+                    if b_done[g][m] is None and (g, m) in bq[s]:
+                        cand_b.append((m, -g, g))
+            b_pick = min(cand_b) if cand_b else None
+
+            # ---- forward slot: Megatron grouping order.  The loss
+            # chunk's F is eligible only when the B slot can pair with it
+            # in the same tick.
+            cand_f = []
+            for g in range(s, G, S):
+                for m in range(M):
+                    if f_done[g][m] is not None:
+                        continue
+                    if g == 0 or (g, m) in fq[s]:
+                        if g == LAST and b_pick is not None:
+                            continue  # B slot taken; pair next tick
+                        cand_f.append((fwd_order(g, m), g, m))
+            f_pick = min(cand_f) if cand_f else None
+
+            if f_pick is not None:
+                _, g, m = f_pick
+                a = act_pool[s].take()
+                act_slot[s][(g, m)] = a
+                if g == 0:
+                    src = -1
+                else:
+                    src = fq[s].pop((g, m))
+                    fq_pool[s].give(src)
+                row["f"][s] = (g, m, src, a)
+                f_done[g][m] = t
+                hop_f.append((g, m))
+                if g == LAST:
+                    # paired in-tick backward (loss vjp on the fresh y)
+                    assert b_pick is None
+                    b_done[g][m] = t
+                    done_b += 1
+                    hop_b.append((g, m))
+                    a2 = act_slot[s].pop((g, m))
+                    act_pool[s].give(a2)
+                    row["b"][s] = (g, m, -1, a)
+                    b_pick = "paired"
+
+            if b_pick is not None and b_pick != "paired":
+                m, _, g = b_pick
+                src = bq[s].pop((g, m))
+                bq_pool[s].give(src)
+                a = act_slot[s].pop((g, m))
+                act_pool[s].give(a)
+                row["b"][s] = (g, m, src, a)
+                b_done[g][m] = t
+                done_b += 1
+                hop_b.append((g, m))
+
+        rows.append(row)
+        t += 1
+
+    T = len(rows)
+
+    def tab(fill=0):
+        return np.full((T, S), fill, np.int32)
+
+    f_active, f_micro, f_chunk = tab(), tab(), tab()
+    f_src_q, f_save, arr_f, arr_b = tab(-1), tab(-1), tab(-1), tab(-1)
+    b_active, b_micro, b_chunk = tab(), tab(), tab()
+    b_act, b_src_q, b_emit_dh = tab(-1), tab(-1), tab()
+
+    for t, row in enumerate(rows):
+        for s in range(S):
+            arr_f[t, s] = row["arr_f"][s]
+            arr_b[t, s] = row["arr_b"][s]
+            if row["f"][s] is not None:
+                g, m, src, a = row["f"][s]
+                f_active[t, s] = 1
+                f_micro[t, s] = m
+                f_chunk[t, s] = g // S
+                f_src_q[t, s] = src
+                f_save[t, s] = a
+            if row["b"][s] is not None:
+                g, m, src, a = row["b"][s]
+                b_active[t, s] = 1
+                b_micro[t, s] = m
+                b_chunk[t, s] = g // S
+                b_src_q[t, s] = src
+                b_act[t, s] = a
+                b_emit_dh[t, s] = 1 if g == 0 else 0
+
+    sched = InterleavedSchedule(
+        S=S, v=v, M=M, T=T,
+        fq_depth=max(max(p.peak for p in fq_pool), 1),
+        bq_depth=max(max(p.peak for p in bq_pool), 1),
+        act_depth=max(max(p.peak for p in act_pool), 1),
+        f_active=f_active, f_micro=f_micro, f_chunk=f_chunk,
+        f_src_q=f_src_q, f_save=f_save, arr_f=arr_f,
+        b_active=b_active, b_micro=b_micro, b_chunk=b_chunk,
+        b_act=b_act, b_src_q=b_src_q, arr_b=arr_b, b_emit_dh=b_emit_dh,
+        meta={"ticks": T, "ideal_ticks": v * M, "bubble_ticks": T - v * M},
+    )
+    validate_schedule(sched)
+    return sched
+
+
+def validate_schedule(sc: InterleavedSchedule) -> None:
+    """Re-check every execution constraint from the tables alone (the
+    generator's internal state is not trusted): every unit runs exactly
+    once; forward dependency order with hop latency >= 1; backward after
+    (same tick for the loss chunk as) its forward and before the previous
+    chunk's backward; queue/act slots written before read, never clobbered
+    while live, and freed exactly once; every send has a matching arrival."""
+    S, v, M, G, T = sc.S, sc.v, sc.M, sc.v * sc.S, sc.T
+    f_tick, b_tick = {}, {}
+    for t in range(T):
+        for s in range(S):
+            if sc.f_active[t, s]:
+                key = (sc.f_chunk[t, s] * S + s, int(sc.f_micro[t, s]))
+                _check(key not in f_tick, f"F{key} scheduled twice")
+                f_tick[key] = t
+            if sc.b_active[t, s]:
+                key = (sc.b_chunk[t, s] * S + s, int(sc.b_micro[t, s]))
+                _check(key not in b_tick, f"B{key} scheduled twice")
+                b_tick[key] = t
+    _check(len(f_tick) == G * M, f"{len(f_tick)} forward units != {G * M}")
+    _check(len(b_tick) == G * M, f"{len(b_tick)} backward units != {G * M}")
+    for g in range(G):
+        for m in range(M):
+            if g > 0:
+                _check(f_tick[(g, m)] > f_tick[(g - 1, m)], f"F({g},{m}) not after F({g - 1},{m})")
+            if g < G - 1:
+                _check(b_tick[(g, m)] > b_tick[(g + 1, m)], f"B({g},{m}) not after B({g + 1},{m})")
+            if g == G - 1:
+                _check(b_tick[(g, m)] == f_tick[(g, m)], "loss-chunk backward must pair with its forward in-tick")
+            else:
+                _check(
+                    b_tick[(g, m)] > f_tick[(g, m)],
+                    f"B({g},{m}) must run after F({g},{m})",
+                )
+
+    # buffer lifetime simulation straight from the tables; within a tick
+    # the executor order is: queue arrivals, then F (reads fq, writes
+    # act), then B (reads act + bq).  Queue entries track the UNIT whose
+    # value they hold (like the act check), so a generator bug that swaps
+    # two in-flight units' slot assignments — write-before-read and
+    # no-clobber both still holding — cannot slip a wrong microbatch's
+    # activation into a chunk vjp.
+    for s in range(S):
+        live_f, live_b, live_a = {}, {}, {}
+        for t in range(T):
+            if sc.arr_f[t, s] >= 0:
+                _check(t > 0, f"fq arrival at tick 0 has no sender (s={s})")
+                _check(sc.arr_f[t, s] not in live_f, f"fq clobber t={t} s={s}")
+                src = (s - 1) % S
+                g_sent = int(sc.f_chunk[t - 1, src]) * S + src
+                live_f[int(sc.arr_f[t, s])] = (g_sent + 1, int(sc.f_micro[t - 1, src]))
+            if sc.arr_b[t, s] >= 0:
+                _check(t > 0, f"bq arrival at tick 0 has no sender (s={s})")
+                _check(sc.arr_b[t, s] not in live_b, f"bq clobber t={t} s={s}")
+                srcb = (s + 1) % S
+                g_b = int(sc.b_chunk[t - 1, srcb]) * S + srcb
+                live_b[int(sc.arr_b[t, s])] = (g_b - 1, int(sc.b_micro[t - 1, srcb]))
+            if sc.f_active[t, s]:
+                g = int(sc.f_chunk[t, s]) * S + s
+                m = int(sc.f_micro[t, s])
+                q = int(sc.f_src_q[t, s])
+                if q >= 0:
+                    _check(q in live_f, f"fq slot {q} read before write t={t} s={s}")
+                    _check(live_f[q] == (g, m), f"fq slot {q} holds unit {live_f[q]}, forward wants ({g}, {m})")
+                    del live_f[q]
+                else:
+                    _check(g == 0, "src -1 is chunk-0 only")
+                a = int(sc.f_save[t, s])
+                _check(a >= 0 and a not in live_a, f"act clobber t={t} s={s}")
+                live_a[a] = (int(sc.f_chunk[t, s]), int(sc.f_micro[t, s]))
+            if sc.b_active[t, s]:
+                g = int(sc.b_chunk[t, s]) * S + s
+                m = int(sc.b_micro[t, s])
+                q = int(sc.b_src_q[t, s])
+                if q >= 0:
+                    _check(q in live_b, f"bq slot {q} read before write t={t} s={s}")
+                    _check(live_b[q] == (g, m), f"bq slot {q} holds unit {live_b[q]}, backward wants ({g}, {m})")
+                    del live_b[q]
+                else:
+                    _check(g == G - 1, "src -1 is loss chunk only")
+                a = int(sc.b_act[t, s])
+                _check(a in live_a, f"act slot {a} not live t={t} s={s}")
+                _check(live_a[a] == (int(sc.b_chunk[t, s]), int(sc.b_micro[t, s])), f"act slot {a} holds {live_a[a]} but backward wants " f"({int(sc.b_chunk[t, s])}, {int(sc.b_micro[t, s])})")
+                del live_a[a]
+        _check(not live_a, f"act slots leaked on device {s}: {live_a}")
+        _check(not live_f, f"fwd-queue slots leaked on device {s}: {live_f}")
+        _check(not live_b, f"bwd-queue slots leaked on device {s}: {live_b}")
+
+    # every ring send must land in a queue slot on the right neighbor one
+    # tick later (or be the final chunk, which sends nothing useful)
+    for t in range(T):
+        for s in range(S):
+            if sc.f_active[t, s]:
+                g = sc.f_chunk[t, s] * S + s
+                if g + 1 < G:
+                    d = (s + 1) % S
+                    _check(t + 1 < T and sc.arr_f[t + 1, d] >= 0, f"F output of t={t} s={s} (g={g}) never delivered")
+            if sc.b_active[t, s]:
+                g = sc.b_chunk[t, s] * S + s
+                if g - 1 >= 0:
+                    d = (s - 1) % S
+                    _check(t + 1 < T and sc.arr_b[t + 1, d] >= 0, f"B output of t={t} s={s} (g={g}) never delivered")
+    # conversely: an arrival implies its sender was active last tick
+    for t in range(1, T):
+        for s in range(S):
+            if sc.arr_f[t, s] >= 0:
+                src = (s - 1) % S
+                _check(sc.f_active[t - 1, src], f"fq arrival t={t} s={s} unsent")
+            if sc.arr_b[t, s] >= 0:
+                src = (s + 1) % S
+                _check(sc.b_active[t - 1, src], f"bq arrival t={t} s={s} unsent")

@@ -79,17 +79,21 @@ def stack_blocks(params: dict, prefix: str = "block_", out_key: str = "stacked_b
 
 
 def unstack_blocks(params: dict, prefix: str = "block_", key: str = "stacked_blocks",
-                   layer_transform=None) -> dict:
+                   layer_transform=None, row_order=None) -> dict:
     """Pipelined tree → standard per-layer tree (for checkpoints/eval).
     ``layer_transform`` (if given) is applied to each layer tree AS it is
     unstacked — the hook the memory-aware reshard path uses so only one
-    untransformed (replicated) layer is ever live."""
+    untransformed (replicated) layer is ever live.  ``row_order`` (if
+    given) maps TRUE layer index → storage row — the interleaved pipeline
+    schedule's permuted layout resolves here one row at a time, instead of
+    materializing a whole un-permuted copy of the stack first."""
     stacked = params[key]
     rest = {k: v for k, v in params.items() if k != key}
     n = jax.tree.leaves(stacked)[0].shape[0]
     out = dict(rest)
     for i in range(n):
-        layer = jax.tree.map(lambda x: x[i], stacked)
+        row = i if row_order is None else int(row_order[i])
+        layer = jax.tree.map(lambda x: x[row], stacked)
         out[f"{prefix}{i}"] = layer if layer_transform is None else layer_transform(layer)
     return out
 
@@ -133,7 +137,8 @@ def unstack_for_family(family: str, params: dict) -> dict:
     return _unstack_dispatch(family, params, unstack_blocks)
 
 
-def unstack_for_family_resharded(family: str, params: dict, mesh, rules=None) -> dict:
+def unstack_for_family_resharded(family: str, params: dict, mesh, rules=None,
+                                 row_order=None) -> dict:
     """``unstack_for_family`` that device_puts each layer onto its
     (default FSDP/TP) rule sharding AS it is unstacked.  Indexing a
     stage-sharded stack yields a replicated layer; doing all layers before
@@ -151,7 +156,9 @@ def unstack_for_family_resharded(family: str, params: dict, mesh, rules=None) ->
                 holder["sh"] = resolve_shardings(layer, mesh, rules)
             return jax.tree.map(jax.device_put, layer, holder["sh"])
 
-        return unstack_blocks(tree, prefix, key, layer_transform=transform)
+        return unstack_blocks(
+            tree, prefix, key, layer_transform=transform, row_order=row_order
+        )
 
     out = _unstack_dispatch(family, params, unstack_one)
     # non-stacked leaves (embeddings/norms/head) get their rule shardings
@@ -185,7 +192,8 @@ def gather_tree_to_host(tree, *, writer_only: bool = False):
     return jax.tree.map(to_host, tree)
 
 
-def unstack_for_family_to_host(family: str, params: dict, *, writer_only: bool = False) -> dict:
+def unstack_for_family_to_host(family: str, params: dict, *, writer_only: bool = False,
+                               row_order=None) -> dict:
     """Unstack a pipelined tree layer-by-layer STRAIGHT TO HOST numpy —
     the export path.  Device-side resharded unstacking still replicates
     everything on a pure-pipeline mesh (stage>1 with fsdp=tensor=1, the
@@ -200,6 +208,7 @@ def unstack_for_family_to_host(family: str, params: dict, *, writer_only: bool =
         return unstack_blocks(
             tree, prefix, key,
             layer_transform=lambda layer: gather_tree_to_host(layer, writer_only=writer_only),
+            row_order=row_order,
         )
 
     out = _unstack_dispatch(family, params, unstack_one)
@@ -535,6 +544,208 @@ def pipeline_apply(
     return result.astype(compute_dtype)
 
 
+def _pvg_single_stage(run_stage, post_loss_fn, stacked_params, post_params,
+                      hidden, extras, loss_batch, rng):
+    """S == 1 fallback shared by the fused-schedule executors: one vjp over
+    (blocks ∘ tail) under plain GSPMD — no pipeline."""
+
+    def whole(sp, pp, h):
+        return post_loss_fn(pp, run_stage(sp, h, extras, rng), loss_batch)
+
+    (lsum, tokens), vjp = jax.vjp(whole, stacked_params, post_params, hidden)
+    d_sp, d_pp, d_h = vjp((jnp.ones((), lsum.dtype), jnp.zeros((), tokens.dtype)))
+    return lsum, tokens, d_sp, d_pp, d_h
+
+
+def _pvg_check_batch(B: int, mesh: Mesh, M: int, batch_axes) -> None:
+    """Fail fast on a batch that doesn't divide into (batch shards ×
+    microbatches) — run BEFORE the S==1 early return too, so a stage=1
+    misconfiguration surfaces immediately instead of when scaled up."""
+    batch_shards = 1
+    for a in batch_axes:
+        if a in mesh.shape:
+            batch_shards *= mesh.shape[a]
+    if B % (batch_shards * M):
+        raise ValueError(
+            f"global batch {B} not divisible by {batch_shards} batch shards "
+            f"× {M} microbatches"
+        )
+
+
+def _pvg_common(hidden, extras, *, mesh, axis_name, seq_axis):
+    """Shared setup for the fused-schedule executors (plain 1F1B and
+    interleaved): sequence axis resolution and the bf16→fp32 boundary
+    conversion (sharded-boundary bf16 crossings feed the partitioner
+    copy-chain bug — convert OUTSIDE the manual region, see
+    ``pipeline_apply``).  Returns ``(seq_axis, n_seq, axes_all,
+    is_batched, ex_dtypes, compute_dtype, plumb_dtype, hidden, extras)``.
+    Batch divisibility is validated by the executors themselves
+    (``_pvg_check_batch``, BEFORE their S==1 early return) — not here."""
+    B = hidden.shape[0]
+    n_seq = mesh.shape.get(seq_axis, 1) if seq_axis else 1
+    if n_seq <= 1:
+        seq_axis = None
+    if seq_axis is not None and hidden.ndim >= 2 and hidden.shape[1] % n_seq:
+        raise ValueError(
+            f"sequence length {hidden.shape[1]} not divisible by "
+            f"{seq_axis}={n_seq}"
+        )
+    axes_all = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
+    is_batched = jax.tree.map(lambda m: m.ndim > 0 and m.shape[0] == B, extras)
+    ex_dtypes = jax.tree.map(lambda m: m.dtype, extras)
+    compute_dtype = hidden.dtype
+    plumb_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
+    if seq_axis is not None:
+        hidden = hidden.astype(plumb_dtype)
+        extras = jax.tree.map(
+            lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, extras
+        )
+    return (seq_axis, n_seq, axes_all, is_batched, ex_dtypes,
+            compute_dtype, plumb_dtype, hidden, extras)
+
+
+def _pvg_body_prologue(sp_local, pp, h, ex, lb, rt, *, S, M, axis_name,
+                       axes_all, seq_axis, plumb_dtype, is_batched, ex_dtypes):
+    """Shared in-body setup for the fused-schedule executors.  Everything
+    entering a ``jax.vjp`` is pre-varied: differentiating w.r.t. an
+    unvarying input under a varying cotangent transposes the implicit
+    broadcast into a hidden psum over the manual axes — the per-stage
+    grads would then already contain every OTHER stage's (garbage)
+    contribution, leaking through the schedule masks (and over ``seq``
+    that implicit psum would be bf16, the partitioner crash).  Explicit
+    fp32 psums in the epilogue do the real cross-shard reductions.
+
+    Returns ``(s_idx, is_last, sp_local, pp, key, mb, micro, micro_ex,
+    micro_lb, ex_at)`` with the batch already split into M microbatches."""
+    s_idx = jax.lax.axis_index(axis_name)
+    is_last = s_idx == S - 1
+    ex = jax.tree.map(
+        lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, ex
+    )
+    h, ex, lb = _vary(h.astype(plumb_dtype), axes_all), _vary(ex, axes_all), _vary(lb, axes_all)
+    pp = _vary(pp, axes_all)
+    sp_local = _vary(sp_local, axes_all)
+    key = rt.get("key")
+    if key is not None:
+        key = jax.random.fold_in(_vary(key, axes_all), s_idx)
+        if seq_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(seq_axis))
+    mb = h.shape[0] // M
+    micro = h.reshape(M, mb, *h.shape[1:])
+    micro_ex = jax.tree.map(
+        lambda m, batched: m.reshape(M, m.shape[0] // M, *m.shape[1:]) if batched else m,
+        ex, is_batched,
+    )
+    micro_lb = jax.tree.map(lambda m: m.reshape(M, m.shape[0] // M, *m.shape[1:]), lb)
+
+    def ex_at(m_idx):
+        return jax.tree.map(
+            lambda m, batched, dt: (
+                jax.lax.dynamic_index_in_dim(m, m_idx, 0, keepdims=False)
+                if batched else m
+            ).astype(dt),
+            micro_ex, is_batched, ex_dtypes,
+        )
+
+    return s_idx, is_last, sp_local, pp, key, mb, micro, micro_ex, micro_lb, ex_at
+
+
+def _pvg_loss_vjp(loss_f, pp, y, do_loss):
+    """Loss-head forward+vjp, gated on ``do_loss`` — a tick-level predicate
+    that is UNVARYING across devices (derived from the tick index / a
+    schedule table, never from ``axis_index``), so ``lax.cond`` runs ONE
+    branch and all devices agree (collectives inside ``loss_f``, e.g. the
+    seq-sharded label-shift ppermute, stay consistent).  Without the gate
+    every tick of every device would pay a full loss-head fwd+bwd
+    (final-norm + lm_head over a microbatch + CE) that only the last
+    stage's real loss ticks need — for large-vocab models that fixed cost
+    rivals a layer chunk's.  Returns ``(ls_m, tk_m, d_pp_m, dy_loss)``;
+    the skip branch returns zeros of the same shapes/dtypes (vma types
+    derived from the varying operands, so ``check_vma`` stays happy)."""
+
+    def with_loss(ops):
+        pp_, y_ = ops
+        (ls_m, tk_m), loss_vjp = jax.vjp(loss_f, pp_, y_)
+        # cotangents must carry exactly the outputs' vma type (varying or
+        # not, depending on what loss_f computes) — derive from the outputs
+        d_pp_m, dy_loss = loss_vjp((ls_m * 0 + 1, tk_m * 0))
+        return ls_m, tk_m, d_pp_m, dy_loss
+
+    def skip_loss(ops):
+        pp_, y_ = ops
+        out_sh = jax.eval_shape(loss_f, pp_, y_)
+        zscal = y_.ravel()[0] * 0
+        ls_m = zscal.astype(out_sh[0].dtype)
+        tk_m = zscal.astype(out_sh[1].dtype)
+        d_pp_m = jax.tree.map(lambda p: p * 0, pp_)
+        dy_loss = y_ * 0
+        return ls_m, tk_m, d_pp_m, dy_loss
+
+    return jax.lax.cond(do_loss, with_loss, skip_loss, (pp, y))
+
+
+def _pvg_body_epilogue(lsum, toks, d_sp, d_pp, d_h, h_shape, *, axis_name,
+                       axes_all, seq_axis):
+    """Shared reduction epilogue: loss/tail grads live on the last stage,
+    d_hidden on stage 0 (updates already masked to those stages); psum
+    replicates.  Under sequence parallelism the scalars and param/tail
+    grads additionally reduce over the seq shards (all fp32 — bf16 psums
+    over manual axes crash the partitioner); d_h stays seq-sharded (it IS
+    the local positions' gradient)."""
+    lsum = jax.lax.psum(lsum, axes_all)
+    toks = jax.lax.psum(toks, axes_all)
+    d_pp = jax.tree.map(lambda g: jax.lax.psum(g, axes_all), d_pp)
+    d_h = jax.lax.psum(d_h, axis_name)
+    if seq_axis is not None:
+        d_sp = jax.tree.map(lambda g: jax.lax.psum(g, seq_axis), d_sp)
+    return lsum, toks, d_sp, d_pp, d_h.reshape(h_shape)
+
+
+def _pvg_shard_map(body, *, mesh, axis_name, axes_all, seq_axis, n_seq,
+                   stacked_params, post_params, hidden, extras, loss_batch,
+                   rng, extras_seq_dims, loss_seq_dims):
+    """Shared spec construction + ``shard_map`` epilogue for the fused-
+    schedule executors.  ``body(sp, pp, h, ex, lb, rt)`` returns
+    ``(lsum, tokens, d_sp, d_pp, d_h)``; it is wrapped in the
+    ``manual_sequence`` context when a sequence axis is live."""
+    param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
+    rng_tree = {} if rng is None else {"key": rng}
+    if seq_axis is None:
+        hidden_spec = P()
+        extras_specs = jax.tree.map(lambda m: P(), extras)
+        loss_specs = jax.tree.map(lambda m: P(), loss_batch)
+    else:
+        hidden_spec, extras_specs, loss_specs = _seq_specs(
+            seq_axis, hidden.ndim, (extras, extras_seq_dims), (loss_batch, loss_seq_dims)
+        )
+
+    def outer(sp, pp, h, ex, lb, rt):
+        if seq_axis is None:
+            return body(sp, pp, h, ex, lb, rt)
+        with manual_sequence(seq_axis, n_seq):
+            return body(sp, pp, h, ex, lb, rt)
+
+    return jax.shard_map(
+        outer,
+        mesh=mesh,
+        axis_names=set(axes_all),
+        in_specs=(
+            param_specs,
+            jax.tree.map(lambda _: P(), post_params),
+            hidden_spec,
+            extras_specs,
+            loss_specs,
+            jax.tree.map(lambda _: P(), rng_tree),
+        ),
+        out_specs=(
+            P(), P(), param_specs,
+            jax.tree.map(lambda _: P(), post_params),
+            hidden_spec,
+        ),
+        check_vma=True,
+    )(stacked_params, post_params, hidden, extras, loss_batch, rng_tree)
+
+
 def pipeline_value_and_grad(
     layer_fn: Callable,
     post_loss_fn: Callable,
@@ -611,97 +822,35 @@ def pipeline_value_and_grad(
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     if L % max(S, 1):
         raise ValueError(f"{L} layers not divisible into {S} pipeline stages")
-    B = hidden.shape[0]
-    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
-    batch_shards = 1
-    for a in batch_axes:
-        batch_shards *= mesh.shape[a]
-    if B % (batch_shards * M):
-        raise ValueError(
-            f"global batch {B} not divisible by {batch_shards} batch shards "
-            f"× {M} microbatches"
-        )
     run_stage = _make_run_stage(layer_fn, checkpoint)
-
+    _pvg_check_batch(hidden.shape[0], mesh, M, batch_axes)
     if S == 1:
-        # no pipeline: one vjp over (blocks ∘ tail) under plain GSPMD
-        def whole(sp, pp, h):
-            return post_loss_fn(pp, run_stage(sp, h, extras, rng), loss_batch)
-
-        (lsum, tokens), vjp = jax.vjp(whole, stacked_params, post_params, hidden)
-        d_sp, d_pp, d_h = vjp((jnp.ones((), lsum.dtype), jnp.zeros((), tokens.dtype)))
-        return lsum, tokens, d_sp, d_pp, d_h
-
-    n_seq = mesh.shape.get(seq_axis, 1) if seq_axis else 1
-    if n_seq <= 1:
-        seq_axis = None
-    if seq_axis is not None and hidden.ndim >= 2 and hidden.shape[1] % n_seq:
-        raise ValueError(
-            f"sequence length {hidden.shape[1]} not divisible by "
-            f"{seq_axis}={n_seq}"
+        return _pvg_single_stage(
+            run_stage, post_loss_fn, stacked_params, post_params,
+            hidden, extras, loss_batch, rng,
         )
-    axes_all = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
-
-    is_batched = jax.tree.map(lambda m: m.ndim > 0 and m.shape[0] == B, extras)
-    ex_dtypes = jax.tree.map(lambda m: m.dtype, extras)
-    compute_dtype = hidden.dtype
-    # same partitioner workaround as pipeline_apply: plumbing in fp32
-    plumb_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
-    if seq_axis is not None:
-        # sharded-boundary bf16 crossings feed the partitioner copy-chain
-        # bug — convert outside the region (see pipeline_apply)
-        hidden = hidden.astype(plumb_dtype)
-        extras = jax.tree.map(
-            lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, extras
-        )
+    (seq_axis, n_seq, axes_all, is_batched, ex_dtypes, compute_dtype,
+     plumb_dtype, hidden, extras) = _pvg_common(
+        hidden, extras, mesh=mesh, axis_name=axis_name, seq_axis=seq_axis,
+    )
     K = 2 * S - 1  # ring depth ≥ max activation lifetime in ticks (stage 0)
     T = M + 2 * (S - 1)
 
     def body(sp_local, pp, h, ex, lb, rt):
-        s_idx = jax.lax.axis_index(axis_name)
-        is_last = s_idx == S - 1
-        ex = jax.tree.map(
-            lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, ex
+        h_shape = h.shape
+        (s_idx, is_last, sp_local, pp, key, mb, micro, micro_ex, micro_lb,
+         ex_at) = _pvg_body_prologue(
+            sp_local, pp, h, ex, lb, rt, S=S, M=M, axis_name=axis_name,
+            axes_all=axes_all, seq_axis=seq_axis, plumb_dtype=plumb_dtype,
+            is_batched=is_batched, ex_dtypes=ex_dtypes,
         )
-        h, ex, lb = _vary(h.astype(plumb_dtype), axes_all), _vary(ex, axes_all), _vary(lb, axes_all)
-        # pp must be stage-VARYING before entering jax.vjp: differentiating
-        # w.r.t. an unvarying input under a varying cotangent transposes
-        # the implicit broadcast into a hidden psum over stage — the
-        # per-stage d_pp would then already contain every OTHER stage's
-        # (garbage) contribution, leaking through the take_loss mask.
-        # Same over seq: pre-varying keeps the per-shard cotangents local
-        # (and the implicit-psum it avoids would be bf16 — the crash); the
-        # explicit fp32 psums at the end do the cross-shard reduction.
-        pp = _vary(pp, axes_all)
-        sp_local = _vary(sp_local, axes_all)
-        key = rt.get("key")
-        if key is not None:
-            key = jax.random.fold_in(_vary(key, axes_all), s_idx)
-            if seq_axis is not None:
-                key = jax.random.fold_in(key, jax.lax.axis_index(seq_axis))
-        mb = h.shape[0] // M
-        micro = h.reshape(M, mb, *h.shape[1:])
-        micro_ex = jax.tree.map(
-            lambda m, batched: m.reshape(M, m.shape[0] // M, *m.shape[1:]) if batched else m,
-            ex, is_batched,
-        )
-        micro_lb = jax.tree.map(lambda m: m.reshape(M, m.shape[0] // M, *m.shape[1:]), lb)
-
-        def ex_at(m_idx):
-            return jax.tree.map(
-                lambda m, batched, dt: (
-                    jax.lax.dynamic_index_in_dim(m, m_idx, 0, keepdims=False)
-                    if batched else m
-                ).astype(dt),
-                micro_ex, is_batched, ex_dtypes,
-            )
 
         zeros_like_f32 = lambda t: jax.tree.map(  # noqa: E731
             lambda x: _vary(jnp.zeros(x.shape, jnp.float32), axes_all), t
         )
-        fwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axes_all)
-        bwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), h.dtype), axes_all)
-        act = _vary(jnp.zeros((K, mb, *h.shape[1:]), h.dtype), axes_all)
+        fwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), plumb_dtype), axes_all)
+        bwd_buf = _vary(jnp.zeros((mb, *h.shape[1:]), plumb_dtype), axes_all)
+        act = _vary(jnp.zeros((K, mb, *h.shape[1:]), plumb_dtype), axes_all)
         d_sp = zeros_like_f32(sp_local)
         d_pp = zeros_like_f32(pp)
         d_h = _vary(jnp.zeros((M, mb, *h.shape[1:]), jnp.float32), axes_all)
@@ -733,7 +882,10 @@ def pipeline_value_and_grad(
             act = jax.lax.dynamic_update_index_in_dim(act, x_in, mf_c % K, 0)
 
             # ---- last stage: loss fwd+vjp for the microbatch it just
-            # finished (1F then immediately 1B of the same microbatch)
+            # finished (1F then immediately 1B of the same microbatch).
+            # The gate is TICK-level (the last stage's F is active exactly
+            # on ticks S-1 .. S-1+M-1) and unvarying across devices, so
+            # the loss head runs on M ticks instead of all T.
             lb_f = jax.tree.map(
                 lambda m: jax.lax.dynamic_index_in_dim(m, mf_c, 0, keepdims=False),
                 micro_lb,
@@ -742,11 +894,8 @@ def pipeline_value_and_grad(
             def loss_f(pp_, y_):
                 return post_loss_fn(pp_, y_.astype(compute_dtype), lb_f)
 
-            (ls_m, tk_m), loss_vjp = jax.vjp(loss_f, pp, y)
-            # cotangents must carry exactly the outputs' vma type (varying
-            # or not, depending on what post_loss_fn computes) — derive
-            # them from the outputs themselves
-            d_pp_m, dy_loss = loss_vjp((ls_m * 0 + 1, tk_m * 0))
+            do_loss = (t >= S - 1) & (t < S - 1 + M)
+            ls_m, tk_m, d_pp_m, dy_loss = _pvg_loss_vjp(loss_f, pp, y, do_loss)
             take_loss = is_last & act_f
             lsum = lsum + jnp.where(take_loss, ls_m.astype(jnp.float32), 0.0)
             toks = toks + jnp.where(take_loss, tk_m.astype(jnp.float32), 0.0)
@@ -787,53 +936,247 @@ def pipeline_value_and_grad(
         (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks), _ = jax.lax.scan(
             tick, carry, jnp.arange(T)
         )
-        # loss/tail grads live on the last stage, d_hidden on stage 0 (its
-        # updates are already masked to those stages); psum replicates.
-        # Under sequence parallelism the scalar sums and the param/tail
-        # grads additionally reduce over the seq shards (all in fp32 —
-        # bf16 psums over manual axes crash the partitioner); d_h stays
-        # seq-sharded (it IS the local positions' gradient).
-        lsum = jax.lax.psum(lsum, axes_all)
-        toks = jax.lax.psum(toks, axes_all)
-        d_pp = jax.tree.map(lambda g: jax.lax.psum(g, axes_all), d_pp)
-        d_h = jax.lax.psum(d_h, axis_name)
-        if seq_axis is not None:
-            d_sp = jax.tree.map(lambda g: jax.lax.psum(g, seq_axis), d_sp)
-        return lsum, toks, d_sp, d_pp, d_h.reshape(h.shape)
-
-    param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
-    rng_tree = {} if rng is None else {"key": rng}
-    if seq_axis is None:
-        hidden_spec = P()
-        extras_specs = jax.tree.map(lambda m: P(), extras)
-        loss_specs = jax.tree.map(lambda m: P(), loss_batch)
-    else:
-        hidden_spec, extras_specs, loss_specs = _seq_specs(
-            seq_axis, hidden.ndim, (extras, extras_seq_dims), (loss_batch, loss_seq_dims)
+        return _pvg_body_epilogue(
+            lsum, toks, d_sp, d_pp, d_h, h_shape,
+            axis_name=axis_name, axes_all=axes_all, seq_axis=seq_axis,
         )
 
-    def outer(sp, pp, h, ex, lb, rt):
-        if seq_axis is None:
-            return body(sp, pp, h, ex, lb, rt)
-        with manual_sequence(seq_axis, n_seq):
-            return body(sp, pp, h, ex, lb, rt)
+    return _pvg_shard_map(
+        body, mesh=mesh, axis_name=axis_name, axes_all=axes_all,
+        seq_axis=seq_axis, n_seq=n_seq, stacked_params=stacked_params,
+        post_params=post_params, hidden=hidden, extras=extras,
+        loss_batch=loss_batch, rng=rng, extras_seq_dims=extras_seq_dims,
+        loss_seq_dims=loss_seq_dims,
+    )
 
-    return jax.shard_map(
-        outer,
-        mesh=mesh,
-        axis_names=set(axes_all),
-        in_specs=(
-            param_specs,
-            jax.tree.map(lambda _: P(), post_params),
-            hidden_spec,
-            extras_specs,
-            loss_specs,
-            jax.tree.map(lambda _: P(), rng_tree),
-        ),
-        out_specs=(
-            P(), P(), param_specs,
-            jax.tree.map(lambda _: P(), post_params),
-            hidden_spec,
-        ),
-        check_vma=True,
-    )(stacked_params, post_params, hidden, extras, loss_batch, rng_tree)
+
+def pipeline_value_and_grad_interleaved(
+    layer_fn: Callable,
+    post_loss_fn: Callable,
+    stacked_params: Any,
+    post_params: Any,
+    hidden: jnp.ndarray,
+    extras: Any,
+    loss_batch: Any,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    virtual_stages: int,
+    axis_name: str = "stage",
+    batch_axes: tuple[str, ...] = ("data", "fsdp", "expert"),
+    checkpoint: bool = True,
+    rng: jnp.ndarray | None = None,
+    seq_axis: str | None = None,
+    extras_seq_dims: Any = None,
+    loss_seq_dims: Any = None,
+):
+    """Interleaved (virtual-stage) 1F1B: each device runs ``virtual_stages``
+    NON-CONTIGUOUS layer chunks, table-driven by a precomputed schedule
+    (``parallel/interleave.py`` — see its docstring for the model and the
+    honest cost accounting: in this fused-tick SPMD executor the win over
+    plain 1F1B is the shorter tick count T(v)/v < T(1), ~7-10% of pipeline
+    wall at stage >= 4, growing with depth; the price is ~v× more buffered
+    chunk inputs.  The loss-head vjp is gated to its M real ticks on BOTH
+    schedules — ``_pvg_loss_vjp`` — so it does not scale with T(v)).
+    ``stacked_params`` rows must already be in INTERLEAVED
+    storage order (``interleave.interleave_tree``): device ``s``'s shard
+    holds its v chunks contiguously, chunk ``c`` covering true layers
+    ``(c*S + s) * Lc .. + Lc``.  Same contract as
+    ``pipeline_value_and_grad`` otherwise; ``virtual_stages=1`` is plain
+    1F1B through the table machinery (the equivalence tests pin both
+    against the single-device step).
+    """
+    from distributed_llms_example_tpu.parallel.interleave import (
+        make_interleaved_schedule,
+    )
+
+    S = mesh.shape.get(axis_name, 1)
+    M = num_microbatches
+    v = int(virtual_stages)
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    run_stage = _make_run_stage(layer_fn, checkpoint)
+    _pvg_check_batch(hidden.shape[0], mesh, M, batch_axes)
+    if S == 1:
+        return _pvg_single_stage(
+            run_stage, post_loss_fn, stacked_params, post_params,
+            hidden, extras, loss_batch, rng,
+        )
+    if L % (S * v):
+        raise ValueError(
+            f"{L} layers not divisible into {S} stages x {v} virtual chunks"
+        )
+    sc = make_interleaved_schedule(S, v, M)
+    (seq_axis, n_seq, axes_all, is_batched, ex_dtypes, compute_dtype,
+     plumb_dtype, hidden, extras) = _pvg_common(
+        hidden, extras, mesh=mesh, axis_name=axis_name, seq_axis=seq_axis,
+    )
+
+    # schedule tables as device constants; each tick reads its own row
+    tbl = {
+        name: jnp.asarray(getattr(sc, name))
+        for name in (
+            "f_active", "f_micro", "f_chunk", "f_src_q", "f_save", "arr_f",
+            "b_active", "b_micro", "b_chunk", "b_act", "b_src_q", "arr_b",
+            "b_emit_dh",
+        )
+    }
+    # tick-level (device-independent) gate for the loss-head vjp: the
+    # ticks where device S-1 forwards the loss chunk — exactly M of them
+    _t_loss_np = (sc.f_active[:, S - 1] == 1) & (sc.f_chunk[:, S - 1] == v - 1)
+    if int(_t_loss_np.sum()) != M:  # not assert: must survive python -O
+        raise ValueError(
+            f"interleaved schedule runs the loss chunk {int(_t_loss_np.sum())} "
+            f"times, expected {M}"
+        )
+    t_loss = jnp.asarray(_t_loss_np)
+
+    def body(sp_local, pp, h, ex, lb, rt):
+        h_shape = h.shape
+        (s_idx, is_last, sp_local, pp, key, mb, micro, micro_ex, micro_lb,
+         ex_at) = _pvg_body_prologue(
+            sp_local, pp, h, ex, lb, rt, S=S, M=M, axis_name=axis_name,
+            axes_all=axes_all, seq_axis=seq_axis, plumb_dtype=plumb_dtype,
+            is_batched=is_batched, ex_dtypes=ex_dtypes,
+        )
+        # local rows -> (v, Lc, ...): chunk c of device s = global chunk
+        # c*S + s (the interleaved storage order)
+        sp_v = jax.tree.map(
+            lambda a: a.reshape(v, a.shape[0] // v, *a.shape[1:]), sp_local
+        )
+
+        def chunk_key(c_idx, m_idx):
+            if key is None:
+                return None
+            return jax.random.fold_in(jax.random.fold_in(key, c_idx), m_idx)
+
+        def chunk_run(p_all, c_idx, x, ex_c, k):
+            p_c = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c_idx, 0, keepdims=False),
+                p_all,
+            )
+            return run_stage(p_c, x.astype(compute_dtype), ex_c, k).astype(plumb_dtype)
+
+        zeros_like_f32 = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: _vary(jnp.zeros(x.shape, jnp.float32), axes_all), t
+        )
+        zbuf = lambda n: _vary(jnp.zeros((n, mb, *h.shape[1:]), plumb_dtype), axes_all)  # noqa: E731
+        fwd_in = zbuf(1)[0]
+        bwd_in = zbuf(1)[0]
+        fqbuf = zbuf(sc.fq_depth)
+        bqbuf = zbuf(sc.bq_depth)
+        act = zbuf(sc.act_depth)
+        d_sp = zeros_like_f32(sp_v)
+        d_pp = zeros_like_f32(pp)
+        d_h = _vary(jnp.zeros((M, mb, *h.shape[1:]), jnp.float32), axes_all)
+        scal0 = _vary(jnp.zeros((), jnp.float32), axes_all)
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+        def at(name, t):
+            return tbl[name][t, s_idx]
+
+        def tick(carry, t):
+            fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, lsum, toks = carry
+
+            # ---- queue arrivals (values sent on the rings last tick)
+            af = at("arr_f", t)
+            fq_upd = jax.lax.dynamic_update_index_in_dim(
+                fqbuf, fwd_in, jnp.clip(af, 0, sc.fq_depth - 1), 0
+            )
+            fqbuf = jnp.where(af >= 0, fq_upd, fqbuf)
+            ab = at("arr_b", t)
+            bq_upd = jax.lax.dynamic_update_index_in_dim(
+                bqbuf, bwd_in, jnp.clip(ab, 0, sc.bq_depth - 1), 0
+            )
+            bqbuf = jnp.where(ab >= 0, bq_upd, bqbuf)
+
+            # ---- forward slot
+            f_on = at("f_active", t) == 1
+            fm = at("f_micro", t)
+            fc = at("f_chunk", t)
+            fsrc = at("f_src_q", t)
+            x0 = jax.lax.dynamic_index_in_dim(micro, fm, 0, keepdims=False)
+            xq = jax.lax.dynamic_index_in_dim(
+                fqbuf, jnp.clip(fsrc, 0, sc.fq_depth - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(fsrc < 0, x0, xq)
+            ex_f = ex_at(fm)
+            y = chunk_run(sp_v, fc, x_in, ex_f, chunk_key(fc, fm))
+            a_save = jnp.clip(at("f_save", t), 0, sc.act_depth - 1)
+            act_upd = jax.lax.dynamic_update_index_in_dim(act, x_in, a_save, 0)
+            act = jnp.where(f_on, act_upd, act)
+
+            # ---- loss vjp on the in-tick forward output; tick-gated by
+            # the schedule table (unvarying across devices → lax.cond),
+            # folded only where this slot IS the loss chunk
+            lb_f = jax.tree.map(
+                lambda m: jax.lax.dynamic_index_in_dim(m, fm, 0, keepdims=False),
+                micro_lb,
+            )
+
+            def loss_f(pp_, y_):
+                return post_loss_fn(pp_, y_.astype(compute_dtype), lb_f)
+
+            ls_m, tk_m, d_pp_m, dy_loss = _pvg_loss_vjp(loss_f, pp, y, t_loss[t])
+            take_loss = f_on & is_last & (fc == v - 1)
+            lsum = lsum + jnp.where(take_loss, ls_m.astype(jnp.float32), 0.0)
+            toks = toks + jnp.where(take_loss, tk_m.astype(jnp.float32), 0.0)
+            d_pp = jax.tree.map(
+                lambda a_, g: a_ + jnp.where(take_loss, g.astype(jnp.float32), 0.0),
+                d_pp, d_pp_m,
+            )
+
+            # ---- backward slot (recomputes its chunk forward under vjp)
+            b_on = at("b_active", t) == 1
+            bm = at("b_micro", t)
+            bc = at("b_chunk", t)
+            bsrc = at("b_src_q", t)
+            x_b = jax.lax.dynamic_index_in_dim(
+                act, jnp.clip(at("b_act", t), 0, sc.act_depth - 1), 0, keepdims=False
+            )
+            ex_b = ex_at(bm)
+            k_b = chunk_key(bc, bm)
+
+            def chunk_b(p_, x_):
+                return chunk_run(p_, bc, x_, ex_b, k_b)
+
+            _, chunk_vjp = jax.vjp(chunk_b, sp_v, x_b)
+            dy_q = jax.lax.dynamic_index_in_dim(
+                bqbuf, jnp.clip(bsrc, 0, sc.bq_depth - 1), 0, keepdims=False
+            )
+            dy_in = jnp.where(bsrc < 0, dy_loss.astype(plumb_dtype), dy_q)
+            d_sp_m, dx = chunk_vjp(dy_in)
+            d_sp = jax.tree.map(
+                lambda a_, g: a_ + jnp.where(b_on, g.astype(jnp.float32), 0.0),
+                d_sp, d_sp_m,
+            )
+            emit = (at("b_emit_dh", t) == 1) & b_on
+            d_h_upd = jax.lax.dynamic_update_index_in_dim(
+                d_h, dx.astype(jnp.float32), bm, 0
+            )
+            d_h = jnp.where(emit, d_h_upd, d_h)
+
+            # ---- ring hops
+            fwd_in = jax.lax.ppermute(y, axis_name, perm_fwd)
+            bwd_in = jax.lax.ppermute(dx.astype(plumb_dtype), axis_name, perm_bwd)
+            return (fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, lsum, toks), None
+
+        carry = (fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, scal0, scal0)
+        carry, _ = jax.lax.scan(tick, carry, jnp.arange(sc.T))
+        d_sp, d_pp, d_h, lsum, toks = carry[5], carry[6], carry[7], carry[8], carry[9]
+        # (v, Lc, ...) grads back to the sharded row layout first
+        d_sp = jax.tree.map(
+            lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]), d_sp
+        )
+        return _pvg_body_epilogue(
+            lsum, toks, d_sp, d_pp, d_h, h_shape,
+            axis_name=axis_name, axes_all=axes_all, seq_axis=seq_axis,
+        )
+
+    return _pvg_shard_map(
+        body, mesh=mesh, axis_name=axis_name, axes_all=axes_all,
+        seq_axis=seq_axis, n_seq=n_seq, stacked_params=stacked_params,
+        post_params=post_params, hidden=hidden, extras=extras,
+        loss_batch=loss_batch, rng=rng, extras_seq_dims=extras_seq_dims,
+        loss_seq_dims=loss_seq_dims,
+    )

@@ -164,8 +164,8 @@ def make_train_step(
         mesh, P(None, ("data", "fsdp", "expert"), "sequence" if seq_sharded else None)
     )
 
-    if getattr(model, "pipeline_schedule", "gpipe") == "1f1b":
-        # the 1F1B pipeline owns its backward pass (forward/backward
+    if getattr(model, "pipeline_schedule", "gpipe") in ("1f1b", "interleaved"):
+        # these pipelines own their backward pass (forward/backward
         # microbatches interleave inside one fused schedule — autodiff
         # cannot reorder its backward, so the adapter computes gradients
         # itself); same (loss_sum, tokens, grads) contract as the

@@ -28,6 +28,7 @@ accumulation everywhere, deterministic multi-host data sharding.
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Any, Sequence
@@ -152,14 +153,17 @@ class Trainer:
                 num_microbatches=cfg.pipeline_microbatches,
                 remat=cfg.remat,
             )
-            if cfg.pipeline_schedule == "1f1b":
+            if cfg.pipeline_schedule in ("1f1b", "interleaved"):
                 if self.loaded.family != "llama":
                     raise ValueError(
-                        "--pipeline-schedule 1f1b currently supports decoder-only "
-                        f"(llama) families, not {self.loaded.family!r}; the seq2seq "
-                        "adapters' twin encoder/decoder pipelines use gpipe"
+                        f"--pipeline-schedule {cfg.pipeline_schedule} currently "
+                        "supports decoder-only (llama) families, not "
+                        f"{self.loaded.family!r}; the seq2seq adapters' twin "
+                        "encoder/decoder pipelines use gpipe"
                     )
-                adapter_kw["schedule"] = "1f1b"
+                adapter_kw["schedule"] = cfg.pipeline_schedule
+                if cfg.pipeline_schedule == "interleaved":
+                    adapter_kw["virtual_stages"] = cfg.pipeline_virtual_stages
             if self.loaded.family == "llama":
                 from distributed_llms_example_tpu.models.llama import PipelinedLlama as Adapter
             elif self.loaded.family == "bart":
@@ -172,6 +176,21 @@ class Trainer:
                     f"{self.loaded.family!r}"
                 )
             params = stack_for_family(self.loaded.family, params)
+            if cfg.pipeline_schedule == "interleaved" and cfg.pipeline_virtual_stages > 1:
+                # interleaved storage order: device s's stage shard holds
+                # its v non-contiguous chunks contiguously (host-side
+                # permutation, before sharding; checkpoints store this
+                # layout — resume with the same schedule flags.  v == 1 is
+                # the identity: standard layout, no permutation)
+                from distributed_llms_example_tpu.parallel.interleave import (
+                    interleave_tree,
+                )
+
+                params["stacked_blocks"] = interleave_tree(
+                    params["stacked_blocks"],
+                    self.mesh.shape["stage"],
+                    cfg.pipeline_virtual_stages,
+                )
             self.model = Adapter(self.config, self.mesh, **adapter_kw)
             self._rules = pipeline_rules()
             log_json({
@@ -265,18 +284,88 @@ class Trainer:
         )
         self.train_step, _ = build(self.state)
 
+        ckpt_dir = os.path.join(cfg.output_dir, "checkpoints")
         self.checkpointer = Checkpointer(
-            os.path.join(cfg.output_dir, "checkpoints"),
+            ckpt_dir,
             save_every_steps=cfg.checkpoint.save_every_steps,
             keep=cfg.checkpoint.keep,
             async_save=cfg.checkpoint.async_save,
         )
+        # Stacked-block STORAGE ORDER is schedule-dependent (interleaved
+        # packs each device's v non-contiguous chunks contiguously) but
+        # invisible to array shapes — resuming a checkpoint under a
+        # different layout would silently train a layer-permuted model.
+        # Record the layout next to the checkpoints and hard-fail on
+        # mismatch instead.
+        # v == 1 is the IDENTITY permutation (interleave_order(L, S, 1) is
+        # ascending), so only v > 1 is a distinct storage layout — and the
+        # permutation is f(L, stages, v): the STAGE COUNT matters too (the
+        # same v on a resized stage axis packs different chunks per shard),
+        # so it is part of the guarded identity
+        permuted = (
+            self.pipelined
+            and cfg.pipeline_schedule == "interleaved"
+            and cfg.pipeline_virtual_stages > 1
+        )
+        self._ckpt_layout = {
+            "interleaved": permuted,
+            "virtual_stages": cfg.pipeline_virtual_stages if permuted else 1,
+            "stages": self.mesh.shape.get("stage", 1) if permuted else 1,
+        }
+        # THE single storage→true-order map (None: storage is already in
+        # layer order).  Every consumer — eval unstack, HF export, the
+        # val-loss un-permute — reads this one attribute, so the layout
+        # identity cannot drift between them.
+        self._storage_row_order = None
+        if permuted:
+            from distributed_llms_example_tpu.parallel.interleave import (
+                uninterleave_order,
+            )
+
+            self._storage_row_order = uninterleave_order(
+                self.config.num_hidden_layers,
+                self.mesh.shape["stage"],
+                cfg.pipeline_virtual_stages,
+            )
+        self._ckpt_layout_path = os.path.join(ckpt_dir, "stacked_layout.json")
         self.start_step = 0
+        if self.checkpointer.latest_step() is not None:
+            stored = {"interleaved": False, "virtual_stages": 1, "stages": 1}
+            if os.path.exists(self._ckpt_layout_path):
+                with open(self._ckpt_layout_path) as f:
+                    stored = json.load(f)
+            if stored != self._ckpt_layout:
+                # refuse MIXED-layout dirs even with resume=False: this
+                # run's saves would not erase the old run's higher steps,
+                # and rewriting the sidecar would mislabel them for a
+                # later resume (restore_latest takes the HIGHEST step)
+                raise ValueError(
+                    f"checkpoint dir {ckpt_dir} stores stacked blocks in "
+                    f"layout {stored}, but this run uses "
+                    f"{self._ckpt_layout} — resume with the same "
+                    "--pipeline-schedule/--pipeline-virtual-stages flags "
+                    "AND stage-axis size, or point --output-dir at a fresh "
+                    "directory (array shapes match under any row "
+                    "permutation, so restoring across layouts would "
+                    "silently permute the model's layers)"
+                )
         if cfg.checkpoint.resume:
             restored = self.checkpointer.restore_latest(abstract_like(self.state, self.state_sh))
             if restored is not None:
                 self.state, self.start_step = restored
                 log_json({"event": "resumed", "step": self.start_step})
+        # Written at init, AFTER the mismatch guard: a mixed dir has
+        # already been refused above, and deferring to the first save
+        # would leave a crash window (preemption save lands, SIGKILL
+        # before the sidecar write → interleaved checkpoints unlabeled,
+        # and a later same-flags resume would be refused as a "mismatch").
+        # Only written when storage is actually permuted — the guard's
+        # missing-sidecar default IS the standard layout, so a sidecar for
+        # it would add nothing (and litter every plain run's output dir)
+        if permuted and jax.process_index() == 0:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            with open(self._ckpt_layout_path, "w") as f:
+                json.dump(self._ckpt_layout, f)
 
         # Generation-based ROUGE under stage>1 unstacks each layer onto the
         # FSDP/TP rule shardings — but on a PURE-stage mesh (fsdp×tensor==1,
@@ -349,7 +438,8 @@ class Trainer:
                 # generation then needs params/(fsdp·tensor) per device,
                 # the normal FSDP story instead of a whole-model cliff
                 eval_params = unstack_for_family_resharded(
-                    self.loaded.family, eval_params, self.mesh
+                    self.loaded.family, eval_params, self.mesh,
+                    row_order=self._storage_row_order,
                 )
             eval_batch = self.cfg.eval_batch_size or self.cfg.batch_size
             pc = jax.process_count()
@@ -376,14 +466,28 @@ class Trainer:
         training footprint, not a replicated copy of the model)."""
         from distributed_llms_example_tpu.train.step import make_loss_fn
 
+        interleaved_storage = self._storage_row_order is not None
         if not hasattr(self, "_val_loss_fn"):
             from distributed_llms_example_tpu.parallel.activation import activation_mesh
             from distributed_llms_example_tpu.parallel.sharding import batch_sharding
 
             # same objective as training (incl. label smoothing) so the
-            # train-vs-val gap measures generalization, not a formula skew
+            # train-vs-val gap measures generalization, not a formula skew.
+            # Under interleaved STORAGE, score through a gpipe-VIEW adapter
+            # fed a true-order tree instead (built once per evaluate below)
+            # — the interleaved adapter's apply() would re-gather the whole
+            # stacked tree on every batch
+            model_for_val = self.model
+            if interleaved_storage:
+                from distributed_llms_example_tpu.models.llama import PipelinedLlama
+
+                model_for_val = PipelinedLlama(
+                    self.config, self.mesh, dtype=self.model.dtype,
+                    num_microbatches=self.model.num_microbatches,
+                    remat=self.cfg.remat, schedule="gpipe",
+                )
             loss_sums = make_loss_fn(
-                self.model, self.config, self.cfg.label_smoothing,
+                model_for_val, self.config, self.cfg.label_smoothing,
                 is_seq2seq=self.loaded.is_seq2seq,
             )
             bsh = batch_sharding(self.mesh)
@@ -400,6 +504,25 @@ class Trainer:
                     return jitted(p, b)
 
             self._val_loss_fn = run
+        val_params = self.state.params
+        if interleaved_storage:
+            # ONE stacked-tree un-permute per evaluate, not per batch —
+            # and JITTED with sharded outputs, so the partitioner emits a
+            # cross-shard row permutation instead of an eager per-leaf
+            # take() that would gather the whole stack replicated (the
+            # memory cliff this stage-sharded val path exists to avoid)
+            if not hasattr(self, "_val_unpermute"):
+                import jax.numpy as _jnp
+
+                inv = self._storage_row_order  # THE storage→true-order map
+                self._val_unpermute = jax.jit(
+                    lambda t: jax.tree.map(lambda a: _jnp.take(a, inv, axis=0), t),
+                    out_shardings=self.state_sh.params["stacked_blocks"],
+                )
+            val_params = dict(val_params)
+            val_params["stacked_blocks"] = self._val_unpermute(
+                val_params["stacked_blocks"]
+            )
 
         # eval batch rounded to the pipeline quantum: batch shards ×
         # microbatches (and the host slice divisibility)
@@ -441,7 +564,7 @@ class Trainer:
                     (local_pos >= rem)[:, None], LABEL_PAD, batch["labels"]
                 )
             gb = put_batch(batch, self.mesh, sequence_sharded=False)
-            lsum, tokens = self._val_loss_fn(self.state.params, gb)
+            lsum, tokens = self._val_loss_fn(val_params, gb)
             total_loss += float(lsum)
             total_tokens += float(tokens)
         return total_loss / max(total_tokens, 1.0)
@@ -656,7 +779,8 @@ class Trainer:
             )
 
             final_params = unstack_for_family_to_host(
-                self.loaded.family, final_params, writer_only=True
+                self.loaded.family, final_params, writer_only=True,
+                row_order=self._storage_row_order,
             )
         else:
             # multi-host shards live on other hosts' devices; gather each

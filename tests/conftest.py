@@ -33,3 +33,32 @@ def dp_mesh():
     from distributed_llms_example_tpu.core.mesh import build_mesh
 
     return build_mesh(MeshConfig(data=-1))
+
+
+def _tiny_llama(layers: int):
+    import jax.numpy as jnp
+
+    from distributed_llms_example_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=layers, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    module = LlamaForCausalLM(cfg)
+    params = jax.device_get(
+        module.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    )
+    return cfg, module, params
+
+
+@pytest.fixture()
+def tiny_llama4():
+    """4-layer tiny LLaMA (llama-test is 2 layers; stage=4 needs 4)."""
+    return _tiny_llama(4)
+
+
+@pytest.fixture()
+def tiny_llama8():
+    """8 tiny layers: depth for stage=4 × v=2 interleaved chunks."""
+    return _tiny_llama(8)

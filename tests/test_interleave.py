@@ -1,0 +1,370 @@
+"""Interleaved (virtual-stage) pipeline: schedule generator properties and
+train-step equivalence against the single-device step.
+
+The schedule is a pure-Python artifact (parallel/interleave.py) — its
+validator re-derives every execution constraint from the tables alone, so
+these tests focus on (a) generator properties across shapes, (b) the
+executor reproducing the single-device math exactly (schedule-only
+reordering), (c) the storage-order permutation round-tripping through
+eval/export paths.  The reference has no pipeline parallelism (SURVEY.md
+§2: model parallelism "No"); this goes past parity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_example_tpu.core.config import MeshConfig
+from distributed_llms_example_tpu.core.mesh import build_mesh
+from distributed_llms_example_tpu.parallel.interleave import (
+    interleave_order,
+    interleave_tree,
+    make_interleaved_schedule,
+    uninterleave_tree,
+    validate_schedule,
+)
+from distributed_llms_example_tpu.parallel.pipeline import stack_blocks, unstack_blocks
+
+
+@pytest.mark.parametrize(
+    "S,v,M",
+    [(2, 2, 4), (2, 2, 8), (4, 2, 8), (2, 4, 8), (4, 4, 16), (8, 2, 16), (3, 2, 9)],
+)
+def test_schedule_validates(S, v, M):
+    """Generator output passes the independent table validator and stays
+    within sane tick bounds (useful work is v*M ticks per device)."""
+    sc = make_interleaved_schedule(S, v, M)
+    validate_schedule(sc)  # idempotent re-check
+    assert sc.T >= v * M
+    # fill/drain overhead is bounded by the round-trip through the
+    # virtual pipeline (2 * (v*S - 1) hops at one tick each)
+    assert sc.T <= v * M + 2 * (v * S - 1) + S
+    # the grouping order keeps queues trivially shallow
+    assert sc.fq_depth <= 2 and sc.bq_depth <= 2
+
+
+@pytest.mark.parametrize("S,M", [(2, 4), (4, 8)])
+def test_v1_matches_plain_1f1b_tick_count(S, M):
+    """virtual_stages=1 through the table machinery reproduces the plain
+    1F1B schedule length T = M + 2(S-1)."""
+    sc = make_interleaved_schedule(S, 1, M)
+    assert sc.T == M + 2 * (S - 1)
+
+
+def test_interleaving_shortens_the_schedule():
+    """The point of the feature: at fixed work, interleaved ticks (each
+    1/v the size) finish in less wall than v=1 ticks — T(v)/v < T(1)."""
+    for S, M in [(4, 8), (8, 16)]:
+        t1 = make_interleaved_schedule(S, 1, M).T
+        t2 = make_interleaved_schedule(S, 2, M).T
+        assert t2 / 2 < t1, f"S={S} M={M}: T(2)/2={t2 / 2} !< T(1)={t1}"
+
+
+def test_interleave_order_roundtrip():
+    L, S, v = 8, 2, 2
+    order = interleave_order(L, S, v)
+    assert sorted(order.tolist()) == list(range(L))
+    # device 0 rows: chunk 0 = true layers [0,1], chunk 1 = true [4,5]
+    assert order.tolist()[:4] == [0, 1, 4, 5]
+    # device 1 rows: chunk 0 = true [2,3], chunk 1 = true [6,7]
+    assert order.tolist()[4:] == [2, 3, 6, 7]
+    x = {"w": np.arange(L * 3).reshape(L, 3)}
+    rt = uninterleave_tree(interleave_tree(x, S, v), S, v)
+    np.testing.assert_array_equal(rt["w"], x["w"])
+
+
+def _single_device_step(cfg, module, params0, batch, tx, schedule):
+    from distributed_llms_example_tpu.parallel.sharding import shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    mesh1 = build_mesh(
+        MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1]
+    )
+    build = make_train_step(module, cfg, tx, schedule, mesh1, donate=False, is_seq2seq=False)
+    state = create_train_state(shard_params(params0, mesh1), tx)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh1))
+    step, _ = build(state)
+    return step(state, put_batch(batch, mesh1))
+
+
+def _interleaved_step(cfg, params0, batch, tx, schedule, *, mesh, v, micro,
+                      sequence_sharded=False):
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+    from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    piped = PipelinedLlama(cfg, mesh, num_microbatches=micro,
+                           schedule="interleaved", virtual_stages=v)
+    assert piped.pipeline_schedule == "interleaved" and piped.virtual_stages == v
+    stacked = stack_blocks(params0)
+    stacked["stacked_blocks"] = interleave_tree(
+        stacked["stacked_blocks"], mesh.shape["stage"], v
+    )
+    rules = pipeline_rules()
+    state_p = create_train_state(shard_params(stacked, mesh, rules), tx)
+    state_p = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, mesh, rules)
+    )
+    build_p = make_train_step(
+        piped, cfg, tx, schedule, mesh, rules=rules, donate=False, is_seq2seq=False
+    )
+    step_p, _ = build_p(state_p)
+    return step_p(state_p, put_batch(batch, mesh, sequence_sharded=sequence_sharded))
+
+
+@pytest.mark.parametrize(
+    "stages,v,micro,layers",
+    [(2, 2, 4, 4), (2, 2, 2, 8), (4, 2, 8, 8)],
+)
+def test_interleaved_train_step_equals_single_device(
+    request, stages, v, micro, layers, tiny_llama8
+):
+    """Interleaving is a SCHEDULE-only change: loss, grad norm, and updated
+    params must match the single-device step exactly — with multi-layer
+    chunks (layers=8) and chunk-per-layer (layers=4) storage."""
+    import optax
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+
+    if layers == 8:
+        cfg, module, params0 = tiny_llama8
+    else:
+        cfg, module, params0 = request.getfixturevalue("tiny_llama4")
+    rng = np.random.RandomState(31)
+    b, src = 16, 16
+    ids = rng.randint(2, cfg.vocab_size, (b, src)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, :4] = LABEL_PAD
+    mask = np.ones((b, src), np.int32)
+    mask[:2, -3:] = 0
+    batch = {"input_ids": ids, "attention_mask": mask, "labels": labels}
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    ref_state, ref = _single_device_step(cfg, module, params0, batch, tx, schedule)
+
+    mesh_p = build_mesh(
+        MeshConfig(stage=stages, data=8 // stages, fsdp=1, sequence=1, tensor=1)
+    )
+    new_state_p, got = _interleaved_step(
+        cfg, params0, batch, tx, schedule, mesh=mesh_p, v=v, micro=micro
+    )
+
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+    assert float(got["target_tokens"]) == float(ref["target_tokens"])
+    upd = jax.device_get(new_state_p.params)
+    upd["stacked_blocks"] = uninterleave_tree(upd["stacked_blocks"], stages, v)
+    upd = unstack_blocks(upd)
+    ref_upd = jax.device_get(ref_state.params)
+    for lyr in ("block_0", f"block_{cfg.num_hidden_layers - 1}"):
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(upd[lyr])[0]),
+            np.asarray(jax.tree.leaves(ref_upd[lyr])[0]),
+            atol=1e-5, rtol=1e-4,
+        )
+    np.testing.assert_allclose(
+        np.asarray(upd["lm_head"]["kernel"]),
+        np.asarray(ref_upd["lm_head"]["kernel"]),
+        atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_interleaved_composes_with_tensor(tiny_llama4):
+    """stage=2 x tensor=2 x data=2 with v=2: chunk vjps still run under
+    GSPMD auto-partitioning over the tensor axis."""
+    import optax
+
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+
+    cfg, module, params0 = tiny_llama4
+    rng = np.random.RandomState(37)
+    b, src = 8, 16
+    ids = rng.randint(2, cfg.vocab_size, (b, src)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, :6] = LABEL_PAD
+    batch = {"input_ids": ids, "attention_mask": np.ones((b, src), np.int32), "labels": labels}
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    _, ref = _single_device_step(cfg, module, params0, batch, tx, schedule)
+    mesh_p = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=1, tensor=2))
+    _, got = _interleaved_step(
+        cfg, params0, batch, tx, schedule, mesh=mesh_p, v=2, micro=2
+    )
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+
+
+def test_interleaved_apply_uninterleaves_for_eval(tiny_llama4):
+    """The gpipe eval forward (PipelinedLlama.apply) must see TRUE layer
+    order: with interleaved storage the adapter un-permutes internally, so
+    logits match the plain module."""
+    from distributed_llms_example_tpu.models.llama import PipelinedLlama
+
+    cfg, module, params0 = tiny_llama4
+    rng = np.random.RandomState(41)
+    ids = rng.randint(2, cfg.vocab_size, (8, 16)).astype(np.int32)
+    mask = np.ones((8, 16), np.int32)
+    ref = module.apply({"params": params0}, jnp.asarray(ids), jnp.asarray(mask))
+
+    mesh_p = build_mesh(MeshConfig(stage=2, data=2, fsdp=2, sequence=1, tensor=1))
+    piped = PipelinedLlama(cfg, mesh_p, num_microbatches=2,
+                           schedule="interleaved", virtual_stages=2)
+    stacked = stack_blocks(params0)
+    stacked["stacked_blocks"] = interleave_tree(stacked["stacked_blocks"], 2, 2)
+    out = piped.apply({"params": stacked}, jnp.asarray(ids), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_virtual_stages_validation():
+    from distributed_llms_example_tpu.models.llama import LlamaConfig, PipelinedLlama
+
+    mesh = build_mesh(MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1))
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=4, num_attention_heads=2,
+    )
+    with pytest.raises(ValueError, match="virtual-stages"):
+        PipelinedLlama(cfg, mesh, schedule="interleaved", virtual_stages=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        PipelinedLlama(cfg, mesh, schedule="interleaved", virtual_stages=3)
+
+
+def test_checkpoint_layout_guard(tmp_path):
+    """Resuming an interleaved-layout checkpoint under a different schedule
+    must hard-fail: array shapes match under any row permutation, so a
+    silent restore would train a layer-permuted model."""
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    records = [{"dialogue": f"a b c {i}", "summary": "a b"} for i in range(16)]
+    base = dict(
+        model_ckpt="llama-test-4l",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        mesh=MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1),
+        tokenizer="byte",
+        pipeline_microbatches=2,
+        pipeline_eval_rouge=False,
+    )
+    cfg = TrainConfig(
+        **base,
+        pipeline_schedule="interleaved",
+        pipeline_virtual_stages=2,
+        checkpoint=CheckpointConfig(save_every_steps=1, resume=True, async_save=False),
+    )
+    trainer = Trainer(cfg, train_records=records, val_records=records[:8])
+    trainer.train()
+    # same layout resumes fine
+    Trainer(cfg, train_records=records, val_records=records[:8])
+    # different schedule (standard layer order) must refuse the checkpoint
+    cfg2 = TrainConfig(
+        **base,
+        pipeline_schedule="1f1b",
+        checkpoint=CheckpointConfig(save_every_steps=1, resume=True, async_save=False),
+    )
+    with pytest.raises(ValueError, match="layout"):
+        Trainer(cfg2, train_records=records, val_records=records[:8])
+    # a RESIZED stage axis permutes differently under the SAME flags —
+    # the layout identity is f(L, stages, v): train 8 layers interleaved
+    # v=2 at stage=2, then resume v=2 at stage=4 (only `stages` differs)
+    import os as _os
+
+    dir2 = _os.path.join(str(tmp_path), "resize")
+    base_s2 = dict(base, output_dir=dir2, model_ckpt="llama-test-8l")
+    cfg_s2 = TrainConfig(
+        **base_s2,
+        pipeline_schedule="interleaved",
+        pipeline_virtual_stages=2,
+        checkpoint=CheckpointConfig(save_every_steps=1, resume=True, async_save=False),
+    )
+    Trainer(cfg_s2, train_records=records, val_records=records[:8]).train()
+    base_s4 = dict(base_s2, mesh=MeshConfig(stage=4, data=2, fsdp=1, sequence=1, tensor=1))
+    cfg_s4 = TrainConfig(
+        **base_s4,
+        pipeline_schedule="interleaved",
+        pipeline_virtual_stages=2,
+        checkpoint=CheckpointConfig(save_every_steps=1, resume=True, async_save=False),
+    )
+    with pytest.raises(ValueError, match="layout"):
+        Trainer(cfg_s4, train_records=records, val_records=records[:8])
+    # v=1 is the IDENTITY permutation — standard layout, so a v=1
+    # interleaved run resumes plain-1f1b checkpoints (and vice versa)
+    cfg_v1 = TrainConfig(
+        **base_s2,
+        pipeline_schedule="interleaved",
+        pipeline_virtual_stages=1,
+        checkpoint=CheckpointConfig(save_every_steps=1, resume=True, async_save=False),
+    )
+    with pytest.raises(ValueError, match="layout"):
+        # the dir still holds v=2-layout checkpoints: v=1 (standard) differs
+        Trainer(cfg_v1, train_records=records, val_records=records[:8])
+
+
+def test_trainer_interleaved_end_to_end(tmp_path):
+    """Trainer with --pipeline-schedule interleaved on stage=2 x data=4,
+    v=2 (llama-test-4l): trains to finite losses, reports the pipelined
+    val loss, and exports an HF checkpoint in TRUE layer order."""
+    import os
+
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    records = [
+        {"dialogue": f"number {i} plus {i}", "summary": f"sum {2 * i}"}
+        for i in range(16)
+    ]
+    cfg = TrainConfig(
+        model_ckpt="llama-test-4l",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        mesh=MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        tokenizer="byte",
+        pipeline_microbatches=2,
+        pipeline_schedule="interleaved",
+        pipeline_virtual_stages=2,
+        pipeline_eval_rouge=False,
+    )
+    trainer = Trainer(cfg, train_records=records, val_records=records[:8])
+    assert trainer.model.pipeline_schedule == "interleaved"
+    result = trainer.train()
+    assert result["steps"] == trainer.total_steps
+    assert np.isfinite(result["final_eval"]["val_loss"])
+    # exported checkpoint is in TRUE layer order: each per-layer block in
+    # the HF artifact equals the corresponding UN-interleaved stacked row
+    # of the live training state (not the raw storage row)
+    from distributed_llms_example_tpu.models.registry import load_model
+
+    reloaded = load_model(os.path.join(str(tmp_path), "model"))
+    assert "stacked_blocks" not in reloaded.params
+    live = jax.device_get(trainer.state.params["stacked_blocks"])
+    true_order = uninterleave_tree(live, 2, 2)
+    leaf = lambda tree: np.asarray(  # noqa: E731
+        jax.tree.leaves(tree["self_attn"]["q_proj"])[0], np.float32
+    )
+    for i in range(4):
+        row = jax.tree.map(lambda a: a[i], true_order)
+        np.testing.assert_allclose(
+            leaf(reloaded.params[f"block_{i}"]), leaf(row), atol=1e-5, rtol=1e-5
+        )

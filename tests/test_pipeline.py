@@ -125,19 +125,9 @@ def test_validation_errors(pp_mesh):
         pipeline_apply(_toy_layer, stacked, h, {"shift": h}, mesh=pp_mesh, num_microbatches=3)
 
 
-@pytest.fixture(scope="module")
-def tiny_llama4():
-    """4-layer tiny LLaMA (llama-test is 2 layers; stage=4 needs 4)."""
-    from distributed_llms_example_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-
-    cfg = LlamaConfig(
-        vocab_size=128, hidden_size=32, intermediate_size=64,
-        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
-        max_position_embeddings=64,
-    )
-    module = LlamaForCausalLM(cfg)
-    params = jax.device_get(module.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
-    return cfg, module, params
+# tiny_llama4 now lives in tests/conftest.py (shared with test_interleave.py);
+# note the conftest fixture is function-scoped where this module's was
+# module-scoped — params are tiny, the re-init cost is noise.
 
 
 def test_pipelined_llama_logits_parity(pp_mesh, tiny_llama4):
@@ -969,13 +959,15 @@ def test_pipelined_stage_x_sequence_logits_parity(tiny_llama4):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-@pytest.mark.parametrize("pp_schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("pp_schedule", ["gpipe", "1f1b", "interleaved"])
 def test_pipelined_stage_x_sequence_train_step(tiny_llama4, pp_schedule):
     """Full train step on stage=2 × sequence=2 × data=2 == single device:
     autodiff through the combined manual region (pipeline transpose AND the
     ring's rotated-K/V transpose in one backward) is exact.  On 1f1b the
     schedule owns the backward — per-chunk vjps with the ring inside, and
-    the cross-shard next-token label shift (``_seq_shift_labels``)."""
+    the cross-shard next-token label shift (``_seq_shift_labels``).  On
+    interleaved the same composition runs with v=2 virtual chunks per
+    device (table-driven schedule, interleaved storage order)."""
     import optax
 
     from distributed_llms_example_tpu.data.batching import LABEL_PAD
@@ -1008,9 +1000,15 @@ def test_pipelined_stage_x_sequence_train_step(tiny_llama4, pp_schedule):
     ref_state, ref = step(state, put_batch(batch, mesh1))
 
     mesh_sp = build_mesh(MeshConfig(stage=2, data=2, fsdp=1, sequence=2, tensor=1))
-    piped = PipelinedLlama(cfg, mesh_sp, num_microbatches=2, schedule=pp_schedule)
+    kw = {"virtual_stages": 2} if pp_schedule == "interleaved" else {}
+    piped = PipelinedLlama(cfg, mesh_sp, num_microbatches=2, schedule=pp_schedule, **kw)
+    stacked = stack_blocks(params0)
+    if pp_schedule == "interleaved":
+        from distributed_llms_example_tpu.parallel.interleave import interleave_tree
+
+        stacked["stacked_blocks"] = interleave_tree(stacked["stacked_blocks"], 2, 2)
     rules = pipeline_rules()
-    state_p = create_train_state(shard_params(stack_blocks(params0), mesh_sp, rules), tx)
+    state_p = create_train_state(shard_params(stacked, mesh_sp, rules), tx)
     state_p = jax.tree.map(
         lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, mesh_sp, rules)
     )
@@ -1023,7 +1021,12 @@ def test_pipelined_stage_x_sequence_train_step(tiny_llama4, pp_schedule):
     assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
     assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
     assert float(got["target_tokens"]) == float(ref["target_tokens"])
-    upd = unstack_blocks(jax.device_get(new_state_p.params))
+    upd_tree = jax.device_get(new_state_p.params)
+    if pp_schedule == "interleaved":
+        from distributed_llms_example_tpu.parallel.interleave import uninterleave_tree
+
+        upd_tree["stacked_blocks"] = uninterleave_tree(upd_tree["stacked_blocks"], 2, 2)
+    upd = unstack_blocks(upd_tree)
     ref_upd = jax.device_get(ref_state.params)
     for lyr in ("block_0", f"block_{cfg.num_hidden_layers - 1}"):
         np.testing.assert_allclose(

@@ -266,9 +266,15 @@ class PipelinedBart:
     """
 
     def __init__(self, config: BartConfig, mesh, dtype=jnp.float32,
-                 num_microbatches: int = 0, remat: bool = True):
+                 num_microbatches: int = 0, remat: bool = True,
+                 schedule: str = "gpipe"):
         if mesh.shape.get("sequence", 1) > 1:
             raise ValueError("pipeline (stage>1) does not compose with sequence parallelism")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"seq2seq pipeline schedule {schedule!r}: must be gpipe or 1f1b "
+                "(interleaved virtual stages are decoder-only for now)"
+            )
         stages = mesh.shape.get("stage", 1)
         for n, what in ((config.encoder_layers, "encoder"), (config.decoder_layers, "decoder")):
             if n % max(stages, 1):
@@ -278,6 +284,7 @@ class PipelinedBart:
         self.dtype = dtype
         self.num_microbatches = num_microbatches or max(stages, 1)
         self.remat = remat
+        self.pipeline_schedule = schedule
         cfg = config
         self._shared = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=dtype)
         self._pos = nn.Embed(cfg.max_position_embeddings + cfg.POSITION_OFFSET, cfg.d_model, dtype=dtype)
@@ -295,6 +302,110 @@ class PipelinedBart:
         from distributed_llms_example_tpu.parallel.pipeline import dropout
 
         return dropout(x, key, self.config.dropout_rate)
+
+    def make_value_and_grad(self, label_smoothing: float = 0.0,
+                            is_seq2seq: bool = True):
+        """Twin-pipeline 1F1B training path: ``(params, batch, rng) ->
+        (loss_sum, tokens, grads)`` with the fused schedule owning the
+        backward (``pipeline_value_and_grad_seq2seq``).  Embeddings run
+        outside under GSPMD with their own ``jax.vjp``; the tied LM head +
+        ``final_logits_bias`` + CE run per-microbatch on the last stage's
+        decoder chunk; the shared embedding's gradient sums its input-side
+        and output-side contributions."""
+        from distributed_llms_example_tpu.parallel.activation import activation_mesh
+        from distributed_llms_example_tpu.parallel.pipeline_seq2seq import (
+            pipeline_value_and_grad_seq2seq,
+        )
+        from distributed_llms_example_tpu.train.step import cross_entropy_sums
+
+        assert is_seq2seq
+        cfg = self.config
+
+        def post_loss(pp, y, mb, key):
+            # BART has no tail dropout: logits come straight off the last
+            # decoder layer's final_layer_norm output (``decode``)
+            del key
+            logits = y["dec"] @ pp["shared"]["embedding"].astype(self.dtype).T
+            logits = logits + pp["final_logits_bias"].astype(logits.dtype)
+            return cross_entropy_sums(logits, mb["labels"], label_smoothing)
+
+        def enc_fn(lp, h, ex, key=None):
+            with activation_mesh(None):
+                if key is None:
+                    return self._enc_layer.apply({"params": lp}, h, ex.get("src_bias"), True)
+                return self._enc_layer.apply(
+                    {"params": lp}, h, ex.get("src_bias"), False, rngs={"dropout": key}
+                )
+
+        def dec_fn(lp, h, ex, key=None):
+            # decoder self-attention bias is None in training (causality
+            # lives in the attention impl; padded labels are masked in CE)
+            with activation_mesh(None):
+                if key is None:
+                    return self._dec_layer.apply(
+                        {"params": lp}, h, None, ex["enc"], ex.get("src_bias"), True
+                    )
+                return self._dec_layer.apply(
+                    {"params": lp}, h, None, ex["enc"], ex.get("src_bias"),
+                    False, rngs={"dropout": key},
+                )
+
+        embed_keys = (
+            "shared", "encoder_embed_positions", "decoder_embed_positions",
+            "encoder_layernorm_embedding", "decoder_layernorm_embedding",
+        )
+
+        def value_and_grad_sums(params, batch, rng=None):
+            from distributed_llms_example_tpu.models.t5 import shift_right
+
+            labels = batch["labels"]
+            dec_ids = shift_right(labels, cfg.decoder_start_token_id, cfg.pad_token_id)
+            embed_params = {k: params[k] for k in embed_keys}
+
+            def embed_all(ep):
+                sh = lambda ids: self._shared.apply({"params": ep["shared"]}, ids)  # noqa: E731
+                eh = self._embed(ep, sh(batch["input_ids"]), batch["input_ids"],
+                                 "encoder_embed_positions", "encoder_layernorm_embedding")
+                dh = self._embed(ep, sh(dec_ids), dec_ids,
+                                 "decoder_embed_positions", "decoder_layernorm_embedding")
+                if rng is not None:
+                    eh = self._dropout(eh, jax.random.fold_in(rng, 2))
+                    dh = self._dropout(dh, jax.random.fold_in(rng, 3))
+                return eh, dh
+
+            (enc_h, dec_h), embed_vjp = jax.vjp(embed_all, embed_params)
+            src_bias = (
+                mask_to_bias(batch["attention_mask"])
+                if batch.get("attention_mask") is not None else None
+            )
+            extras = {} if src_bias is None else {"src_bias": src_bias}
+            post_params = {
+                "shared": params["shared"],
+                "final_logits_bias": params["final_logits_bias"],
+            }
+            (lsum, tokens, d_se, d_sd, d_pp, _d_seam, _d_dex, d_eh, d_dh) = (
+                pipeline_value_and_grad_seq2seq(
+                    enc_fn, dec_fn, post_loss,
+                    params["stacked_encoder_blocks"], params["stacked_decoder_blocks"],
+                    post_params, enc_h, dec_h, extras, {"labels": labels},
+                    mesh=self.mesh, num_microbatches=self.num_microbatches,
+                    checkpoint=self.remat,
+                    rng=None if rng is None else jax.random.fold_in(rng, 7),
+                )
+            )
+            (d_embed,) = embed_vjp((d_eh.astype(enc_h.dtype), d_dh.astype(dec_h.dtype)))
+            grads = {
+                **{k: d_embed[k] for k in embed_keys},
+                "stacked_encoder_blocks": d_se,
+                "stacked_decoder_blocks": d_sd,
+                "final_logits_bias": d_pp["final_logits_bias"],
+            }
+            # tied embedding: input-side (both embed lookups) + output-side
+            # (logits projection) gradient contributions add
+            grads["shared"] = jax.tree.map(jnp.add, d_embed["shared"], d_pp["shared"])
+            return lsum, tokens, grads
+
+        return value_and_grad_sums
 
     def apply(self, variables, input_ids, attention_mask=None, decoder_input_ids=None,
               decoder_attention_mask=None, *, deterministic: bool = True, rngs=None):

@@ -506,9 +506,15 @@ class PipelinedT5:
     """
 
     def __init__(self, config: T5Config, mesh, dtype=jnp.float32,
-                 num_microbatches: int = 0, remat: bool = True):
+                 num_microbatches: int = 0, remat: bool = True,
+                 schedule: str = "gpipe"):
         if mesh.shape.get("sequence", 1) > 1:
             raise ValueError("pipeline (stage>1) does not compose with sequence parallelism")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"seq2seq pipeline schedule {schedule!r}: must be gpipe or 1f1b "
+                "(interleaved virtual stages are decoder-only for now)"
+            )
         stages = mesh.shape.get("stage", 1)
         for n, what in ((config.num_layers, "encoder"), (config.decoder_layers, "decoder")):
             if n % max(stages, 1):
@@ -518,6 +524,7 @@ class PipelinedT5:
         self.dtype = dtype
         self.num_microbatches = num_microbatches or max(stages, 1)
         self.remat = remat
+        self.pipeline_schedule = schedule
         cfg = config
         self._shared = nn.Embed(
             cfg.vocab_size, cfg.d_model, embedding_init=nn.initializers.normal(1.0), dtype=dtype
@@ -546,6 +553,151 @@ class PipelinedT5:
         from distributed_llms_example_tpu.parallel.pipeline import dropout
 
         return dropout(x, key, self.config.dropout_rate)
+
+    def make_value_and_grad(self, label_smoothing: float = 0.0,
+                            is_seq2seq: bool = True):
+        """Twin-pipeline 1F1B training path (see ``PipelinedBart`` for the
+        shape).  T5's extra structure maps onto the fused executor's hooks:
+        the encoder's final-norm + dropout become the SEAM transform
+        (applied once per microbatch where the encoder output enters the
+        decoder pipeline, differentiated for the norm scale's gradient);
+        the learned relative-position biases ride ``diff_extras`` — the
+        executor accumulates their cotangents across every (chunk,
+        microbatch) vjp, and the bucket tables get their gradients through
+        an outer ``jax.vjp`` of the bias construction."""
+        from distributed_llms_example_tpu.parallel.activation import activation_mesh
+        from distributed_llms_example_tpu.parallel.pipeline_seq2seq import (
+            pipeline_value_and_grad_seq2seq,
+        )
+        from distributed_llms_example_tpu.train.step import cross_entropy_sums
+
+        assert is_seq2seq
+        cfg = self.config
+
+        def post_loss(pp, y, mb, key):
+            # decoder tail: final_norm + dropout (T5Stack's trailing
+            # dropout) + (tied-scaled) logits projection
+            h = self._norm.apply({"params": pp["final_norm"]}, y["dec"])
+            if key is not None:
+                h = self._dropout(h, jax.random.fold_in(key, 555))
+            if cfg.tie_word_embeddings:
+                h = h * (cfg.d_model**-0.5)
+                logits = h @ pp["shared"]["embedding"].astype(self.dtype).T
+            else:
+                logits = self._head.apply({"params": pp["lm_head"]}, h)
+            return cross_entropy_sums(logits, mb["labels"], label_smoothing)
+
+        def seam(sp, h, key):
+            # encoder tail between the pipelines: final_norm + dropout
+            h = self._norm.apply({"params": sp["final_norm"]}, h)
+            if key is not None:
+                h = self._dropout(h, key)
+            return h
+
+        def enc_fn(lp, h, ex, key=None):
+            with activation_mesh(None):
+                if key is None:
+                    return self._enc_block.apply(
+                        {"params": lp}, h, ex.get("src_bias"), None, None,
+                        True, False, ex.get("enc_pos"),
+                    )
+                return self._enc_block.apply(
+                    {"params": lp}, h, ex.get("src_bias"), None, None,
+                    False, False, ex.get("enc_pos"), rngs={"dropout": key},
+                )
+
+        def dec_fn(lp, h, ex, key=None):
+            with activation_mesh(None):
+                if key is None:
+                    return self._dec_block.apply(
+                        {"params": lp}, h, None, ex["enc"], ex.get("src_bias"),
+                        True, False, ex.get("dec_pos"),
+                    )
+                return self._dec_block.apply(
+                    {"params": lp}, h, None, ex["enc"], ex.get("src_bias"),
+                    False, False, ex.get("dec_pos"), rngs={"dropout": key},
+                )
+
+        def value_and_grad_sums(params, batch, rng=None):
+            labels = batch["labels"]
+            dec_ids = shift_right(labels, cfg.decoder_start_token_id, cfg.pad_token_id)
+
+            def embed_all(shared_p):
+                eh = constrain_hidden(
+                    self._shared.apply({"params": shared_p}, batch["input_ids"])
+                )
+                dh = constrain_hidden(self._shared.apply({"params": shared_p}, dec_ids))
+                # T5Stack applies dropout to the embedded input of each stack
+                if rng is not None:
+                    eh = self._dropout(eh, jax.random.fold_in(rng, 201))
+                    dh = self._dropout(dh, jax.random.fold_in(rng, 202))
+                return eh, dh
+
+            (enc_h, dec_h), embed_vjp = jax.vjp(embed_all, params["shared"])
+
+            def pos_biases(tables):
+                et, dt = tables
+                return (
+                    self._position_bias(et, batch["input_ids"].shape[1], causal=False),
+                    self._position_bias(dt, dec_ids.shape[1], causal=True),
+                )
+
+            (enc_pos, dec_pos), pos_vjp = jax.vjp(
+                pos_biases,
+                (
+                    params["encoder"]["relative_attention_bias"]["embedding"],
+                    params["decoder"]["relative_attention_bias"]["embedding"],
+                ),
+            )
+            src_bias = (
+                mask_to_bias(batch["attention_mask"])
+                if batch.get("attention_mask") is not None else None
+            )
+            extras = {} if src_bias is None else {"src_bias": src_bias}
+            post_params = {"final_norm": params["decoder"]["final_norm"]}
+            if cfg.tie_word_embeddings:
+                post_params["shared"] = params["shared"]
+            else:
+                post_params["lm_head"] = params["lm_head"]
+            seam_params = {"final_norm": params["encoder"]["final_norm"]}
+            (lsum, tokens, d_se, d_sd, d_pp, d_seam, d_dex, d_eh, d_dh) = (
+                pipeline_value_and_grad_seq2seq(
+                    enc_fn, dec_fn, post_loss,
+                    params["encoder"]["stacked_blocks"],
+                    params["decoder"]["stacked_blocks"],
+                    post_params, enc_h, dec_h, extras, {"labels": labels},
+                    mesh=self.mesh, num_microbatches=self.num_microbatches,
+                    seam_fn=seam, seam_params=seam_params,
+                    diff_extras={"enc_pos": enc_pos, "dec_pos": dec_pos},
+                    checkpoint=self.remat,
+                    rng=None if rng is None else jax.random.fold_in(rng, 7),
+                )
+            )
+            (d_embed,) = embed_vjp((d_eh.astype(enc_h.dtype), d_dh.astype(dec_h.dtype)))
+            ((d_enc_table, d_dec_table),) = pos_vjp(
+                (d_dex["enc_pos"].astype(enc_pos.dtype), d_dex["dec_pos"].astype(dec_pos.dtype))
+            )
+            d_shared = d_embed
+            if cfg.tie_word_embeddings:
+                d_shared = jax.tree.map(jnp.add, d_shared, d_pp["shared"])
+            grads = {
+                "shared": d_shared,
+                "encoder": {
+                    "stacked_blocks": d_se,
+                    "final_norm": d_seam["final_norm"],
+                    "relative_attention_bias": {"embedding": d_enc_table},
+                },
+                "decoder": {
+                    "stacked_blocks": d_sd,
+                    "final_norm": d_pp["final_norm"],
+                    "relative_attention_bias": {"embedding": d_dec_table},
+                },
+            }
+            if not cfg.tie_word_embeddings:
+                grads["lm_head"] = d_pp["lm_head"]
+            return lsum, tokens, grads
+
+        return value_and_grad_sums
 
     def _run_stack(self, stack_params, block, hidden, self_bias, pos_bias, extras,
                    rng=None):

@@ -661,7 +661,9 @@ def _pvg_loss_vjp(loss_f, pp, y, do_loss):
     stage's real loss ticks need — for large-vocab models that fixed cost
     rivals a layer chunk's.  Returns ``(ls_m, tk_m, d_pp_m, dy_loss)``;
     the skip branch returns zeros of the same shapes/dtypes (vma types
-    derived from the varying operands, so ``check_vma`` stays happy)."""
+    derived from the varying operands, so ``check_vma`` stays happy).
+    ``y`` may be any pytree (a single activation array here; the twin
+    seq2seq executor carries an {enc, dec} pair through the same gate)."""
 
     def with_loss(ops):
         pp_, y_ = ops
@@ -674,11 +676,11 @@ def _pvg_loss_vjp(loss_f, pp, y, do_loss):
     def skip_loss(ops):
         pp_, y_ = ops
         out_sh = jax.eval_shape(loss_f, pp_, y_)
-        zscal = y_.ravel()[0] * 0
+        zscal = jax.tree.leaves(y_)[0].ravel()[0] * 0
         ls_m = zscal.astype(out_sh[0].dtype)
         tk_m = zscal.astype(out_sh[1].dtype)
         d_pp_m = jax.tree.map(lambda p: p * 0, pp_)
-        dy_loss = y_ * 0
+        dy_loss = jax.tree.map(lambda a: a * 0, y_)
         return ls_m, tk_m, d_pp_m, dy_loss
 
     return jax.lax.cond(do_loss, with_loss, skip_loss, (pp, y))

@@ -154,12 +154,12 @@ class Trainer:
                 remat=cfg.remat,
             )
             if cfg.pipeline_schedule in ("1f1b", "interleaved"):
-                if self.loaded.family != "llama":
+                if cfg.pipeline_schedule == "interleaved" and self.loaded.family != "llama":
                     raise ValueError(
-                        f"--pipeline-schedule {cfg.pipeline_schedule} currently "
-                        "supports decoder-only (llama) families, not "
-                        f"{self.loaded.family!r}; the seq2seq adapters' twin "
-                        "encoder/decoder pipelines use gpipe"
+                        "--pipeline-schedule interleaved currently supports "
+                        f"decoder-only (llama) families, not {self.loaded.family!r}; "
+                        "the seq2seq families pipeline under gpipe or the fused "
+                        "twin-pipeline 1f1b"
                     )
                 adapter_kw["schedule"] = cfg.pipeline_schedule
                 if cfg.pipeline_schedule == "interleaved":

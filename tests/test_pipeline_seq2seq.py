@@ -1,0 +1,297 @@
+"""Twin-pipeline fused 1F1B for the seq2seq families (BART/T5).
+
+The fused schedule is a SCHEDULE-only change: interleaving encoder and
+decoder chunk forwards/backwards across the stage ring must reproduce the
+single-device loss, token counts, grad norm, and per-layer parameter
+updates exactly (same math, different order).  These tests pin the
+``pipeline_value_and_grad_seq2seq`` executor + both family adapters
+against the plain flax modules — the same contract the LLaMA 1F1B tests
+enforce (tests/test_pipeline.py::test_1f1b_*).
+"""
+
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_llms_example_tpu.core.config import MeshConfig
+from distributed_llms_example_tpu.core.mesh import build_mesh
+from distributed_llms_example_tpu.data.batching import LABEL_PAD
+from distributed_llms_example_tpu.parallel.pipeline import stack_for_family, unstack_for_family
+from distributed_llms_example_tpu.parallel.sharding import pipeline_rules, shard_params
+from distributed_llms_example_tpu.train.step import (
+    create_train_state,
+    make_train_step,
+    put_batch,
+    state_shardings,
+)
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def _tiny_bart(layers=4, dropout=0.0):
+    import jax.numpy as jnp
+
+    from distributed_llms_example_tpu.models.bart import BartConfig, BartForConditionalGeneration
+
+    cfg = BartConfig(
+        vocab_size=96, d_model=32, encoder_layers=layers, decoder_layers=layers,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, max_position_embeddings=64,
+        dropout_rate=dropout,
+    )
+    module = BartForConditionalGeneration(cfg)
+    params = jax.device_get(
+        module.init(
+            jax.random.PRNGKey(0),
+            jnp.ones((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+            jnp.ones((1, 4), jnp.int32),
+        )["params"]
+    )
+    return cfg, module, params
+
+
+def _tiny_t5(layers=4, dropout=0.0, tied=True):
+    import jax.numpy as jnp
+
+    from distributed_llms_example_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+    cfg = T5Config(
+        vocab_size=96, d_model=32, d_kv=8, d_ff=64, num_layers=layers,
+        num_heads=4, dropout_rate=dropout, tie_word_embeddings=tied,
+        feed_forward_proj="relu" if tied else "gated-gelu",
+    )
+    module = T5ForConditionalGeneration(cfg)
+    params = jax.device_get(
+        module.init(
+            jax.random.PRNGKey(0),
+            jnp.ones((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+            jnp.ones((1, 4), jnp.int32),
+        )["params"]
+    )
+    return cfg, module, params
+
+
+def _seq2seq_batch(vocab, b=16, src=16, tgt=8, seed=3):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(2, vocab, (b, src)).astype(np.int32)
+    mask = np.ones((b, src), np.int32)
+    mask[: b // 4, -5:] = 0
+    labels = rng.randint(2, vocab, (b, tgt)).astype(np.int32)
+    labels[: b // 2, -3:] = LABEL_PAD
+    return {"input_ids": ids, "attention_mask": mask, "labels": labels}
+
+
+def _run_ref(module, cfg, params0, batch, tx, schedule):
+    mesh1 = build_mesh(
+        MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1]
+    )
+    build = make_train_step(module, cfg, tx, schedule, mesh1, donate=False, is_seq2seq=True)
+    state = create_train_state(shard_params(params0, mesh1), tx)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh1))
+    step, _ = build(state)
+    return step(state, put_batch(batch, mesh1))
+
+
+def _run_fused(Adapter, family, cfg, params0, batch, tx, schedule, mesh_cfg, micro):
+    mesh_p = build_mesh(mesh_cfg)
+    piped = Adapter(cfg, mesh_p, num_microbatches=micro, schedule="1f1b")
+    assert piped.pipeline_schedule == "1f1b"
+    rules = pipeline_rules()
+    stacked = stack_for_family(family, params0)
+    state_p = create_train_state(shard_params(stacked, mesh_p, rules), tx)
+    state_p = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state_p, state_shardings(state_p, mesh_p, rules)
+    )
+    build_p = make_train_step(
+        piped, cfg, tx, schedule, mesh_p, rules=rules, donate=False, is_seq2seq=True
+    )
+    step_p, _ = build_p(state_p)
+    return step_p(state_p, put_batch(batch, mesh_p))
+
+
+@pytest.mark.parametrize("stages,micro", [(2, 4), (4, 2)])
+def test_bart_1f1b_equals_single_device(stages, micro):
+    cfg, module, params0 = _tiny_bart()
+    from distributed_llms_example_tpu.models.bart import PipelinedBart
+
+    batch = _seq2seq_batch(cfg.vocab_size)
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    ref_state, ref = _run_ref(module, cfg, params0, batch, tx, schedule)
+    new_state_p, got = _run_fused(
+        PipelinedBart, "bart", cfg, params0, batch, tx, schedule,
+        MeshConfig(stage=stages, data=8 // stages, fsdp=1, sequence=1, tensor=1), micro,
+    )
+
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["target_tokens"]) == float(ref["target_tokens"])
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+    # updated params match layer-for-layer after unstacking — first/last of
+    # BOTH stacks, plus every out-of-pipeline group (embeds, tied head)
+    upd = unstack_for_family("bart", jax.device_get(new_state_p.params))
+    ref_upd = jax.device_get(ref_state.params)
+    for lyr in (
+        "encoder_block_0", f"encoder_block_{cfg.encoder_layers - 1}",
+        "decoder_block_0", f"decoder_block_{cfg.decoder_layers - 1}",
+        "shared", "encoder_embed_positions", "decoder_embed_positions",
+        "encoder_layernorm_embedding", "final_logits_bias",
+    ):
+        got_l, ref_l = jax.tree.leaves(upd[lyr]), jax.tree.leaves(ref_upd[lyr])
+        for g, r in zip(got_l, ref_l):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("tied", [True, False])
+def test_t5_1f1b_equals_single_device(tied):
+    """T5 exercises the executor's seam (encoder final-norm between the
+    pipelines) and diff_extras (learned relative-position bias tables) —
+    both must receive exact gradients."""
+    cfg, module, params0 = _tiny_t5(tied=tied)
+    from distributed_llms_example_tpu.models.t5 import PipelinedT5
+
+    batch = _seq2seq_batch(cfg.vocab_size, seed=11)
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    ref_state, ref = _run_ref(module, cfg, params0, batch, tx, schedule)
+    new_state_p, got = _run_fused(
+        PipelinedT5, "t5", cfg, params0, batch, tx, schedule,
+        MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1), 4,
+    )
+
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["target_tokens"]) == float(ref["target_tokens"])
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+    upd = unstack_for_family("t5", jax.device_get(new_state_p.params))
+    ref_upd = jax.device_get(ref_state.params)
+
+    def check(path_got, path_ref):
+        for g, r in zip(jax.tree.leaves(path_got), jax.tree.leaves(path_ref)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-5, rtol=1e-4)
+
+    for stack in ("encoder", "decoder"):
+        check(upd[stack]["block_0"], ref_upd[stack]["block_0"])
+        check(upd[stack][f"block_{cfg.num_layers - 1}"], ref_upd[stack][f"block_{cfg.num_layers - 1}"])
+        # the seam norm (encoder) / tail norm (decoder) and the learned
+        # relative-position bias tables
+        check(upd[stack]["final_norm"], ref_upd[stack]["final_norm"])
+        check(upd[stack]["relative_attention_bias"], ref_upd[stack]["relative_attention_bias"])
+    check(upd["shared"], ref_upd["shared"])
+    if not tied:
+        check(upd["lm_head"], ref_upd["lm_head"])
+
+
+def test_bart_1f1b_composes_with_tensor_parallel():
+    cfg, module, params0 = _tiny_bart()
+    from distributed_llms_example_tpu.models.bart import PipelinedBart
+
+    batch = _seq2seq_batch(cfg.vocab_size, seed=17)
+    tx = optax.sgd(1e-2)
+    schedule = lambda s: 1e-2  # noqa: E731
+
+    _, ref = _run_ref(module, cfg, params0, batch, tx, schedule)
+    _, got = _run_fused(
+        PipelinedBart, "bart", cfg, params0, batch, tx, schedule,
+        MeshConfig(stage=2, data=2, fsdp=1, sequence=1, tensor=2), 2,
+    )
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+
+
+def test_bart_1f1b_dropout_runs_and_is_key_deterministic():
+    """With dropout live the fused path can't match gpipe key-for-key
+    (different fold layout) — but it must run, produce finite metrics, and
+    be a deterministic function of the rng key."""
+    cfg, module, params0 = _tiny_bart(dropout=0.1)
+    from distributed_llms_example_tpu.models.bart import PipelinedBart
+
+    mesh_p = build_mesh(MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1))
+    piped = PipelinedBart(cfg, mesh_p, num_microbatches=2, schedule="1f1b")
+    batch = _seq2seq_batch(cfg.vocab_size, seed=23)
+    tx = optax.sgd(1e-2)
+    rules = pipeline_rules()
+    stacked = stack_for_family("bart", params0)
+    state = create_train_state(shard_params(stacked, mesh_p, rules), tx)
+    state = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state, state_shardings(state, mesh_p, rules)
+    )
+    build = make_train_step(
+        piped, cfg, tx, lambda s: 1e-2, mesh_p, rules=rules, donate=False,
+        is_seq2seq=True, with_dropout=True,
+    )
+    step, _ = build(state)
+    key = jax.random.PRNGKey(5)
+    _, m1 = step(state, put_batch(batch, mesh_p), key)
+    _, m2 = step(state, put_batch(batch, mesh_p), key)
+    _, m3 = step(state, put_batch(batch, mesh_p), jax.random.PRNGKey(6))
+    assert np.isfinite(float(m1["loss"]))
+    assert float(m1["loss"]) == float(m2["loss"])
+    assert float(m1["loss"]) != float(m3["loss"])
+
+
+def test_trainer_bart_1f1b_end_to_end(tmp_path):
+    """Trainer with --pipeline-schedule 1f1b on a BART config: trains,
+    reports the stage-sharded val loss, exports the per-layer HF layout."""
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    rng = np.random.RandomState(7)
+    records = [
+        {
+            "dialogue": " ".join(f"w{rng.randint(50)}" for _ in range(rng.randint(5, 20))),
+            "summary": "w1 w2",
+        }
+        for _ in range(16)
+    ]
+    cfg = TrainConfig(
+        model_ckpt="bart-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        warmup_steps=0,
+        learning_rate=1e-3,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        log_every_steps=1,
+        mesh=MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        tokenizer="byte",
+        pipeline_microbatches=2,
+        pipeline_schedule="1f1b",
+    )
+    trainer = Trainer(cfg, train_records=records, val_records=records[:4])
+    assert trainer.pipelined
+    assert trainer.model.pipeline_schedule == "1f1b"
+    result = trainer.train()
+    assert result["steps"] == trainer.total_steps
+    assert np.isfinite(result["final_eval"]["val_loss"])
+    from distributed_llms_example_tpu.models.registry import load_model
+
+    reloaded = load_model(os.path.join(str(tmp_path), "model"))
+    assert "encoder_block_0" in reloaded.params
+
+
+def test_interleaved_still_rejected_for_seq2seq(tmp_path):
+    from distributed_llms_example_tpu.core.config import CheckpointConfig, TrainConfig
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    records = [{"dialogue": "a b c", "summary": "a"} for _ in range(8)]
+    cfg = TrainConfig(
+        model_ckpt="bart-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        max_source_length=32,
+        max_target_length=16,
+        pad_to_multiple=16,
+        mesh=MeshConfig(stage=2, data=4, fsdp=1, sequence=1, tensor=1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        tokenizer="byte",
+    )
+    with pytest.raises(ValueError, match="interleaved"):
+        Trainer(cfg.replace(pipeline_schedule="interleaved"), train_records=records)

@@ -137,6 +137,7 @@ class BatchIterator:
             # caller's thread.
             maxima = np.zeros((len(batches), 2), np.int32)
             for s, global_idx in enumerate(batches):
+                self.ds.ensure_encoded(global_idx[self._slice])
                 ex = [self.ds[int(i)] for i in global_idx[self._slice]]
                 maxima[s, 0] = max(len(e.input_ids) for e in ex)
                 maxima[s, 1] = max(len(e.labels) for e in ex)
@@ -152,14 +153,20 @@ class BatchIterator:
         # them sequentially) has no peers to gather from: scan the global
         # index list per batch — same widths, test-only cost.
         rows = slice(None) if self.process_count > 1 else self._slice
-        maxima_lazy = (
-            (
-                max(len(self.ds[int(i)].input_ids) for i in global_idx[rows]),
-                max(len(self.ds[int(i)].labels) for i in global_idx[rows]),
-            )
-            for global_idx in batches
-        )
-        return self._iter_batches(batches, maxima_lazy)
+
+        def maxima_lazy():
+            for global_idx in batches:
+                # batch-fill the cache BEFORE the per-example length scan:
+                # one Rust-parallel tokenizer call per batch instead of a
+                # Python loop of singles (the pod-host feed-rate fix,
+                # bench.py host-input)
+                self.ds.ensure_encoded(global_idx[rows])
+                yield (
+                    max(len(self.ds[int(i)].input_ids) for i in global_idx[rows]),
+                    max(len(self.ds[int(i)].labels) for i in global_idx[rows]),
+                )
+
+        return self._iter_batches(batches, maxima_lazy())
 
     def _iter_batches(
         self, batches: list[np.ndarray], maxima: Iterator[tuple[int, int]]

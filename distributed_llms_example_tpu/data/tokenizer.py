@@ -14,6 +14,7 @@ zero-egress environments, so tokenization is pluggable:
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 from typing import Protocol, Sequence
 
 
@@ -51,6 +52,17 @@ class Tokenizer(Protocol):
 
     def decode(self, ids: Sequence[int]) -> str: ...
 
+    def encode_source_batch(self, texts: Sequence[str], max_length: int) -> list[list[int]]:
+        """Batch form of ``encode_source`` — id-identical, but tokenizers
+        with a parallel batch path (HF fast tokenizers: Rust + rayon
+        across all cores) encode the whole list at once.  One prefetch
+        thread tokenizing example-by-example caps out near 200k tok/s —
+        well short of the ~480k tok/s a v5e-8 host must assemble — so the
+        datasets fill their caches through this entry point per batch."""
+        ...
+
+    def encode_target_batch(self, texts: Sequence[str], max_length: int) -> list[list[int]]: ...
+
 
 class ByteTokenizer:
     """UTF-8 bytes + {pad=0, eos=1}; ids are byte+2.  Its "family layout"
@@ -76,12 +88,40 @@ class ByteTokenizer:
     def encode_prompt(self, text: str, max_length: int) -> list[int]:
         return self.encode(text)[:max_length]
 
+    def encode_source_batch(self, texts: Sequence[str], max_length: int) -> list[list[int]]:
+        # byte encoding is memory-bandwidth work; a plain loop already
+        # clears the pod-host feed rate with >10x margin (bench.py host-input)
+        return [self.encode_source(t, max_length) for t in texts]
+
+    encode_target_batch = encode_source_batch
+
     def decode(self, ids: Sequence[int]) -> str:
         # ids outside [OFFSET, OFFSET+256) are skipped, not an error: models
         # may have a larger vocab than the tokenizer (padded/rounded vocab
         # sizes), and randomly-initialized models emit arbitrary ids
         data = bytes(i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256)
         return data.decode("utf-8", errors="replace")
+
+
+@_contextlib.contextmanager
+def _rust_parallelism():
+    """Enable the Rust tokenizer's rayon parallelism for the duration of
+    ONE batch call.  Setting TOKENIZERS_PARALLELISM=true process-wide
+    would also disable the library's fork-detected auto-shutoff — a
+    forked child (e.g. an embedder's fork-based multiprocessing) could
+    then deadlock on the poisoned rayon pool.  Scoping the variable to
+    the call keeps the batch path parallel AND the safety net intact; an
+    explicit user setting (either value) always wins."""
+    import os
+
+    if os.environ.get("TOKENIZERS_PARALLELISM") is not None:
+        yield
+        return
+    os.environ["TOKENIZERS_PARALLELISM"] = "true"
+    try:
+        yield
+    finally:
+        os.environ.pop("TOKENIZERS_PARALLELISM", None)
 
 
 class HFTokenizer:
@@ -134,6 +174,18 @@ class HFTokenizer:
         if not self._has_eos:
             return ids[:max_length]
         return ids[: max_length - 1] + [self.eos_id]
+
+    def encode_source_batch(self, texts: Sequence[str], max_length: int) -> list[list[int]]:
+        # one call into the Rust tokenizer: rayon fans the batch across
+        # cores and the ids are exactly the per-text encode_source ids
+        with _rust_parallelism():
+            return self._tok(list(texts), max_length=max_length, truncation=True)["input_ids"]
+
+    def encode_target_batch(self, texts: Sequence[str], max_length: int) -> list[list[int]]:
+        with _rust_parallelism():
+            return self._tok(
+                text_target=list(texts), max_length=max_length, truncation=True
+            )["input_ids"]
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode([i for i in ids], skip_special_tokens=True)

@@ -46,6 +46,9 @@ def make_greedy_generate(model: Any, config: Any, max_new_tokens: int) -> Callab
     def generate(params: Any, input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
         B = input_ids.shape[0]
         enc = model.apply({"params": params}, input_ids, attention_mask, method="encode")
+        # cross-attention K/V projected ONCE: per-step re-projection of the
+        # full encoder output (2·S·d² per layer) would dominate decode
+        ckv = model.apply({"params": params}, enc, method="cross_kv")
         cache = _init_cache(model, params, B, L, enc, attention_mask)
 
         def step(t, carry):
@@ -58,6 +61,7 @@ def make_greedy_generate(model: Any, config: Any, max_new_tokens: int) -> Callab
                 use_cache=True,
                 cache_offset=t,
                 max_kv_len=L,
+                cross_kv=ckv,
                 method="decode",
                 mutable=["cache"],
             )
@@ -341,6 +345,11 @@ def make_beam_search(
         # replicate encoder outputs per beam: (B*K, S, D)
         enc_rep = jnp.repeat(enc, K, axis=0)
         mask_rep = jnp.repeat(attention_mask, K, axis=0)
+        # cross-attention K/V projected ONCE at batch B then replicated per
+        # beam; beams of a row share the encoder output, so the per-step
+        # beam reorder never touches this tree
+        ckv = model.apply({"params": params}, enc, method="cross_kv")
+        ckv = jax.tree.map(lambda x: jnp.repeat(x, K, axis=0), ckv)
         cache = _init_cache(model, params, B * K, L, enc_rep, mask_rep)
 
         state = _beam_init(B, K, L, pad)
@@ -356,6 +365,7 @@ def make_beam_search(
                 use_cache=True,
                 cache_offset=t,
                 max_kv_len=L,
+                cross_kv=ckv,
                 method="decode",
                 mutable=["cache"],
             )

@@ -133,12 +133,15 @@ class BartDecoderLayer(nn.Module):
         cross_bias,
         deterministic: bool = True,
         use_cache: bool = False,
+        cross_kv=None,
     ):
         residual = hidden
         h = self.self_attn(hidden, bias=self_bias, use_cache=use_cache)
         hidden = self.self_attn_layer_norm(residual + self.dropout(h, deterministic=deterministic))
         residual = hidden
-        h = self.cross_attn(hidden, kv_hidden=encoder_hidden, bias=cross_bias)
+        h = self.cross_attn(
+            hidden, kv_hidden=encoder_hidden, bias=cross_bias, cross_kv=cross_kv
+        )
         hidden = self.cross_attn_layer_norm(residual + self.dropout(h, deterministic=deterministic))
         residual = hidden
         h = self.mlp(hidden, deterministic=deterministic)
@@ -193,6 +196,16 @@ class BartForConditionalGeneration(nn.Module):
             hidden = constrain_hidden(blk(hidden, bias, deterministic))
         return hidden
 
+    def cross_kv(self, encoder_hidden):
+        """Per-decoder-layer cross-attention K/V, projected ONCE from the
+        encoder output.  The decode loop's per-step cross projections
+        (2·S·d_model² FLOPs per layer) dwarf everything else it does at
+        summarization shapes; generation precomputes this tuple after
+        ``encode`` and threads it through every decode step."""
+        return tuple(
+            blk.cross_attn.project_kv(encoder_hidden) for blk in self.decoder_blocks
+        )
+
     def decode(
         self,
         decoder_input_ids,
@@ -204,6 +217,7 @@ class BartForConditionalGeneration(nn.Module):
         use_cache: bool = False,
         cache_offset: int | jnp.ndarray = 0,
         max_kv_len: int | None = None,
+        cross_kv=None,
     ):
         cfg = self.config
         q_len = decoder_input_ids.shape[1]
@@ -222,8 +236,11 @@ class BartForConditionalGeneration(nn.Module):
             )
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
         hidden = constrain_hidden(hidden)
-        for blk in self.decoder_blocks:
-            hidden = constrain_hidden(blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache))
+        for i, blk in enumerate(self.decoder_blocks):
+            hidden = constrain_hidden(blk(
+                hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache,
+                cross_kv=None if cross_kv is None else cross_kv[i],
+            ))
         logits = constrain_logits(hidden @ self.shared.embedding.astype(self.dtype).T)
         return logits + self.final_logits_bias.astype(logits.dtype)
 

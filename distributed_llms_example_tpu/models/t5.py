@@ -122,6 +122,11 @@ class T5Attention(nn.Module):
         b, s, _ = x.shape
         return x.reshape(b, s, self.config.num_heads, self.config.d_kv).transpose(0, 2, 1, 3)
 
+    def project_kv(self, kv_hidden: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """K/V projections alone — precomputed once per sequence for
+        cross-attention decode (see MultiHeadAttention.project_kv)."""
+        return self._split(self.k_proj(kv_hidden)), self._split(self.v_proj(kv_hidden))
+
     def _merge(self, x: jnp.ndarray) -> jnp.ndarray:
         b, h, s, d = x.shape
         return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
@@ -156,17 +161,22 @@ class T5Attention(nn.Module):
         *,
         use_cache: bool = False,
         learned_bias: jnp.ndarray | None = None,
+        cross_kv: tuple[jnp.ndarray, jnp.ndarray] | None = None,
     ) -> jnp.ndarray:
         """``bias``: constant (mask-like) additive bias.  ``learned_bias``:
         the (1, H, Q, K) relative-position bias, kept SEPARATE so the flash
         kernel can treat the mask as constant while computing the learned
         bias's gradient in its dbias kernel.  When the caller pre-combines
         everything into ``bias`` (cache decode, the pipeline adapter), the
-        XLA path reproduces round-2 behavior exactly."""
-        kv_src = hidden if kv_hidden is None else kv_hidden
+        XLA path reproduces round-2 behavior exactly.  ``cross_kv``:
+        precomputed ``project_kv`` output — skips the k/v projections."""
         q = self._split(self.q_proj(hidden))
-        k = self._split(self.k_proj(kv_src))
-        v = self._split(self.v_proj(kv_src))
+        if cross_kv is not None:
+            k, v = cross_kv
+        else:
+            kv_src = hidden if kv_hidden is None else kv_hidden
+            k = self._split(self.k_proj(kv_src))
+            v = self._split(self.v_proj(kv_src))
         causal_in_bias = False
         if use_cache and self.causal:
             k, v, idx = self._cache_kv(k, v)
@@ -294,6 +304,7 @@ class T5Block(nn.Module):
         deterministic: bool = True,
         use_cache: bool = False,
         pos_bias: jnp.ndarray | None = None,
+        cross_kv=None,
     ) -> jnp.ndarray:
         # deterministic/use_cache are positional so nn.remat can mark them
         # static (argnums 5, 6 counting self at 0); pos_bias is the learned
@@ -305,7 +316,10 @@ class T5Block(nn.Module):
         )
         hidden = hidden + self.dropout(h, deterministic=deterministic)
         if self.has_cross:
-            h = self.cross_attn(self.cross_attn_norm(hidden), kv_hidden=encoder_hidden, bias=cross_bias)
+            h = self.cross_attn(
+                self.cross_attn_norm(hidden), kv_hidden=encoder_hidden,
+                bias=cross_bias, cross_kv=cross_kv,
+            )
             hidden = hidden + self.dropout(h, deterministic=deterministic)
         h = self.mlp(self.mlp_norm(hidden), deterministic=deterministic)
         return hidden + self.dropout(h, deterministic=deterministic)
@@ -362,6 +376,7 @@ class T5Stack(nn.Module):
         use_cache: bool = False,
         cache_offset: int | jnp.ndarray = 0,
         max_kv_len: int | None = None,
+        cross_kv=None,
     ) -> jnp.ndarray:
         q_len = hidden.shape[1]
         pos_bias = None
@@ -383,11 +398,12 @@ class T5Stack(nn.Module):
             self_bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         cross_bias = mask_to_bias(encoder_mask) if encoder_mask is not None else None
         hidden = self.dropout(hidden, deterministic=deterministic)
-        for blk in self.blocks:
+        for i, blk in enumerate(self.blocks):
             # re-anchor the residual stream every layer so GSPMD never
             # propagates a param sharding (d_model over fsdp/tensor) into it
             hidden = constrain_hidden(
-                blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache, pos_bias)
+                blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache, pos_bias,
+                    cross_kv=None if cross_kv is None else cross_kv[i])
             )
         return self.dropout(self.final_norm(hidden), deterministic=deterministic)
 
@@ -432,6 +448,13 @@ class T5ForConditionalGeneration(nn.Module):
             return constrain_logits(hidden @ self.shared.embedding.astype(self.dtype).T)
         return constrain_logits(self.lm_head(hidden))
 
+    def cross_kv(self, encoder_hidden: jnp.ndarray):
+        """Per-decoder-layer cross-attention K/V, projected ONCE from the
+        encoder output (see BartForConditionalGeneration.cross_kv)."""
+        return tuple(
+            blk.cross_attn.project_kv(encoder_hidden) for blk in self.decoder.blocks
+        )
+
     def decode(
         self,
         decoder_input_ids: jnp.ndarray,
@@ -443,6 +466,7 @@ class T5ForConditionalGeneration(nn.Module):
         use_cache: bool = False,
         cache_offset: int | jnp.ndarray = 0,
         max_kv_len: int | None = None,
+        cross_kv=None,
     ) -> jnp.ndarray:
         hidden = constrain_hidden(self.shared(decoder_input_ids))
         if use_cache:
@@ -454,6 +478,7 @@ class T5ForConditionalGeneration(nn.Module):
                 use_cache=True,
                 cache_offset=cache_offset,
                 max_kv_len=max_kv_len,
+                cross_kv=cross_kv,
             )
         else:
             hidden = self.decoder(

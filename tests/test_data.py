@@ -139,3 +139,62 @@ def test_host_batch_slices():
     assert host_batch_slices(16, 4, 1) == slice(4, 8)
     with pytest.raises(ValueError, match="not divisible"):
         host_batch_slices(10, 4, 0)
+
+
+def test_batch_encode_matches_per_example():
+    """ensure_encoded (the pod-host feed-rate path: one Rust-parallel
+    tokenizer call per batch) must produce byte-identical ids to the lazy
+    per-example __getitem__ path, for both tokenizer kinds."""
+    from distributed_llms_example_tpu.data.dataset import SummarizationDataset
+    from distributed_llms_example_tpu.data.tokenizer import ByteTokenizer
+
+    records = [
+        {"dialogue": f"hello world {i} " * (i + 1), "summary": f"sum {i}"}
+        for i in range(9)
+    ]
+    tok = ByteTokenizer()
+    a = SummarizationDataset(records, tok, max_source_length=32, max_target_length=8)
+    b = SummarizationDataset(records, tok, max_source_length=32, max_target_length=8)
+    b.ensure_encoded(range(len(records)))
+    for i in range(len(records)):
+        assert a[i].input_ids == b[i].input_ids
+        assert a[i].labels == b[i].labels
+
+
+def test_batch_encode_matches_per_example_hf(tmp_path):
+    """Same contract through a real transformers fast tokenizer (the
+    construction tests/test_tokenizer_parity.py uses)."""
+    pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer as TK, models, pre_tokenizers, processors
+    from tokenizers.trainers import BpeTrainer
+    from transformers import PreTrainedTokenizerFast
+
+    from distributed_llms_example_tpu.data.dataset import SummarizationDataset
+    from distributed_llms_example_tpu.data.tokenizer import HFTokenizer
+
+    records = [
+        {"dialogue": "the quick brown fox " * (i + 1), "summary": "a fox"}
+        for i in range(7)
+    ]
+    tok = TK(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    trainer = BpeTrainer(special_tokens=["<s>", "<pad>", "</s>", "<unk>"], vocab_size=300)
+    tok.train_from_iterator([r["dialogue"] for r in records], trainer)
+    bos, eos = tok.token_to_id("<s>"), tok.token_to_id("</s>")
+    tok.post_processor = processors.TemplateProcessing(
+        single="<s> $A </s>", pair="<s> $A </s> $B </s>",
+        special_tokens=[("<s>", bos), ("</s>", eos)],
+    )
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok, bos_token="<s>", eos_token="</s>",
+        pad_token="<pad>", unk_token="<unk>",
+    )
+    d = str(tmp_path / "tok")
+    fast.save_pretrained(d)
+    hf = HFTokenizer(d)
+    a = SummarizationDataset(records, hf, max_source_length=16, max_target_length=8)
+    b = SummarizationDataset(records, hf, max_source_length=16, max_target_length=8)
+    b.ensure_encoded(range(len(records)))
+    for i in range(len(records)):
+        assert a[i].input_ids == b[i].input_ids
+        assert a[i].labels == b[i].labels

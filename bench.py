@@ -412,17 +412,17 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
             # examples, and a warm cache would exclude tokenization from
             # the timed window entirely — the prefetch 2-vs-0 gap is
             # precisely "does the background thread hide tokenize+pad"
-            trainer.train_ds._cache = [None] * len(trainer.train_ds)
+            trainer.train_ds.clear_cache()
             dt = timed_pass()
             out[f"tokens_per_sec_chip_prefetch{prefetch}"] = round(tokens / dt / n_chips, 1)
         if trainer.use_dropout and os.environ.get("BENCH_TRAINER_RBG", "1") != "0" and rbg_ok():
             # the --prng-impl rbg trainer path: hardware-RNG dropout masks.
-            # Swap the key impl and warm once (the step retraces for the
-            # typed-key argument) before timing.
+            # Swap the key impl via the Trainer's own knob and warm once
+            # (the step retraces for the typed-key argument) before timing.
             trainer.cfg = cfg.replace(prefetch_batches=2)
-            trainer._rng = jax.random.key(7, impl="rbg")
+            trainer.set_prng_impl("rbg")
             timed_pass()
-            trainer.train_ds._cache = [None] * len(trainer.train_ds)
+            trainer.train_ds.clear_cache()
             dt = timed_pass()
             out["tokens_per_sec_chip_rbg"] = round(tokens / dt / n_chips, 1)
         out["steps"] = steps
@@ -474,9 +474,10 @@ def _llama_depth_main() -> None:
 
     from distributed_llms_example_tpu.parallel.sharding import infer_param_shardings
 
+    fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
     step_ms = {}
     for L in depths:
-        cfg = dataclasses.replace(base, num_hidden_layers=L)
+        cfg = dataclasses.replace(base, num_hidden_layers=L, fused_ce=fused_ce)
         module = LlamaForCausalLM(cfg, dtype=jax.numpy.bfloat16, remat=True, remat_policy=policy)
 
         # init ON-DEVICE with output shardings: a host round-trip of these
@@ -539,7 +540,8 @@ def _llama_depth_main() -> None:
             {
                 "metric": f"llama-2-7b causal-LM fine-tune throughput, depth-extrapolated "
                           f"from measured {depths}-layer full-width steps "
-                          f"(seq {seq}, bf16+remat[{policy}], batch {batch})",
+                          f"(seq {seq}, bf16+remat[{policy}]"
+                          f"{'+fused_ce' if fused_ce else ''}, batch {batch})",
                 "value": round(tps_chip, 1),
                 "unit": "tokens/sec/chip (extrapolated)",
                 "vs_baseline": round(tps_chip / baseline_7b, 3),

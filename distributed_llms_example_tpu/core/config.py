@@ -114,6 +114,10 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"
     # "" = model default; else "auto" | "flash" | "ring" | "xla" (ops/mha.py)
     attention_impl: str = ""
+    # fuse LM-head + CE into a vocab-chunked scan (causal families; no
+    # (tokens, vocab) fp32 logits in HBM — ops/blockwise_ce.py).  Meant
+    # for data/fsdp meshes; under tensor parallelism keep it off.
+    fused_ce: bool = False
     # PRNG implementation for the in-step dropout stream: "threefry"
     # (default — counter-based, bit-reproducible across backends) or "rbg"
     # (TPU hardware RNG; much cheaper mask generation when dropout sits on
@@ -213,6 +217,11 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
         "--attention-impl", type=str, default=_D.attention_impl,
         choices=("", "auto", "flash", "ring", "xla"),
         help="attention path override; empty = model default (auto)",
+    )
+    p.add_argument(
+        "--fused-ce", action="store_true",
+        help="vocab-chunked fused LM-head + cross-entropy (causal families, "
+             "data/fsdp meshes; logits never materialize)",
     )
     p.add_argument(
         "--prng-impl", type=str, default=_D.prng_impl,

@@ -102,8 +102,17 @@ class BatchIterator:
         steps, rem = divmod(len(self.ds), self.global_batch)
         return steps + (1 if rem and not self.drop_last else 0)
 
-    def epoch(self, epoch: int) -> Iterator[dict[str, np.ndarray]]:
-        """Iterator over the host's batches for one epoch.
+    def epoch(self, epoch: int, start_step: int = 0) -> Iterator[dict[str, np.ndarray]]:
+        """Iterator over the host's batches for one epoch, optionally
+        starting at ``start_step`` (in-epoch resume).
+
+        The batch plan is a deterministic function of (seed, epoch), so
+        skipping happens on the INDEX lists before any tokenization —
+        resuming at step N costs O(1) per skipped batch, not N batch
+        assemblies (round-4 fast-forwarded by assembling and discarding).
+        Multi-host: every host passes the same ``start_step`` (the step
+        counter agrees by construction), so the per-epoch width-agreement
+        allgather still sees identical shapes everywhere.
 
         Multi-host: an eager pass (on the caller's thread, NOT under the
         prefetcher) tokenizes the host's 1/P slice to get per-batch length
@@ -128,6 +137,8 @@ class BatchIterator:
                 drop_last=self.drop_last,
             )
         )
+        if start_step:
+            batches = batches[start_step:]
         import jax
 
         if self.process_count > 1 and jax.process_count() > 1:

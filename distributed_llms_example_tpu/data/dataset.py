@@ -171,6 +171,10 @@ class SummarizationDataset:
         for j, s, t in zip(todo, src_ids, tgt_ids):
             self._cache[j] = Example(s, t)
 
+    def clear_cache(self) -> None:
+        """Drop memoized encodings (benchmarks re-timing cold tokenization)."""
+        self._cache = [None] * len(self._records)
+
     def __getitem__(self, i: int) -> Example:
         ex = self._cache[i]
         if ex is None:
@@ -227,6 +231,10 @@ class CausalLMDataset:
         per-example path already clears the feed rate."""
         for i in indices:
             self[int(i)]
+
+    def clear_cache(self) -> None:
+        """Drop memoized encodings (benchmarks re-timing cold tokenization)."""
+        self._cache = [None] * len(self._records)
 
     def __getitem__(self, i: int) -> CausalExample:
         ex = self._cache[i]

@@ -39,6 +39,11 @@ class LlamaConfig:
     bos_token_id: int = 1
     eos_token_id: int = 2
     attention_impl: str = "auto"  # "auto" | "flash" | "ring" | "xla" (see ops/mha.py)
+    # fuse the LM head + CE into a vocab-chunked scan so (tokens, vocab)
+    # fp32 logits never materialize (ops/blockwise_ce.py; data/fsdp
+    # meshes — under tensor parallelism the chunked slicing fights the
+    # partitioner's vocab sharding, keep the unfused path)
+    fused_ce: bool = False
     # Mixture-of-experts (Mixtral-class): 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -185,11 +190,11 @@ class PipelinedLlama:
                     "pipeline stage×sequence does not compose with MoE "
                     "(per-shard router statistics need their own reduction)"
                 )
-        if getattr(config, "num_experts", 0) > 0 and schedule in ("1f1b", "interleaved"):
+        if getattr(config, "num_experts", 0) > 0 and schedule == "interleaved":
             raise ValueError(
-                f"pipeline schedule {schedule} does not support MoE configs: the "
-                "load-balance aux loss is carried as an explicit pipeline "
-                "output on the gpipe path only"
+                "pipeline schedule interleaved does not support MoE configs yet: "
+                "the load-balance aux loss rides the gpipe and 1f1b schedules "
+                "as an explicit output"
             )
         stages = mesh.shape.get("stage", 1)
         if config.num_hidden_layers % max(stages, 1):
@@ -265,6 +270,7 @@ class PipelinedLlama:
         (``_seq_shift_labels``) and the CE covers every local position —
         summing to exactly the global ``logits[:, :-1]`` vs
         ``labels[:, 1:]`` objective."""
+        from distributed_llms_example_tpu.data.batching import LABEL_PAD
         from distributed_llms_example_tpu.parallel.activation import activation_mesh
         from distributed_llms_example_tpu.parallel.pipeline import (
             pipeline_value_and_grad,
@@ -274,6 +280,10 @@ class PipelinedLlama:
 
         assert not is_seq2seq
         n_seq = self.mesh.shape.get("sequence", 1)
+        moe = getattr(self.config, "num_experts", 0) > 0
+        moe_weight = float(getattr(self.config, "moe_aux_weight", 0.0) or 0.0)
+        L = self.config.num_hidden_layers
+        M = self.num_microbatches
 
         def post_loss(pp, h, mb):
             with activation_mesh(None):
@@ -284,7 +294,7 @@ class PipelinedLlama:
                 return cross_entropy_sums(logits, labels, label_smoothing)
             return cross_entropy_sums(logits[:, :-1], mb["labels"][:, 1:], label_smoothing)
 
-        layer_fn = self._layer_fn()
+        layer_fn = self._layer_fn(with_aux=moe)
 
         def value_and_grad_sums(params, batch, rng=None):
             hidden, embed_vjp = jax.vjp(
@@ -309,7 +319,18 @@ class PipelinedLlama:
                 common["virtual_stages"] = self.virtual_stages
             else:
                 run = pipeline_value_and_grad
-            lsum, tokens, d_stacked, d_post, d_hidden = run(
+                if moe:
+                    # the aux cotangent is a DATA-only constant — the token
+                    # count the CE will report, known before the schedule
+                    # runs — so every chunk vjp can fold the load-balance
+                    # gradient in as it goes (matches the gpipe objective
+                    # lsum + w·aux_mean·tokens exactly)
+                    tokens_const = jnp.sum(
+                        (batch["labels"][:, 1:] != LABEL_PAD).astype(jnp.float32)
+                    )
+                    common["with_aux"] = True
+                    common["aux_cotangent"] = moe_weight * tokens_const / (L * M)
+            out = run(
                 layer_fn,
                 post_loss,
                 params["stacked_blocks"],
@@ -319,6 +340,11 @@ class PipelinedLlama:
                 {"labels": batch["labels"]},
                 **common,
             )
+            if moe:
+                lsum, tokens, d_stacked, d_post, d_hidden, aux_sum = out
+                lsum = lsum + moe_weight * (aux_sum / (L * M)) * tokens
+            else:
+                lsum, tokens, d_stacked, d_post, d_hidden = out
             (d_embed,) = embed_vjp(d_hidden.astype(hidden.dtype))
             grads = {
                 "embed_tokens": d_embed,
@@ -417,3 +443,13 @@ class LlamaForCausalLM(nn.Module):
         for blk in self.blocks:
             hidden = constrain_hidden(blk(hidden, bias, deterministic, use_cache, positions))
         return constrain_logits(self.lm_head(self.final_norm(hidden)))
+
+    def hidden_states(self, input_ids, attention_mask=None, *, deterministic: bool = True):
+        """Final-norm output WITHOUT the LM-head projection — the fused-CE
+        training path (ops/blockwise_ce.py) consumes this and applies the
+        head inside its vocab-chunked scan."""
+        hidden = constrain_hidden(self.embed_tokens(input_ids))
+        bias = mask_to_bias(attention_mask) if attention_mask is not None else None
+        for blk in self.blocks:
+            hidden = constrain_hidden(blk(hidden, bias, deterministic, False))
+        return self.final_norm(hidden)

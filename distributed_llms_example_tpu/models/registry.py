@@ -265,6 +265,7 @@ def load_model(
     load_weights: bool = True,
     attention_impl: str | None = None,
     moe_capacity_factor: float | None = None,
+    fused_ce: bool | None = None,
 ) -> LoadedModel:
     """Resolve a model name or local HF checkpoint dir into a LoadedModel.
 
@@ -296,6 +297,10 @@ def load_model(
             and getattr(cfg, "num_experts", 0) > 0
         ):
             cfg = dataclasses.replace(cfg, moe_capacity_factor=moe_capacity_factor)
+        if fused_ce is not None and hasattr(cfg, "fused_ce"):
+            # vocab-chunked LM-head + CE (ops/blockwise_ce.py); causal
+            # families only — seq2seq configs have no such field
+            cfg = dataclasses.replace(cfg, fused_ce=fused_ce)
         return cfg
 
     if os.path.isdir(name_or_path):

@@ -1,0 +1,160 @@
+"""Blockwise (vocab-chunked) cross-entropy for large-vocab LM heads.
+
+The standard causal-LM loss materializes the full (tokens, vocab) logits
+tensor — for the 7B recipe (batch 4 × seq 1024 × vocab 32000) that is a
+0.5 GB fp32 array written and re-read several times (logsumexp, gather,
+softmax in the backward), all pure HBM traffic.  This module fuses the
+LM-head matmul, the online softmax statistics, and the CE reduction into
+one ``lax.scan`` over vocab chunks: per chunk, a (tokens, block) tile is
+produced by the MXU, consumed by the running logsumexp / true-logit
+gather, and dropped — the only (tokens, vocab)-sized object that ever
+exists is conceptual.  The hand-written vjp recomputes each chunk's
+logits in the backward (flash-attention-style rematerialization) and
+accumulates dh / dW chunk by chunk.
+
+Numerics: chunk logits are computed at fp32 accumulation
+(``preferred_element_type``) from the bf16 hidden/kernel — slightly
+MORE precise than the unfused path, whose logits round through bf16
+before the fp32 CE.  Same token-SUM semantics as
+``train.step.cross_entropy_sums`` (loss sum, unmasked-token count), so
+grad accumulation and token weighting compose identically.
+
+Sharding: intended for data/fsdp meshes (the BASELINE 7B config).  Under
+tensor parallelism the LM-head kernel's vocab dim is sharded and the
+per-chunk ``dynamic_slice`` would fight the partitioner — keep the
+unfused path there (the Trainer only enables this via ``--fused-ce``).
+
+The reference has no analog (fp32 torch, full logits); this is part of
+the TPU-first perf work, like ops/flash_attention.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llms_example_tpu.data.batching import LABEL_PAD
+
+_NEG = -1.0e30  # finite stand-in for -inf: exp(_NEG - m) underflows to 0
+
+
+def pick_block(vocab: int, target: int = 4096) -> int:
+    """Largest divisor of ``vocab`` ≤ ``target`` — chunks must tile the
+    vocab exactly so no masking/padding logic runs in the hot loop."""
+    for b in range(min(target, vocab), 0, -1):
+        if vocab % b == 0:
+            return b
+    return vocab
+
+
+def _chunk(w: jnp.ndarray, i: jnp.ndarray, block: int) -> jnp.ndarray:
+    return jax.lax.dynamic_slice_in_dim(w, i * block, block, axis=1)
+
+
+def _logits(h: jnp.ndarray, w_c: jnp.ndarray) -> jnp.ndarray:
+    # fp32 MXU accumulation straight out of the matmul — the unfused path
+    # rounds logits through bf16 first
+    return jnp.einsum("nd,dv->nv", h, w_c, preferred_element_type=jnp.float32)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def blockwise_cross_entropy_sums(
+    hidden: jnp.ndarray,
+    w: jnp.ndarray,
+    labels: jnp.ndarray,
+    label_smoothing: float = 0.0,
+    block: int | None = None,
+):
+    """(loss_sum, token_count) of next-token CE without materializing logits.
+
+    ``hidden``: (N, D) pre-head activations (caller flattens and applies
+    the next-token shift); ``w``: (D, V) LM-head kernel; ``labels``: (N,)
+    int ids with ``LABEL_PAD`` marking masked positions.  Gradients flow
+    to ``hidden`` and ``w``; the count output has zero gradient.
+    """
+    lsum, tokens, _ = _forward(hidden, w, labels, label_smoothing, block)
+    return lsum, tokens
+
+
+def _forward(hidden, w, labels, label_smoothing, block):
+    V = w.shape[1]
+    blk = pick_block(V) if block is None else block
+    if V % blk:
+        raise ValueError(f"block {blk} does not divide vocab {V}")
+    nc = V // blk
+    mask = (labels != LABEL_PAD)
+    targets = jnp.where(mask, labels, 0)
+
+    def body(carry, i):
+        m, s, t_logit, sum_l = carry
+        lg = _logits(hidden, _chunk(w, i, blk))  # (N, blk) fp32
+        m_new = jnp.maximum(m, jnp.max(lg, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(jnp.exp(lg - m_new[:, None]), axis=-1)
+        c0 = i * blk
+        in_chunk = (targets >= c0) & (targets < c0 + blk)
+        idx = jnp.clip(targets - c0, 0, blk - 1)
+        t = jnp.take_along_axis(lg, idx[:, None], axis=1)[:, 0]
+        t_logit = jnp.where(in_chunk, t, t_logit)
+        sum_l = sum_l + jnp.sum(lg, axis=-1)
+        return (m_new, s, t_logit, sum_l), None
+
+    N = hidden.shape[0]
+    init = (
+        jnp.full((N,), _NEG, jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+        jnp.full((N,), _NEG, jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+    )
+    (m, s, t_logit, sum_l), _ = jax.lax.scan(body, init, jnp.arange(nc))
+    logz = m + jnp.log(s)
+    loss = logz - t_logit
+    if label_smoothing > 0.0:
+        # mean over vocab of -log_softmax = logz - mean(logits)
+        smooth = logz - sum_l / V
+        loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth
+    maskf = mask.astype(jnp.float32)
+    return jnp.sum(loss * maskf), jnp.sum(maskf), logz
+
+
+def _fwd(hidden, w, labels, label_smoothing, block):
+    lsum, tokens, logz = _forward(hidden, w, labels, label_smoothing, block)
+    return (lsum, tokens), (hidden, w, labels, logz)
+
+
+def _bwd(label_smoothing, block, res, ct):
+    hidden, w, labels, logz = res
+    d_lsum, _d_tokens = ct  # the count is a constant of the data: no grad
+    V = w.shape[1]
+    blk = pick_block(V) if block is None else block
+    nc = V // blk
+    mask = (labels != LABEL_PAD)
+    targets = jnp.where(mask, labels, 0)
+    scale = (mask.astype(jnp.float32) * d_lsum)[:, None]  # (N, 1)
+
+    def body(carry, i):
+        dh, dw = carry
+        w_c = _chunk(w, i, blk)
+        lg = _logits(hidden, w_c)  # recompute, flash-style
+        p = jnp.exp(lg - logz[:, None])
+        c0 = i * blk
+        in_chunk = (targets >= c0) & (targets < c0 + blk)
+        idx = jnp.clip(targets - c0, 0, blk - 1)
+        onehot = (
+            (jnp.arange(blk)[None, :] == idx[:, None]) & in_chunk[:, None]
+        ).astype(jnp.float32)
+        g = p - (1.0 - label_smoothing) * onehot - label_smoothing / V
+        g = g * scale  # (N, blk) fp32
+        dh = dh + jnp.einsum("nv,dv->nd", g, w_c, preferred_element_type=jnp.float32)
+        dw_c = jnp.einsum("nd,nv->dv", hidden, g, preferred_element_type=jnp.float32)
+        dw = jax.lax.dynamic_update_slice_in_dim(dw, dw_c, i * blk, axis=1)
+        return (dh, dw), None
+
+    dh0 = jnp.zeros(hidden.shape, jnp.float32)
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    (dh, dw), _ = jax.lax.scan(body, (dh0, dw0), jnp.arange(nc))
+    return dh.astype(hidden.dtype), dw.astype(w.dtype), None
+
+
+blockwise_cross_entropy_sums.defvjp(_fwd, _bwd)

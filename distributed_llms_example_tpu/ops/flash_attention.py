@@ -587,23 +587,36 @@ MAX_BLOCK = 512  # measured on v5e: 512-tiles run the fwd+bwd ~2.5x faster
 MAX_BLOCK_NONCAUSAL = 1024  # v5e sweep at (16, 16, 1024, 64) fwd+bwd:
 #                  non-causal 1024x1024 = 70.0 ms vs 512x512 = 74.6 ms
 #                  (~6% — fewer grid steps, same VMEM class: 4 MB score
-#                  tile).  CAUSAL stays at 512: the tile-skip guard works
-#                  per-block, so 1024-tiles waste half of each diagonal
-#                  block on masked work (74.5 ms vs 71.0 at 512).  The
-#                  learned-bias path caps block_q at 512 but block_k at
-#                  1024 (71.1 ms vs 73.9 at 512x512): its backward carries
-#                  the (1, H, Q, K) bias tile + dlbias accumulator on top
-#                  of the plain path's scratch, and 1024x1024 overflows
-#                  the 16 MB VMEM stack (measured 18.07 MB on v5e).
+#                  tile).  CAUSAL at head_dim 64 stays at 512: the
+#                  tile-skip guard works per-block, so 1024-tiles waste
+#                  half of each diagonal block on masked work (74.5 ms vs
+#                  71.0 at 512).  The learned-bias path caps block_q at
+#                  512 but block_k at 1024 (71.1 ms vs 73.9 at 512x512):
+#                  its backward carries the (1, H, Q, K) bias tile +
+#                  dlbias accumulator on top of the plain path's scratch,
+#                  and 1024x1024 overflows the 16 MB VMEM stack (measured
+#                  18.07 MB on v5e).
+
+MAX_BLOCK_CAUSAL_WIDE = 1024  # v5e sweep at the 7B regime (4/8, 32,
+#                  1024, 128) fwd+bwd: causal 1024x1024 = 3.48/4.97 ms vs
+#                  512x512 = 4.16/6.58 ms (batch 4/8) — at head_dim 128
+#                  the wider tiles' extra MXU occupancy beats the diagonal
+#                  blocks' masked-work waste that dominates at d=64, so
+#                  the causal cap is head_dim-dependent.
 
 
-def _block_caps(causal: bool, has_learned_bias: bool) -> tuple[int, int]:
+def _block_caps(causal: bool, has_learned_bias: bool,
+                head_dim: int = 64) -> tuple[int, int]:
     """(cap_q, cap_k) for the given attention flavor — see the constants'
-    comments for the v5e measurements behind each choice."""
-    if causal:
-        return MAX_BLOCK, MAX_BLOCK
+    comments for the v5e measurements behind each choice.  The learned-
+    bias cap applies even when causal: its backward's bias tile + dlbias
+    accumulator overflow VMEM at 1024×1024 regardless of masking (and
+    tiles only grow with head_dim)."""
     if has_learned_bias:
         return MAX_BLOCK, MAX_BLOCK_NONCAUSAL
+    if causal:
+        cap = MAX_BLOCK_CAUSAL_WIDE if head_dim >= 128 else MAX_BLOCK
+        return cap, cap
     return MAX_BLOCK_NONCAUSAL, MAX_BLOCK_NONCAUSAL
 
 
@@ -671,7 +684,7 @@ def flash_attention(
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    cap_q, cap_k = _block_caps(causal, learned_bias is not None)
+    cap_q, cap_k = _block_caps(causal, learned_bias is not None, q.shape[-1])
     block_q = auto_block(q.shape[2], cap_q) if block_q is None else min(block_q, q.shape[2])
     block_k = auto_block(k.shape[2], cap_k) if block_k is None else min(block_k, k.shape[2])
     if (
@@ -716,7 +729,7 @@ def flash_supported(q_len: int, kv_len: int, head_dim: int,
     ``has_learned_bias`` as the eventual kernel call will, or a length only
     tileable above 512 (e.g. 592 = 16*37) would be reported eligible for a
     path whose cap rejects it."""
-    cap_q, cap_k = _block_caps(causal, has_learned_bias)
+    cap_q, cap_k = _block_caps(causal, has_learned_bias, head_dim)
     bq = auto_block(q_len, cap_q) if block_q is None else min(block_q, q_len)
     bk = auto_block(kv_len, cap_k) if block_k is None else min(block_k, kv_len)
     return (
@@ -866,7 +879,7 @@ def flash_attention_lbias_sharded(
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    cap_q, cap_k = _block_caps(bool(causal), True)
+    cap_q, cap_k = _block_caps(bool(causal), True, q.shape[-1])
     block_q = auto_block(q.shape[2], cap_q) if block_q is None else min(block_q, q.shape[2])
     block_k = auto_block(k.shape[2], cap_k) if block_k is None else min(block_k, k.shape[2])
     if (

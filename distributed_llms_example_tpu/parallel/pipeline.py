@@ -365,10 +365,6 @@ def pipeline_apply(
 
     run_stage = _make_run_stage(layer_fn, checkpoint, with_aux)
 
-    n_seq = mesh.shape.get(seq_axis, 1) if seq_axis else 1
-    if n_seq <= 1:
-        seq_axis = None
-
     if S == 1:
         # no pipeline: plain scan over the full stack under GSPMD (a
         # sequence axis, if any, is handled by the modules' own global-shape
@@ -378,43 +374,24 @@ def pipeline_apply(
             return y, aux / L
         return run_stage(stacked_params, hidden, extras, rng)
 
-    if seq_axis is not None and with_aux:
-        raise ValueError(
-            "pipeline with_aux (MoE load-balance loss) does not compose with "
-            "sequence parallelism: per-shard router statistics would need "
-            "their own cross-sequence reduction"
-        )
-    if seq_axis is not None and hidden.ndim >= 2 and hidden.shape[1] % n_seq:
-        raise ValueError(
-            f"sequence length {hidden.shape[1]} not divisible by "
-            f"{seq_axis}={n_seq}"
-        )
-    axes_all = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
-
-    # which extras are per-example (to be microbatched) vs per-call
-    # constants (replicated): decided from GLOBAL shapes, outside the body
-    is_batched = jax.tree.map(lambda m: m.ndim > 0 and m.shape[0] == B, extras)
-    # original extras dtypes: bf16 extras ride the plumbing in fp32 (same
-    # partitioner bug as the hidden carries) and cast back per microbatch
-    ex_dtypes = jax.tree.map(lambda m: m.dtype, extras)
-
+    # seq-axis resolution, divisibility, and the bf16→fp32 boundary
+    # conversion are shared with the fused executors (_pvg_common) so the
+    # partitioner-workaround conventions cannot drift between the paths.
     # The pipeline PLUMBING (microbatch selects, hop buffers, the output
     # accumulator) runs in fp32 when the compute dtype is bf16: the XLA
     # SPMD partitioner miscompiles bf16 select/copy chains under
     # partial-manual shard_map ("Invalid binary instruction opcode copy",
     # observed on jax 0.9/XLA CPU), and the converts fuse into the layer
     # matmuls anyway.  Layer compute still happens in the caller's dtype.
-    compute_dtype = hidden.dtype
-    plumb_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
-    if seq_axis is not None:
-        # sequence-sharded region boundary: the hidden/extras in- and
-        # out-specs are SHARDED here (not replicated as on the stage-only
-        # path), and a bf16 array crossing a sharded partial-manual
-        # boundary feeds the same partitioner copy-chain bug — convert
-        # OUTSIDE the shard_map so the boundary only ever carries fp32
-        hidden = hidden.astype(plumb_dtype)
-        extras = jax.tree.map(
-            lambda m: m.astype(plumb_dtype) if m.dtype == jnp.bfloat16 else m, extras
+    (seq_axis, n_seq, axes_all, is_batched, ex_dtypes, compute_dtype,
+     plumb_dtype, hidden, extras) = _pvg_common(
+        hidden, extras, mesh=mesh, axis_name=axis_name, seq_axis=seq_axis,
+    )
+    if seq_axis is not None and with_aux:
+        raise ValueError(
+            "pipeline with_aux (MoE load-balance loss) does not compose with "
+            "sequence parallelism: per-shard router statistics would need "
+            "their own cross-sequence reduction"
         )
 
     def body(local_params: Any, h: jnp.ndarray, ex: Any, key: Any) -> jnp.ndarray:
@@ -705,11 +682,12 @@ def _pvg_body_epilogue(lsum, toks, d_sp, d_pp, d_h, h_shape, *, axis_name,
 
 def _pvg_shard_map(body, *, mesh, axis_name, axes_all, seq_axis, n_seq,
                    stacked_params, post_params, hidden, extras, loss_batch,
-                   rng, extras_seq_dims, loss_seq_dims):
+                   rng, extras_seq_dims, loss_seq_dims, with_aux=False):
     """Shared spec construction + ``shard_map`` epilogue for the fused-
     schedule executors.  ``body(sp, pp, h, ex, lb, rt)`` returns
-    ``(lsum, tokens, d_sp, d_pp, d_h)``; it is wrapped in the
-    ``manual_sequence`` context when a sequence axis is live."""
+    ``(lsum, tokens, d_sp, d_pp, d_h)`` (plus an aux-sum scalar when
+    ``with_aux``); it is wrapped in the ``manual_sequence`` context when a
+    sequence axis is live."""
     param_specs = jax.tree.map(lambda x: _full_spec(axis_name, x.ndim), stacked_params)
     rng_tree = {} if rng is None else {"key": rng}
     if seq_axis is None:
@@ -743,6 +721,7 @@ def _pvg_shard_map(body, *, mesh, axis_name, axes_all, seq_axis, n_seq,
             P(), P(), param_specs,
             jax.tree.map(lambda _: P(), post_params),
             hidden_spec,
+            *((P(),) if with_aux else ()),
         ),
         check_vma=True,
     )(stacked_params, post_params, hidden, extras, loss_batch, rng_tree)
@@ -766,6 +745,8 @@ def pipeline_value_and_grad(
     seq_axis: str | None = None,
     extras_seq_dims: Any = None,
     loss_seq_dims: Any = None,
+    with_aux: bool = False,
+    aux_cotangent: jnp.ndarray | float = 0.0,
 ):
     """1F1B pipeline schedule: loss AND parameter gradients in ONE fused
     scan, backward microbatches interleaved with forward.
@@ -818,15 +799,56 @@ def pipeline_value_and_grad(
     cross-shard target shift itself — see models/llama.py).  All manual-
     axis gradient reductions run in fp32 (bf16 psums over manual axes
     crash the partitioner, see ``pipeline_apply``).
+
+    ``with_aux``: ``layer_fn`` returns ``(h, aux_scalar)`` (the MoE
+    load-balance loss).  The call then additionally returns ``aux_sum``
+    (the raw sum over all L layers × M microbatches — the caller
+    normalizes), and every chunk vjp receives ``aux_cotangent`` as the
+    aux output's cotangent so its gradient lands in d_stacked/d_hidden
+    with everything else.  ``aux_cotangent`` must be the CONSTANT
+    d(objective)/d(aux_sum) — for the ``moe_weight·aux_mean·tokens``
+    objective that is ``moe_weight·tokens/(L·M)``, computable from the
+    labels alone BEFORE the schedule runs (token counts don't depend on
+    params).  Does not compose with ``seq_axis`` (per-shard router
+    statistics would need their own reduction — same restriction as
+    ``pipeline_apply``).
     """
     S = mesh.shape.get(axis_name, 1)
     M = num_microbatches
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     if L % max(S, 1):
         raise ValueError(f"{L} layers not divisible into {S} pipeline stages")
-    run_stage = _make_run_stage(layer_fn, checkpoint)
+    if with_aux and seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1:
+        raise ValueError(
+            "pipeline with_aux (MoE load-balance loss) does not compose with "
+            "sequence parallelism: per-shard router statistics would need "
+            "their own cross-sequence reduction"
+        )
+    run_stage = _make_run_stage(layer_fn, checkpoint, with_aux)
     _pvg_check_batch(hidden.shape[0], mesh, M, batch_axes)
     if S == 1:
+        if with_aux:
+            def whole(sp, pp, h):
+                y, aux = run_stage(sp, h, extras, rng)
+                ls, tk = post_loss_fn(pp, y, loss_batch)
+                # contract: aux_sum spans L layers × M microbatches.  The
+                # single-stage path runs ONE full-batch pass (aux over L
+                # only) — scale by M so the caller's /(L·M) normalization
+                # and the aux cotangent (also /(L·M)) stay exact; the
+                # value then equals the gpipe S==1 aux/L mean.
+                return ls, tk, aux * M
+
+            (lsum, tokens, aux_sum), vjp = jax.vjp(
+                whole, stacked_params, post_params, hidden
+            )
+            # the aux output's cotangent IS the constant d(objective)/d(aux)
+            # — one vjp covers CE and load-balance gradients together
+            d_sp, d_pp, d_h = vjp((
+                jnp.ones((), lsum.dtype),
+                jnp.zeros((), tokens.dtype),
+                jnp.asarray(aux_cotangent, aux_sum.dtype),
+            ))
+            return lsum, tokens, d_sp, d_pp, d_h, aux_sum
         return _pvg_single_stage(
             run_stage, post_loss_fn, stacked_params, post_params,
             hidden, extras, loss_batch, rng,
@@ -857,11 +879,12 @@ def pipeline_value_and_grad(
         d_pp = zeros_like_f32(pp)
         d_h = _vary(jnp.zeros((M, mb, *h.shape[1:]), jnp.float32), axes_all)
         scal0 = _vary(jnp.zeros((), jnp.float32), axes_all)
+        aux_ct = _vary(jnp.asarray(aux_cotangent, jnp.float32), axes_all)
         perm_fwd = [(i, i + 1) for i in range(S - 1)]
         perm_bwd = [(i + 1, i) for i in range(S - 1)]
 
         def tick(carry, t):
-            fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks = carry
+            fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks, aux_acc = carry
             mf = t - s_idx
             mb_i = t - (2 * (S - 1) - s_idx)
             act_f = (mf >= 0) & (mf < M)
@@ -876,11 +899,15 @@ def pipeline_value_and_grad(
             key_f = None if key is None else jax.random.fold_in(key, mf_c)
 
             def chunk_f(p_, x_):
-                return run_stage(
-                    p_, x_.astype(compute_dtype), ex_f, key_f
-                ).astype(plumb_dtype)
+                out = run_stage(p_, x_.astype(compute_dtype), ex_f, key_f)
+                if with_aux:
+                    return out[0].astype(plumb_dtype), out[1]
+                return out.astype(plumb_dtype)
 
             y = chunk_f(sp_local, x_in)
+            if with_aux:
+                y, aux_f = y
+                aux_acc = aux_acc + jnp.where(act_f, aux_f.astype(jnp.float32), 0.0)
             act = jax.lax.dynamic_update_index_in_dim(act, x_in, mf_c % K, 0)
 
             # ---- last stage: loss fwd+vjp for the microbatch it just
@@ -913,13 +940,22 @@ def pipeline_value_and_grad(
             key_b = None if key is None else jax.random.fold_in(key, mb_c)
 
             def chunk_b(p_, x_):
-                return run_stage(
-                    p_, x_.astype(compute_dtype), ex_b, key_b
-                ).astype(plumb_dtype)
+                out = run_stage(p_, x_.astype(compute_dtype), ex_b, key_b)
+                if with_aux:
+                    return out[0].astype(plumb_dtype), out[1]
+                return out.astype(plumb_dtype)
 
             _, chunk_vjp = jax.vjp(chunk_b, sp_local, x_b)
             dy_in = jnp.where(is_last, dy_loss.astype(plumb_dtype), bwd_buf)
-            d_sp_m, dx = chunk_vjp(dy_in)
+            if with_aux:
+                # the aux output's cotangent: the constant objective
+                # coefficient, masked to active backward ticks (bubble
+                # ticks' dx is never consumed, but bounding it costs one
+                # where and keeps the invariant obvious)
+                aux_dy = jnp.where(act_b, aux_ct, 0.0)
+                d_sp_m, dx = chunk_vjp((dy_in, aux_dy))
+            else:
+                d_sp_m, dx = chunk_vjp(dy_in)
             d_sp = jax.tree.map(
                 lambda a, g: a + jnp.where(act_b, g.astype(jnp.float32), 0.0),
                 d_sp, d_sp_m,
@@ -932,23 +968,27 @@ def pipeline_value_and_grad(
             # ---- hops: activations forward, activation-grads backward
             fwd_buf = jax.lax.ppermute(y, axis_name, perm_fwd)
             bwd_buf = jax.lax.ppermute(dx.astype(plumb_dtype), axis_name, perm_bwd)
-            return (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks), None
+            return (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks, aux_acc), None
 
-        carry = (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, scal0, scal0)
-        (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks), _ = jax.lax.scan(
+        carry = (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, scal0, scal0, scal0)
+        (fwd_buf, bwd_buf, act, d_sp, d_pp, d_h, lsum, toks, aux_acc), _ = jax.lax.scan(
             tick, carry, jnp.arange(T)
         )
-        return _pvg_body_epilogue(
+        out = _pvg_body_epilogue(
             lsum, toks, d_sp, d_pp, d_h, h_shape,
             axis_name=axis_name, axes_all=axes_all, seq_axis=seq_axis,
         )
+        if with_aux:
+            # every (stage-chunk, microbatch) contributed its layer-sum once
+            return (*out, jax.lax.psum(aux_acc, axis_name))
+        return out
 
     return _pvg_shard_map(
         body, mesh=mesh, axis_name=axis_name, axes_all=axes_all,
         seq_axis=seq_axis, n_seq=n_seq, stacked_params=stacked_params,
         post_params=post_params, hidden=hidden, extras=extras,
         loss_batch=loss_batch, rng=rng, extras_seq_dims=extras_seq_dims,
-        loss_seq_dims=loss_seq_dims,
+        loss_seq_dims=loss_seq_dims, with_aux=with_aux,
     )
 
 

@@ -81,6 +81,16 @@ def make_loss_fn(
     # as mean-CE + weight·aux (exact under scan-based grad accumulation).
     moe_weight = float(getattr(config, "moe_aux_weight", 0.0) or 0.0)
 
+    # fused (vocab-chunked) CE: consume the pre-head hidden and apply the
+    # LM head inside blockwise_cross_entropy_sums' scan, so (tokens, vocab)
+    # fp32 logits never materialize.  Causal flax modules only (the
+    # pipelined adapters own their loss paths).
+    fused_ce = (
+        not is_seq2seq
+        and bool(getattr(config, "fused_ce", False))
+        and hasattr(model, "hidden_states")
+    )
+
     def apply_model(params: Any, *args, **kw):
         if moe_weight > 0.0:
             logits, mutated = model.apply({"params": params}, *args, mutable=["losses"], **kw)
@@ -106,6 +116,27 @@ def make_loss_fn(
                 rngs=rngs,
             )
             lsum, tokens = cross_entropy_sums(logits, labels, label_smoothing)
+        elif fused_ce:
+            h, aux = apply_model(
+                params,
+                batch["input_ids"],
+                batch["attention_mask"],
+                deterministic=dropout_rng is None,
+                rngs=rngs,
+                method="hidden_states",
+            )
+            from distributed_llms_example_tpu.ops.blockwise_ce import (
+                blockwise_cross_entropy_sums,
+            )
+
+            h2 = h[:, :-1].reshape(-1, h.shape[-1])
+            # cast the master-fp32 kernel to the compute dtype first — the
+            # unfused lm_head does the same (nn.Dense dtype), and a raw
+            # fp32×fp32 chunk matmul would forfeit MXU bf16 throughput
+            w = params["lm_head"]["kernel"].astype(h.dtype)
+            lsum, tokens = blockwise_cross_entropy_sums(
+                h2, w, labels[:, 1:].reshape(-1), label_smoothing
+            )
         else:
             logits, aux = apply_model(
                 params,

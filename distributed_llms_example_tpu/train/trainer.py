@@ -81,6 +81,7 @@ class Trainer:
             cfg.model_ckpt, dtype=compute_dtype, remat=cfg.remat, remat_policy=cfg.remat_policy,
             moe_capacity_factor=cfg.moe_capacity_factor,
             attention_impl=cfg.attention_impl or None,
+            fused_ce=cfg.fused_ce or None,
         )
         self.model, self.config = self.loaded.module, self.loaded.config
 
@@ -236,6 +237,29 @@ class Trainer:
                           f"target_cap={tgt_cap} not all divisible by sequence={seq_axis}",
             })
 
+        # --fused-ce composes only with data/fsdp meshes on causal
+        # families: under tensor>1 the vocab-chunked slicing would gather
+        # the vocab-sharded lm_head kernel every chunk (a silent perf/HBM
+        # regression), and the pipelined adapters own their loss paths so
+        # the flag would be silently inert — fail loudly instead.
+        if cfg.fused_ce:
+            if self.loaded.is_seq2seq:
+                raise ValueError(
+                    "--fused-ce supports causal (decoder-only) families; "
+                    f"{cfg.model_ckpt!r} is seq2seq"
+                )
+            bad = [
+                a for a in ("tensor", "stage", "sequence")
+                if self.mesh.shape.get(a, 1) > 1
+            ]
+            if bad:
+                raise ValueError(
+                    f"--fused-ce does not compose with mesh axes {bad}: the "
+                    "vocab-chunked LM head wants an unsharded vocab dim and "
+                    "the standard (non-pipelined) loss path; use data/fsdp "
+                    "axes or drop the flag"
+                )
+
         # forced-ring misconfiguration must fail HERE, loudly: the selection
         # logic quietly falls back on mesh-less traces (module init, the
         # pipeline's per-stage bodies), so a bad mesh would otherwise train
@@ -312,6 +336,20 @@ class Trainer:
             "virtual_stages": cfg.pipeline_virtual_stages if permuted else 1,
             "stages": self.mesh.shape.get("stage", 1) if permuted else 1,
         }
+        # the same identity ALSO rides inside the checkpoint payload as an
+        # array leaf (ADVICE r4: the sidecar can be separated from the
+        # arrays — a copy that drops the small JSON silently yields a
+        # layer-permuted model, which nothing else can catch since shapes
+        # are permutation-invariant).  Saved with the state, checked on
+        # restore; the sidecar stays for pre-restore refusal + humans.
+        self._layout_leaf = np.asarray(
+            [
+                int(permuted),
+                self._ckpt_layout["virtual_stages"],
+                self._ckpt_layout["stages"],
+            ],
+            np.int32,
+        )
         # THE single storage→true-order map (None: storage is already in
         # layer order).  Every consumer — eval unstack, HF export, the
         # val-loss un-permute — reads this one attribute, so the layout
@@ -349,10 +387,38 @@ class Trainer:
                     "permutation, so restoring across layouts would "
                     "silently permute the model's layers)"
                 )
-        if cfg.checkpoint.resume:
-            restored = self.checkpointer.restore_latest(abstract_like(self.state, self.state_sh))
+        if cfg.checkpoint.resume and self.checkpointer.latest_step() is not None:
+            abstract = abstract_like(self.state, self.state_sh)
+            try:
+                restored = self.checkpointer.restore_latest(
+                    self._with_layout(abstract, abstract=True)
+                )
+            except Exception:
+                # legacy checkpoint (bare TrainState, no layout leaf):
+                # restore the old structure and rely on the sidecar guard
+                # above, which already ran for this directory
+                restored = self.checkpointer.restore_latest(abstract)
+                if restored is not None:
+                    self.state, self.start_step = restored
+                    log_json({
+                        "event": "resumed", "step": self.start_step,
+                        "legacy_payload": True,
+                    })
+                restored = None
             if restored is not None:
-                self.state, self.start_step = restored
+                payload, self.start_step = restored
+                stored_leaf = np.asarray(jax.device_get(payload["stacked_layout"]))
+                if not np.array_equal(stored_leaf, self._layout_leaf):
+                    raise ValueError(
+                        f"checkpoint payload records stacked-block layout "
+                        f"[interleaved, virtual_stages, stages] = "
+                        f"{stored_leaf.tolist()}, but this run uses "
+                        f"{self._layout_leaf.tolist()} — resume with the same "
+                        "--pipeline-schedule/--pipeline-virtual-stages flags "
+                        "and stage-axis size (restoring across layouts would "
+                        "silently permute the model's layers)"
+                    )
+                self.state = payload["state"]
                 log_json({"event": "resumed", "step": self.start_step})
         # Written at init, AFTER the mismatch guard: a mixed dir has
         # already been refused above, and deferring to the first save
@@ -405,13 +471,29 @@ class Trainer:
         # reproducible across backends); --prng-impl rbg swaps in the TPU
         # hardware RNG — mask generation is then nearly free, where
         # threefry's counter math can cost ~20% of a dropout-on step
-        self._rng = (
-            jax.random.PRNGKey(cfg.shuffle_seed)
-            if cfg.prng_impl == "threefry"
-            else jax.random.key(cfg.shuffle_seed, impl=cfg.prng_impl)
-        )
+        self.set_prng_impl(cfg.prng_impl)
 
     # ------------------------------------------------------------------
+
+    def set_prng_impl(self, impl: str) -> None:
+        """(Re)seed the dropout stream with the given PRNG implementation
+        ("threefry" / "rbg") — the ONE home for the key wiring, used by
+        __init__ and by bench A/B passes, so the two cannot drift."""
+        self._rng = (
+            jax.random.PRNGKey(self.cfg.shuffle_seed)
+            if impl == "threefry"
+            else jax.random.key(self.cfg.shuffle_seed, impl=impl)
+        )
+
+    def _with_layout(self, state: Any, abstract: bool = False) -> dict:
+        """Checkpoint payload: the TrainState plus the stacked-block layout
+        identity as an ARRAY leaf, so the identity cannot be separated from
+        the arrays it describes (the sidecar JSON can)."""
+        leaf = (
+            jax.ShapeDtypeStruct(self._layout_leaf.shape, self._layout_leaf.dtype)
+            if abstract else self._layout_leaf
+        )
+        return {"state": state, "stacked_layout": leaf}
 
     def evaluate(self, epoch: int | None = None) -> dict[str, float]:
         if self.val_ds is None:
@@ -676,14 +758,16 @@ class Trainer:
         for epoch in range(start_epoch, cfg.num_epochs):
             # assemble host batches (tokenize/pad/bucket) on a background
             # thread, prefetch_batches ahead, so input work overlaps the
-            # device step instead of sitting on the critical path
-            epoch_batches = self.batches.epoch(epoch)
+            # device step instead of sitting on the critical path.  A
+            # resumed epoch fast-forwards at the INDEX level (the batch
+            # plan is deterministic per (seed, epoch)): no skipped batch
+            # is ever tokenized or padded.
+            skip = step - start_epoch * steps_per_epoch if epoch == start_epoch else 0
+            epoch_batches = self.batches.epoch(epoch, start_step=skip)
             if cfg.prefetch_batches > 0:
                 epoch_batches = Prefetcher(epoch_batches, depth=cfg.prefetch_batches)
             try:
-                for i, batch in enumerate(epoch_batches):
-                    if epoch == start_epoch and i < step - start_epoch * steps_per_epoch:
-                        continue  # fast-forward within the resumed epoch
+                for batch in epoch_batches:
                     if profile_stop_step and step + 1 == profile_start_step:
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling_active = True
@@ -711,7 +795,7 @@ class Trainer:
                         epoch=epoch,
                     )
                     if self.checkpointer.should_save(step):
-                        self.checkpointer.save(step, self.state)
+                        self.checkpointer.save(step, self._with_layout(self.state))
                     if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
                         last_eval = self.evaluate(epoch)
                     if self._check_preemption(step):
@@ -741,7 +825,7 @@ class Trainer:
             log_json({"event": "profile_trace", "dir": cfg.profile_dir, "truncated": True})
         if self._preempted:
             # save where we stopped and get out; resume restarts from here
-            self.checkpointer.save(step, self.state, force=True)
+            self.checkpointer.save(step, self._with_layout(self.state), force=True)
             self.checkpointer.wait()
             wall = time.perf_counter() - t0
             log_json({"event": "preempted", "step": step, "wall_seconds": wall})
@@ -749,7 +833,7 @@ class Trainer:
                 "steps": step, "wall_seconds": wall, "final_eval": last_eval,
                 "preempted": True,
             }
-        self.checkpointer.save(self.total_steps, self.state, force=True)
+        self.checkpointer.save(self.total_steps, self._with_layout(self.state), force=True)
         self.checkpointer.wait()
         self.save_final()
         wall = time.perf_counter() - t0

@@ -198,3 +198,52 @@ def test_batch_encode_matches_per_example_hf(tmp_path):
     for i in range(len(records)):
         assert a[i].input_ids == b[i].input_ids
         assert a[i].labels == b[i].labels
+
+
+def test_epoch_start_step_resumes_without_assembly():
+    """In-epoch resume skips at the INDEX level: epoch(e, start_step=N)
+    yields exactly the batches epoch(e) yields from step N on, and the
+    skipped batches' examples are never tokenized (round-4 fast-forward
+    assembled and discarded them — O(N) host work before the first real
+    step)."""
+
+    class CountingByte(ByteTokenizer):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def encode_source(self, text, max_length):
+            self.calls += 1
+            return super().encode_source(text, max_length)
+
+        encode_target = encode_source
+
+        def encode_source_batch(self, texts, max_length):
+            self.calls += len(texts)
+            return [ByteTokenizer.encode_source(self, t, max_length) for t in texts]
+
+        encode_target_batch = encode_source_batch
+
+    records = [{"dialogue": f"word {i} " * (i % 5 + 1), "summary": f"s {i}"} for i in range(32)]
+
+    def make_iter():
+        from distributed_llms_example_tpu.data.dataset import SummarizationDataset
+
+        tok = CountingByte()
+        ds = SummarizationDataset(records, tok, max_source_length=64, max_target_length=16)
+        return tok, BatchIterator(
+            ds, global_batch=8, seed=5, bucket_multiple=16,
+            max_source_length=64, max_target_length=16,
+        )
+
+    _, it_full = make_iter()
+    full = list(it_full.epoch(0))
+    assert len(full) == 4
+
+    tok, it_tail = make_iter()
+    tail = list(it_tail.epoch(0, start_step=3))
+    assert len(tail) == 1
+    for k in full[3]:
+        np.testing.assert_array_equal(tail[0][k], full[3][k])
+    # only the ONE remaining batch's examples were encoded (src + tgt each)
+    assert tok.calls == 2 * 8

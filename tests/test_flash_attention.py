@@ -277,3 +277,16 @@ def test_fully_masked_rows_give_finite_zero_grads():
     np.testing.assert_allclose(
         np.asarray(g_full[3]), np.asarray(g_live[3]), atol=1e-5
     )
+
+
+def test_causal_cap_is_head_dim_dependent():
+    """Causal tiles cap at 512 for narrow heads (d=64: diagonal masked work
+    dominates wider tiles) but 1024 at d>=128 (7B regime, measured -17%/-24%
+    fwd+bwd at batch 4/8 — BENCH_7B_r05.json attack A)."""
+    from distributed_llms_example_tpu.ops.flash_attention import _block_caps
+
+    assert _block_caps(True, False, 64) == (512, 512)
+    assert _block_caps(True, False, 128) == (1024, 1024)
+    # 592 = 16*37 tiles only above 512: causal+wide heads becomes eligible
+    assert flash_supported(592, 592, 128, causal=True)
+    assert not flash_supported(592, 592, 64, causal=True)

@@ -345,11 +345,12 @@ def make_beam_search(
         # replicate encoder outputs per beam: (B*K, S, D)
         enc_rep = jnp.repeat(enc, K, axis=0)
         mask_rep = jnp.repeat(attention_mask, K, axis=0)
-        # cross-attention K/V projected ONCE at batch B then replicated per
-        # beam; beams of a row share the encoder output, so the per-step
-        # beam reorder never touches this tree
+        # cross-attention K/V projected ONCE at batch B and kept there:
+        # beams of a row share the encoder output, so the attention folds
+        # the beam group next to heads (grouped_dot_product_attention) and
+        # K/V stream from HBM once per row per step — neither the per-step
+        # beam reorder nor a per-beam replica ever touches this tree
         ckv = model.apply({"params": params}, enc, method="cross_kv")
-        ckv = jax.tree.map(lambda x: jnp.repeat(x, K, axis=0), ckv)
         cache = _init_cache(model, params, B * K, L, enc_rep, mask_rep)
 
         state = _beam_init(B, K, L, pad)

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from distributed_llms_example_tpu.ops.attention import (
     NEG_INF,
+    beam_grouped_attention,
     dot_product_attention,
     make_causal_bias,
     mask_to_bias,
@@ -173,6 +174,15 @@ class T5Attention(nn.Module):
         q = self._split(self.q_proj(hidden))
         if cross_kv is not None:
             k, v = cross_kv
+            if k.shape[0] != hidden.shape[0]:
+                # beam decode: beams share the row's cross K/V — one
+                # shared fold/unfold convention (ops/attention.py); T5
+                # attention is unscaled
+                out = beam_grouped_attention(
+                    q, k, v, bias, scale=1.0, dtype=self.dtype,
+                    learned_bias=learned_bias,
+                )
+                return self.o_proj(self._merge(out))
         else:
             kv_src = hidden if kv_hidden is None else kv_hidden
             k = self._split(self.k_proj(kv_src))

@@ -47,6 +47,68 @@ def dot_product_attention(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
 
 
+def grouped_dot_product_attention(
+    q5: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    scale: float | None = None,
+    dtype: jnp.dtype | None = None,
+) -> jnp.ndarray:
+    """``dot_product_attention`` with a beam/group dim folded next to heads.
+
+    ``q5``: (B, G, H, Q, d) attends SHARED ``k``/``v``: (B, H, K, d) —
+    the einsum contracts without materializing the (B·G, H, K, d) repeat,
+    so K/V stream from HBM once per row instead of once per beam copy
+    (the dominant decode-step traffic for seq2seq generation, where every
+    beam of a row shares the encoder's cross K/V).  Same math per element
+    as ``dot_product_attention`` on repeated K/V: fp32 scores/softmax,
+    identical scale/bias conventions; ``bias`` is (B|1, 1|H, Q, K) —
+    per-row, like K/V, never per-beam (beams of a row share the mask)."""
+    if scale is None:
+        scale = q5.shape[-1] ** -0.5
+    dtype = dtype or q5.dtype
+    scores = jnp.einsum("bghqd,bhkd->bghqk", q5, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)[:, None]
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum("bghqk,bhkd->bghqd", probs.astype(dtype), v)
+
+
+def beam_grouped_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    scale: float | None = None,
+    dtype: jnp.dtype | None = None,
+    learned_bias: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Beam-decode front end for ``grouped_dot_product_attention``: the ONE
+    home for the fold/slice/unfold convention both attention modules use.
+
+    ``q``: (B·G, H, Q, d) flattened beam batch; ``k``/``v``: (B, H, K, d)
+    shared per row.  A per-beam ``bias`` (leading dim B·G) is stride-
+    sliced to one row per group (beams of a row share their mask);
+    ``learned_bias`` (1, H, Q, K) adds on top.  Returns (B·G, H, Q, d)."""
+    B = k.shape[0]
+    G = q.shape[0] // B
+    H, Q, d = q.shape[1], q.shape[2], q.shape[3]
+    bb = None
+    if bias is not None:
+        bb = bias if bias.shape[0] in (1, B) else bias[::G]
+    if learned_bias is not None:
+        bb = learned_bias if bb is None else bb + learned_bias
+    out = grouped_dot_product_attention(
+        q.reshape(B, G, H, Q, d), k, v, bb, scale=scale, dtype=dtype
+    )
+    return out.reshape(B * G, H, Q, d)
+
+
 def make_causal_bias(q_len: int, kv_len: int, offset: int = 0) -> jnp.ndarray:
     """(1, 1, q_len, kv_len) additive causal mask; ``offset`` is the absolute
     position of query 0 (for incremental decoding with a KV cache)."""

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_llms_example_tpu.ops.attention import (
     NEG_INF,
+    beam_grouped_attention,
     dot_product_attention,
     make_causal_bias,
 )
@@ -263,6 +264,22 @@ class MultiHeadAttention(nn.Module):
         q = self._split(self.q_proj(hidden), self.num_heads)
         if cross_kv is not None:
             k, v = cross_kv
+            if k.shape[0] != hidden.shape[0]:
+                if self.kv_heads != self.num_heads:
+                    # GQA cross-attention cannot fold beams next to heads
+                    # (head counts already differ): replicate K/V per beam
+                    # instead — correct, just without the traffic saving
+                    G = hidden.shape[0] // k.shape[0]
+                    k = jnp.repeat(k, G, axis=0)
+                    v = jnp.repeat(v, G, axis=0)
+                else:
+                    # beam decode: every beam of a row shares the row's
+                    # cross K/V — fold the beam group next to heads so K/V
+                    # stream once per row instead of once per beam copy
+                    # (the dominant decode-step HBM traffic)
+                    out = beam_grouped_attention(q, k, v, bias, dtype=self.dtype)
+                    b_, h_, s_, d_ = out.shape
+                    return self.o_proj(out.transpose(0, 2, 1, 3).reshape(b_, s_, h_ * d_))
         else:
             kv_src = hidden if kv_hidden is None else kv_hidden
             k = self._split(self.k_proj(kv_src), self.kv_heads)

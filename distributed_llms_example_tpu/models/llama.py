@@ -190,12 +190,6 @@ class PipelinedLlama:
                     "pipeline stage×sequence does not compose with MoE "
                     "(per-shard router statistics need their own reduction)"
                 )
-        if getattr(config, "num_experts", 0) > 0 and schedule == "interleaved":
-            raise ValueError(
-                "pipeline schedule interleaved does not support MoE configs yet: "
-                "the load-balance aux loss rides the gpipe and 1f1b schedules "
-                "as an explicit output"
-            )
         stages = mesh.shape.get("stage", 1)
         if config.num_hidden_layers % max(stages, 1):
             raise ValueError(
@@ -319,17 +313,18 @@ class PipelinedLlama:
                 common["virtual_stages"] = self.virtual_stages
             else:
                 run = pipeline_value_and_grad
-                if moe:
-                    # the aux cotangent is a DATA-only constant — the token
-                    # count the CE will report, known before the schedule
-                    # runs — so every chunk vjp can fold the load-balance
-                    # gradient in as it goes (matches the gpipe objective
-                    # lsum + w·aux_mean·tokens exactly)
-                    tokens_const = jnp.sum(
-                        (batch["labels"][:, 1:] != LABEL_PAD).astype(jnp.float32)
-                    )
-                    common["with_aux"] = True
-                    common["aux_cotangent"] = moe_weight * tokens_const / (L * M)
+            if moe:
+                # the aux cotangent is a DATA-only constant — the token
+                # count the CE will report, known before the schedule
+                # runs — so every chunk vjp can fold the load-balance
+                # gradient in as it goes (matches the gpipe objective
+                # lsum + w·aux_mean·tokens exactly); both fused schedules
+                # take the same contract
+                tokens_const = jnp.sum(
+                    (batch["labels"][:, 1:] != LABEL_PAD).astype(jnp.float32)
+                )
+                common["with_aux"] = True
+                common["aux_cotangent"] = moe_weight * tokens_const / (L * M)
             out = run(
                 layer_fn,
                 post_loss,

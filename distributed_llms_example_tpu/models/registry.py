@@ -91,6 +91,13 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
         max_position_embeddings=128,
         num_experts=4, num_experts_per_tok=2, moe_aux_weight=0.01,
     ),
+    # 4 layers: MoE × interleaved pipeline tests need stage=2 × v=2 chunks
+    "mixtral-test-4l": LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+        num_experts=4, num_experts_per_tok=2, moe_aux_weight=0.01,
+    ),
     "mixtral-8x7b": LlamaConfig(
         hidden_size=4096, intermediate_size=14336, num_hidden_layers=32,
         num_attention_heads=32, num_key_value_heads=8, vocab_size=32000,

@@ -534,6 +534,34 @@ def _pvg_single_stage(run_stage, post_loss_fn, stacked_params, post_params,
     return lsum, tokens, d_sp, d_pp, d_h
 
 
+def _pvg_single_stage_aux(run_stage, post_loss_fn, stacked_params, post_params,
+                          hidden, extras, loss_batch, rng, aux_cotangent, M):
+    """S == 1 fallback for the fused executors when ``with_aux``: one vjp
+    under plain GSPMD, with the aux output's cotangent folded in.
+
+    Contract note: aux_sum spans L layers × M microbatches; the single-
+    stage path runs ONE full-batch pass (aux over L only), so aux scales
+    by M — the caller's /(L·M) normalization and the /(L·M) cotangent
+    then stay exact, and the value equals the gpipe S==1 aux/L mean."""
+
+    def whole(sp, pp, h):
+        y, aux = run_stage(sp, h, extras, rng)
+        ls, tk = post_loss_fn(pp, y, loss_batch)
+        return ls, tk, aux * M
+
+    (lsum, tokens, aux_sum), vjp = jax.vjp(
+        whole, stacked_params, post_params, hidden
+    )
+    # the aux output's cotangent IS the constant d(objective)/d(aux) —
+    # one vjp covers CE and load-balance gradients together
+    d_sp, d_pp, d_h = vjp((
+        jnp.ones((), lsum.dtype),
+        jnp.zeros((), tokens.dtype),
+        jnp.asarray(aux_cotangent, aux_sum.dtype),
+    ))
+    return lsum, tokens, d_sp, d_pp, d_h, aux_sum
+
+
 def _pvg_check_batch(B: int, mesh: Mesh, M: int, batch_axes) -> None:
     """Fail fast on a batch that doesn't divide into (batch shards ×
     microbatches) — run BEFORE the S==1 early return too, so a stage=1
@@ -828,27 +856,10 @@ def pipeline_value_and_grad(
     _pvg_check_batch(hidden.shape[0], mesh, M, batch_axes)
     if S == 1:
         if with_aux:
-            def whole(sp, pp, h):
-                y, aux = run_stage(sp, h, extras, rng)
-                ls, tk = post_loss_fn(pp, y, loss_batch)
-                # contract: aux_sum spans L layers × M microbatches.  The
-                # single-stage path runs ONE full-batch pass (aux over L
-                # only) — scale by M so the caller's /(L·M) normalization
-                # and the aux cotangent (also /(L·M)) stay exact; the
-                # value then equals the gpipe S==1 aux/L mean.
-                return ls, tk, aux * M
-
-            (lsum, tokens, aux_sum), vjp = jax.vjp(
-                whole, stacked_params, post_params, hidden
+            return _pvg_single_stage_aux(
+                run_stage, post_loss_fn, stacked_params, post_params,
+                hidden, extras, loss_batch, rng, aux_cotangent, M,
             )
-            # the aux output's cotangent IS the constant d(objective)/d(aux)
-            # — one vjp covers CE and load-balance gradients together
-            d_sp, d_pp, d_h = vjp((
-                jnp.ones((), lsum.dtype),
-                jnp.zeros((), tokens.dtype),
-                jnp.asarray(aux_cotangent, aux_sum.dtype),
-            ))
-            return lsum, tokens, d_sp, d_pp, d_h, aux_sum
         return _pvg_single_stage(
             run_stage, post_loss_fn, stacked_params, post_params,
             hidden, extras, loss_batch, rng,
@@ -1011,6 +1022,8 @@ def pipeline_value_and_grad_interleaved(
     seq_axis: str | None = None,
     extras_seq_dims: Any = None,
     loss_seq_dims: Any = None,
+    with_aux: bool = False,
+    aux_cotangent: jnp.ndarray | float = 0.0,
 ):
     """Interleaved (virtual-stage) 1F1B: each device runs ``virtual_stages``
     NON-CONTIGUOUS layer chunks, table-driven by a precomputed schedule
@@ -1026,7 +1039,10 @@ def pipeline_value_and_grad_interleaved(
     ``(c*S + s) * Lc .. + Lc``.  Same contract as
     ``pipeline_value_and_grad`` otherwise; ``virtual_stages=1`` is plain
     1F1B through the table machinery (the equivalence tests pin both
-    against the single-device step).
+    against the single-device step).  ``with_aux``/``aux_cotangent``:
+    same MoE contract as ``pipeline_value_and_grad`` — chunks emit their
+    aux sums and every chunk vjp takes the constant objective
+    coefficient as the aux output's cotangent.
     """
     from distributed_llms_example_tpu.parallel.interleave import (
         make_interleaved_schedule,
@@ -1036,9 +1052,20 @@ def pipeline_value_and_grad_interleaved(
     M = num_microbatches
     v = int(virtual_stages)
     L = jax.tree.leaves(stacked_params)[0].shape[0]
-    run_stage = _make_run_stage(layer_fn, checkpoint)
+    if with_aux and seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1:
+        raise ValueError(
+            "pipeline with_aux (MoE load-balance loss) does not compose with "
+            "sequence parallelism: per-shard router statistics would need "
+            "their own cross-sequence reduction"
+        )
+    run_stage = _make_run_stage(layer_fn, checkpoint, with_aux)
     _pvg_check_batch(hidden.shape[0], mesh, M, batch_axes)
     if S == 1:
+        if with_aux:
+            return _pvg_single_stage_aux(
+                run_stage, post_loss_fn, stacked_params, post_params,
+                hidden, extras, loss_batch, rng, aux_cotangent, M,
+            )
         return _pvg_single_stage(
             run_stage, post_loss_fn, stacked_params, post_params,
             hidden, extras, loss_batch, rng,
@@ -1096,7 +1123,10 @@ def pipeline_value_and_grad_interleaved(
                 lambda a: jax.lax.dynamic_index_in_dim(a, c_idx, 0, keepdims=False),
                 p_all,
             )
-            return run_stage(p_c, x.astype(compute_dtype), ex_c, k).astype(plumb_dtype)
+            out = run_stage(p_c, x.astype(compute_dtype), ex_c, k)
+            if with_aux:
+                return out[0].astype(plumb_dtype), out[1]
+            return out.astype(plumb_dtype)
 
         zeros_like_f32 = lambda t: jax.tree.map(  # noqa: E731
             lambda x: _vary(jnp.zeros(x.shape, jnp.float32), axes_all), t
@@ -1111,6 +1141,7 @@ def pipeline_value_and_grad_interleaved(
         d_pp = zeros_like_f32(pp)
         d_h = _vary(jnp.zeros((M, mb, *h.shape[1:]), jnp.float32), axes_all)
         scal0 = _vary(jnp.zeros((), jnp.float32), axes_all)
+        aux_ct = _vary(jnp.asarray(aux_cotangent, jnp.float32), axes_all)
         perm_fwd = [(i, (i + 1) % S) for i in range(S)]
         perm_bwd = [(i, (i - 1) % S) for i in range(S)]
 
@@ -1118,7 +1149,8 @@ def pipeline_value_and_grad_interleaved(
             return tbl[name][t, s_idx]
 
         def tick(carry, t):
-            fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, lsum, toks = carry
+            (fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, lsum, toks,
+             aux_acc) = carry
 
             # ---- queue arrivals (values sent on the rings last tick)
             af = at("arr_f", t)
@@ -1144,6 +1176,9 @@ def pipeline_value_and_grad_interleaved(
             x_in = jnp.where(fsrc < 0, x0, xq)
             ex_f = ex_at(fm)
             y = chunk_run(sp_v, fc, x_in, ex_f, chunk_key(fc, fm))
+            if with_aux:
+                y, aux_f = y
+                aux_acc = aux_acc + jnp.where(f_on, aux_f.astype(jnp.float32), 0.0)
             a_save = jnp.clip(at("f_save", t), 0, sc.act_depth - 1)
             act_upd = jax.lax.dynamic_update_index_in_dim(act, x_in, a_save, 0)
             act = jnp.where(f_on, act_upd, act)
@@ -1187,7 +1222,12 @@ def pipeline_value_and_grad_interleaved(
                 bqbuf, jnp.clip(bsrc, 0, sc.bq_depth - 1), 0, keepdims=False
             )
             dy_in = jnp.where(bsrc < 0, dy_loss.astype(plumb_dtype), dy_q)
-            d_sp_m, dx = chunk_vjp(dy_in)
+            if with_aux:
+                # constant objective coefficient on active backward ticks
+                # (see pipeline_value_and_grad)
+                d_sp_m, dx = chunk_vjp((dy_in, jnp.where(b_on, aux_ct, 0.0)))
+            else:
+                d_sp_m, dx = chunk_vjp(dy_in)
             d_sp = jax.tree.map(
                 lambda a_, g: a_ + jnp.where(b_on, g.astype(jnp.float32), 0.0),
                 d_sp, d_sp_m,
@@ -1201,24 +1241,31 @@ def pipeline_value_and_grad_interleaved(
             # ---- ring hops
             fwd_in = jax.lax.ppermute(y, axis_name, perm_fwd)
             bwd_in = jax.lax.ppermute(dx.astype(plumb_dtype), axis_name, perm_bwd)
-            return (fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, lsum, toks), None
+            return (fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, lsum, toks,
+                    aux_acc), None
 
-        carry = (fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, scal0, scal0)
+        carry = (fwd_in, bwd_in, fqbuf, bqbuf, act, d_sp, d_pp, d_h, scal0, scal0,
+                 scal0)
         carry, _ = jax.lax.scan(tick, carry, jnp.arange(sc.T))
-        d_sp, d_pp, d_h, lsum, toks = carry[5], carry[6], carry[7], carry[8], carry[9]
+        (d_sp, d_pp, d_h, lsum, toks, aux_acc) = (
+            carry[5], carry[6], carry[7], carry[8], carry[9], carry[10]
+        )
         # (v, Lc, ...) grads back to the sharded row layout first
         d_sp = jax.tree.map(
             lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]), d_sp
         )
-        return _pvg_body_epilogue(
+        out = _pvg_body_epilogue(
             lsum, toks, d_sp, d_pp, d_h, h_shape,
             axis_name=axis_name, axes_all=axes_all, seq_axis=seq_axis,
         )
+        if with_aux:
+            return (*out, jax.lax.psum(aux_acc, axis_name))
+        return out
 
     return _pvg_shard_map(
         body, mesh=mesh, axis_name=axis_name, axes_all=axes_all,
         seq_axis=seq_axis, n_seq=n_seq, stacked_params=stacked_params,
         post_params=post_params, hidden=hidden, extras=extras,
         loss_batch=loss_batch, rng=rng, extras_seq_dims=extras_seq_dims,
-        loss_seq_dims=loss_seq_dims,
+        loss_seq_dims=loss_seq_dims, with_aux=with_aux,
     )

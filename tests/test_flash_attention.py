@@ -290,3 +290,37 @@ def test_causal_cap_is_head_dim_dependent():
     # 592 = 16*37 tiles only above 512: causal+wide heads becomes eligible
     assert flash_supported(592, 592, 128, causal=True)
     assert not flash_supported(592, 592, 64, causal=True)
+
+
+def test_beam_grouped_attention_matches_replicated_kv():
+    """The beam-decode grouped path (K/V shared per row) must reproduce
+    plain attention on per-beam-replicated K/V exactly — same fp32
+    softmax, scale, bias conventions (ops/attention.py)."""
+    import jax.numpy as jnp
+
+    from distributed_llms_example_tpu.ops.attention import (
+        beam_grouped_attention,
+        dot_product_attention,
+    )
+
+    rng = np.random.RandomState(9)
+    B, G, H, Q, K, d = 3, 2, 4, 1, 16, 8
+    q = jnp.asarray(rng.randn(B * G, H, Q, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, K, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, K, d).astype(np.float32))
+    bias = jnp.asarray(
+        np.where(rng.rand(B, 1, 1, K) < 0.2, -1e9, 0.0).astype(np.float32)
+    )
+    # per-beam bias: each row's mask repeated per beam (the generation layout)
+    bias_rep = jnp.repeat(bias, G, axis=0)
+    k_rep = jnp.repeat(k, G, axis=0)
+    v_rep = jnp.repeat(v, G, axis=0)
+
+    ref = dot_product_attention(q, k_rep, v_rep, bias_rep)
+    got = beam_grouped_attention(q, k, v, bias_rep)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6, rtol=1e-6)
+    # unscaled + learned-bias variant (the T5 cross path)
+    lb = jnp.asarray(rng.randn(1, H, Q, K).astype(np.float32) * 0.1)
+    ref2 = dot_product_attention(q, k_rep, v_rep, bias_rep + lb, scale=1.0)
+    got2 = beam_grouped_attention(q, k, v, bias_rep, scale=1.0, learned_bias=lb)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(ref2), atol=1e-6, rtol=1e-6)

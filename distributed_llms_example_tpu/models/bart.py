@@ -292,6 +292,14 @@ class PipelinedBart:
                 f"seq2seq pipeline schedule {schedule!r}: must be gpipe or 1f1b "
                 "(interleaved virtual stages are decoder-only for now)"
             )
+        if (schedule == "1f1b" and mesh.shape.get("fsdp", 1) > 1
+                and mesh.shape.get("stage", 1) > 1):
+            # see parallel/pipeline_seq2seq.py: the partitioner crashes on
+            # the twin chunk-pair program with fsdp-sharded block params
+            raise ValueError(
+                "the fused seq2seq 1f1b schedule does not support fsdp>1; "
+                "use gpipe on fsdp×stage meshes, or tensor parallelism with 1f1b"
+            )
         stages = mesh.shape.get("stage", 1)
         for n, what in ((config.encoder_layers, "encoder"), (config.decoder_layers, "decoder")):
             if n % max(stages, 1):

@@ -37,7 +37,10 @@ Design — ONE pipeline of 2S chunks over S devices, table-driven:
   There the executor computes BOTH chunks and selects — collectives run
   uniformly on every device, at the honest price of one extra
   decoder-chunk-equivalent per tick (small next to the encoder chunk at
-  summarization shapes: tgt 128 vs src 1024).
+  summarization shapes: tgt 128 vs src 1024).  fsdp>1 is guarded off
+  entirely: the partitioner crashes compiling the chunk-pair program
+  with dim-0-sharded params under either dispatch mode (gpipe remains
+  the fsdp×stage path for seq2seq).
 - The enc→dec SEAM (device 0's decoder chunk): the decoder embedding
   enters from the microbatch store (like global chunk 0's input), an
   optional differentiable ``seam_fn`` (T5's encoder final-norm + dropout)
@@ -145,6 +148,19 @@ def pipeline_value_and_grad_seq2seq(
 
     S = mesh.shape.get(axis_name, 1)
     M = num_microbatches
+    if S > 1 and mesh.shape.get("fsdp", 1) > 1:
+        # the XLA SPMD partitioner SIGABRTs (no diagnostic) compiling this
+        # executor's chunk-pair program with dim-0-fsdp-sharded block
+        # params, under BOTH dispatch modes and with the param gather
+        # hoisted out of the branches — reproduced on XLA CPU, jax 0.9.
+        # The llama 1f1b executor (single chunk body, no pair) compiles
+        # fine on the same mesh, so this is specific to the twin shape.
+        # Until the compiler moves: seq2seq fsdp×stage uses gpipe.
+        raise ValueError(
+            "the fused seq2seq 1f1b schedule does not support fsdp>1 "
+            "(XLA partitioner crash); use --pipeline-schedule gpipe on "
+            "fsdp×stage meshes, or tensor parallelism with 1f1b"
+        )
     seam_params = {} if seam_params is None else seam_params
     diff_extras = {} if diff_extras is None else diff_extras
     for stacked, what in ((stacked_enc, "encoder"), (stacked_dec, "decoder")):

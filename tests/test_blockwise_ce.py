@@ -121,3 +121,42 @@ def test_train_step_with_fused_ce_matches_unfused():
     assert float(got["target_tokens"]) == float(ref["target_tokens"])
     assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
     assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+
+
+def test_fused_ce_mesh_and_family_validation(tmp_path):
+    """--fused-ce must fail loudly at Trainer startup on the compositions
+    it documents as unsupported (tensor/stage/sequence meshes, seq2seq
+    families) instead of silently degrading or being inert."""
+    from distributed_llms_example_tpu.core.config import (
+        CheckpointConfig,
+        MeshConfig,
+        TrainConfig,
+    )
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    records = [{"dialogue": "a b c d", "summary": "a b"} for _ in range(8)]
+    base = dict(
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=1,
+        max_source_length=32,
+        max_target_length=16,
+        pad_to_multiple=16,
+        tokenizer="byte",
+        fused_ce=True,
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+    )
+    with pytest.raises(ValueError, match="seq2seq"):
+        Trainer(
+            TrainConfig(model_ckpt="bart-test", mesh=MeshConfig(data=-1), **base),
+            train_records=records,
+        )
+    with pytest.raises(ValueError, match="tensor"):
+        Trainer(
+            TrainConfig(
+                model_ckpt="llama-test",
+                mesh=MeshConfig(data=2, fsdp=2, sequence=1, tensor=2),
+                **base,
+            ),
+            train_records=records,
+        )

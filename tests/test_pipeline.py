@@ -1170,7 +1170,24 @@ def test_moe_interleaved_equals_grad_accum_single_device():
         piped, cfg, tx, schedule, mesh_p, rules=rules, donate=False, is_seq2seq=False
     )
     step_p, _ = build_p(state_p)
-    _, got = step_p(state_p, put_batch(batch, mesh_p))
+    new_state_p, got = step_p(state_p, put_batch(batch, mesh_p))
 
     assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
     assert float(got["grad_norm"]) == pytest.approx(float(ref["grad_norm"]), rel=1e-4)
+    # per-layer router updates too: a row-permuted aux gradient would leave
+    # loss AND the global grad norm unchanged — unstack through the
+    # INTERLEAVED storage order and compare layer-for-layer
+    from distributed_llms_example_tpu.parallel.interleave import uninterleave_order
+
+    ref_state2, _ = step(state, put_batch(batch, mesh1))
+    upd = unstack_blocks(
+        jax.device_get(new_state_p.params),
+        row_order=uninterleave_order(cfg.num_hidden_layers, 2, 2),
+    )
+    ref_upd = jax.device_get(ref_state2.params)
+    for lyr in (f"block_{i}" for i in range(cfg.num_hidden_layers)):
+        np.testing.assert_allclose(
+            np.asarray(upd[lyr]["mlp"]["router"]["kernel"]),
+            np.asarray(ref_upd[lyr]["mlp"]["router"]["kernel"]),
+            atol=1e-5, rtol=1e-4,
+        )

@@ -295,3 +295,20 @@ def test_interleaved_still_rejected_for_seq2seq(tmp_path):
     )
     with pytest.raises(ValueError, match="interleaved"):
         Trainer(cfg.replace(pipeline_schedule="interleaved"), train_records=records)
+
+
+def test_bart_1f1b_rejects_fsdp():
+    """stage×fsdp with the twin 1f1b is guarded at construction: the XLA
+    partitioner SIGABRTs (no diagnostic) compiling the chunk-pair program
+    with dim-0-fsdp-sharded block params — under both dispatch modes and
+    with the param gather hoisted out of the branches.  gpipe remains the
+    fsdp×stage path for seq2seq; the guard turns a compiler crash into an
+    actionable startup error."""
+    cfg, _, _ = _tiny_bart()
+    from distributed_llms_example_tpu.models.bart import PipelinedBart
+
+    mesh_p = build_mesh(MeshConfig(stage=2, data=2, fsdp=2, sequence=1, tensor=1))
+    with pytest.raises(ValueError, match="fsdp"):
+        PipelinedBart(cfg, mesh_p, num_microbatches=2, schedule="1f1b")
+    # gpipe on the same mesh constructs fine
+    PipelinedBart(cfg, mesh_p, num_microbatches=2, schedule="gpipe")

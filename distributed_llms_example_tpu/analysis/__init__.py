@@ -1,0 +1,34 @@
+"""Static sharding analysis: lint passes over specs, lowered programs, and
+parallelism compositions.
+
+Declarative sharding fails silently or fatally — a typo'd PartitionSpec
+axis replicates a 7B parameter until HBM blows, and bad schedule × sharding
+compositions crash the XLA SPMD partitioner with no diagnostic (the
+seq2seq 1f1b × fsdp SIGABRT).  This package catches those classes of
+mistake from ABSTRACT inputs (ShapeDtypeStruct, no weights, CPU-safe):
+
+- ``spec_lint``    — pass 1: ShardingRules vs mesh vs abstract param tree
+- ``ir_lint``      — pass 2: smells in the compiled train-step HLO
+- ``composition``  — pass 3: the known-valid/known-bad (schedule ×
+                     sharding × family) table, also consulted by the
+                     pipeline adapters at construction
+- ``lint``         — the CLI gluing all three:
+                     ``python -m distributed_llms_example_tpu.analysis.lint``
+"""
+
+from distributed_llms_example_tpu.analysis.findings import Finding, has_errors
+from distributed_llms_example_tpu.analysis.composition import (
+    KNOWN_BAD,
+    check_composition,
+    reason_for,
+    validate_composition,
+)
+
+__all__ = [
+    "Finding",
+    "has_errors",
+    "KNOWN_BAD",
+    "check_composition",
+    "reason_for",
+    "validate_composition",
+]

@@ -1,0 +1,363 @@
+"""Pass 3 — the parallelism composition matrix.
+
+The repo used to guard bad (schedule × sharding × model-family) combos
+with ad-hoc ``raise`` statements scattered across the pipeline adapters,
+the trainer, and the seq2seq executor — commit ``ac1288e`` alone added
+three copies of the 1f1b×fsdp guard.  This module replaces them with ONE
+declarative table: a known-bad combo is a ``BadCombo`` row, the adapters
+call ``validate_composition`` at construction, the lint CLI calls
+``check_composition`` for findings, and a new bad pair discovered at scale
+is one table row — not another scatter of raises.
+
+Matching model: a combo row fires when ALL of its conditions hold —
+
+- ``schedules``:      pipeline schedule is one of these (None = any)
+- ``families``:       model family is one of these (None = any)
+- ``flags``:          every named flag is present.  Families imply flags
+                      (bart/t5 → ``seq2seq``, llama → ``causal``) so deep
+                      call sites that know the shape but not the family
+                      (parallel/pipeline_seq2seq.py) can still match.
+- ``axes_over_1``:    every listed mesh axis has size > 1
+- ``axes_any_over_1``: at least one listed mesh axis has size > 1
+
+Known flags: ``pipelined`` (a stage>1 pipeline adapter is in play),
+``seq2seq``/``causal`` (family shape), ``moe`` (config has routed
+experts), ``fused_ce`` (--fused-ce), ``ring`` (--attention-impl ring),
+``forced_dense_attention`` (--attention-impl xla/flash).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Mapping
+
+from distributed_llms_example_tpu.analysis.findings import Finding
+
+FAMILY_FLAGS: dict[str, tuple[str, ...]] = {
+    "bart": ("seq2seq",),
+    "t5": ("seq2seq",),
+    "llama": ("causal",),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class BadCombo:
+    id: str
+    reason: str
+    schedules: tuple[str, ...] | None = None
+    families: tuple[str, ...] | None = None
+    flags: tuple[str, ...] = ()
+    axes_over_1: tuple[str, ...] = ()
+    axes_any_over_1: tuple[str, ...] = ()
+
+    def matches(
+        self,
+        *,
+        family: str | None,
+        schedule: str | None,
+        mesh_axes: Mapping[str, int],
+        flags: frozenset[str],
+    ) -> bool:
+        if self.schedules is not None and schedule not in self.schedules:
+            return False
+        if self.families is not None and family not in self.families:
+            return False
+        if not set(self.flags) <= flags:
+            return False
+        if any(mesh_axes.get(a, 1) <= 1 for a in self.axes_over_1):
+            return False
+        if self.axes_any_over_1 and not any(
+            mesh_axes.get(a, 1) > 1 for a in self.axes_any_over_1
+        ):
+            return False
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class GoodCombo:
+    """A composition the test suite pins as working — documentation for
+    operators and the lint's source for "recognized" info findings."""
+
+    id: str
+    notes: str
+    schedules: tuple[str, ...] | None = None
+    flags: tuple[str, ...] = ()
+    axes: tuple[str, ...] = ()  # the axes this combo is validated to use
+
+
+# Ordering matters: ``validate_composition`` raises the FIRST matching
+# row's reason, so more specific rows go first.
+KNOWN_BAD: tuple[BadCombo, ...] = (
+    BadCombo(
+        id="seq2seq-1f1b-fsdp",
+        schedules=("1f1b",),
+        flags=("seq2seq",),
+        axes_over_1=("stage", "fsdp"),
+        reason=(
+            "the fused seq2seq 1f1b schedule does not support fsdp>1: the "
+            "XLA SPMD partitioner SIGABRTs (no diagnostic) compiling the "
+            "twin chunk-pair program with dim-0-fsdp-sharded block params; "
+            "use --pipeline-schedule gpipe on fsdp×stage meshes, or tensor "
+            "parallelism with 1f1b"
+        ),
+    ),
+    BadCombo(
+        id="seq2seq-interleaved",
+        schedules=("interleaved",),
+        flags=("seq2seq",),
+        reason=(
+            "--pipeline-schedule interleaved currently supports decoder-only "
+            "(llama) families only; the seq2seq families pipeline under "
+            "gpipe or the fused twin-pipeline 1f1b"
+        ),
+    ),
+    BadCombo(
+        id="seq2seq-pipeline-sequence",
+        flags=("seq2seq", "pipelined"),
+        axes_over_1=("sequence",),
+        reason=(
+            "the seq2seq pipeline (stage>1) does not compose with sequence "
+            "parallelism: ring attention for encoder/decoder stacks runs as "
+            "its own fully-manual shard_map, which does not nest inside the "
+            "pipeline's manual region"
+        ),
+    ),
+    BadCombo(
+        id="pipeline-sequence-moe",
+        flags=("pipelined", "moe"),
+        axes_over_1=("sequence",),
+        reason=(
+            "pipeline MoE (load-balance aux loss) does not compose with "
+            "sequence parallelism: per-shard router statistics would need "
+            "their own cross-sequence reduction"
+        ),
+    ),
+    BadCombo(
+        id="fused-ce-seq2seq",
+        flags=("fused_ce", "seq2seq"),
+        reason=(
+            "--fused-ce supports causal (decoder-only) families; seq2seq "
+            "models compute their loss from decoder logits directly"
+        ),
+    ),
+    BadCombo(
+        id="fused-ce-model-axes",
+        flags=("fused_ce",),
+        axes_any_over_1=("tensor", "stage", "sequence"),
+        reason=(
+            "--fused-ce does not compose with tensor/stage/sequence mesh "
+            "axes: the vocab-chunked LM head wants an unsharded vocab dim "
+            "and the standard (non-pipelined) loss path; use data/fsdp axes "
+            "or drop the flag"
+        ),
+    ),
+    BadCombo(
+        id="ring-seq2seq-pipeline",
+        flags=("ring", "seq2seq", "pipelined"),
+        reason=(
+            "--attention-impl ring composes with stage>1 only for the llama "
+            "family (ONE manual region over {stage, sequence}); the seq2seq "
+            "families run ring as its own fully-manual shard_map, which "
+            "does not nest"
+        ),
+    ),
+    BadCombo(
+        id="dense-attention-stage-sequence",
+        flags=("forced_dense_attention", "pipelined"),
+        families=("llama",),
+        axes_over_1=("stage", "sequence"),
+        reason=(
+            "--attention-impl xla/flash cannot run on a stage×sequence mesh "
+            "(the pipeline's manual region executes ring attention only); "
+            "use auto or ring"
+        ),
+    ),
+)
+
+# The combinations the test suite pins as working (tests/test_pipeline*.py,
+# tests/test_train_step.py).  A requested combo matching neither table gets
+# a "composition-unproven" warning from the lint — not an error: absence of
+# evidence is a prompt to add a row, not a crash claim.
+KNOWN_GOOD: tuple[GoodCombo, ...] = (
+    GoodCombo(
+        id="gspmd-data-fsdp-tensor-expert",
+        axes=("data", "fsdp", "tensor", "expert"),
+        notes="no pipeline: GSPMD partitions everything (all families)",
+    ),
+    GoodCombo(
+        id="sequence-parallel-unpipelined",
+        axes=("data", "fsdp", "sequence", "tensor"),
+        notes="ring/context parallelism without stages (all families)",
+    ),
+    GoodCombo(
+        id="gpipe-all-families",
+        schedules=("gpipe",),
+        axes=("stage", "data", "fsdp", "tensor", "expert"),
+        notes="gpipe composes with data/fsdp/tensor/expert (MoE aux rides "
+              "out of the pipeline as an explicit output)",
+    ),
+    GoodCombo(
+        id="1f1b-llama",
+        schedules=("1f1b",),
+        flags=("causal",),
+        axes=("stage", "data", "fsdp", "tensor", "sequence"),
+        notes="fused 1f1b, single chunk body: full axis composition",
+    ),
+    GoodCombo(
+        id="1f1b-seq2seq-tensor",
+        schedules=("1f1b",),
+        flags=("seq2seq",),
+        axes=("stage", "data", "tensor"),
+        notes="twin-pipeline 1f1b: data/tensor compose; fsdp is the "
+              "known-bad row seq2seq-1f1b-fsdp",
+    ),
+    GoodCombo(
+        id="interleaved-llama",
+        schedules=("interleaved",),
+        flags=("causal",),
+        axes=("stage", "data", "fsdp", "tensor"),
+        notes="virtual-stage 1f1b, stage >= 2, decoder-only",
+    ),
+)
+
+
+def config_flags(
+    *,
+    pipelined: bool,
+    fused_ce: bool = False,
+    attention_impl: str = "",
+    num_experts: int = 0,
+) -> set[str]:
+    """Derive the composition-matrix flags from run configuration — the
+    ONE mapping from config knobs to table flags, shared by the Trainer's
+    startup validation and the lint CLI so they can never disagree about
+    which combos are bad."""
+    flags: set[str] = set()
+    if pipelined:
+        flags.add("pipelined")
+    if fused_ce:
+        flags.add("fused_ce")
+    if num_experts > 0:
+        flags.add("moe")
+    if attention_impl == "ring":
+        flags.add("ring")
+    elif attention_impl in ("xla", "flash"):
+        flags.add("forced_dense_attention")
+    return flags
+
+
+def effective_flags(family: str | None, flags: Iterable[str] = ()) -> frozenset[str]:
+    out = set(flags)
+    out.update(FAMILY_FLAGS.get(family or "", ()))
+    return frozenset(out)
+
+
+def failing_combos(
+    *,
+    family: str | None = None,
+    schedule: str | None = None,
+    mesh_axes: Mapping[str, int],
+    flags: Iterable[str] = (),
+) -> list[BadCombo]:
+    eff = effective_flags(family, flags)
+    return [
+        row
+        for row in KNOWN_BAD
+        if row.matches(family=family, schedule=schedule, mesh_axes=mesh_axes, flags=eff)
+    ]
+
+
+def reason_for(combo_id: str) -> str:
+    """The table's message for a row id — deep guards (e.g. the seq2seq
+    executor, which knows the shape but not the family) raise this text so
+    the message cannot drift from the table."""
+    for row in KNOWN_BAD:
+        if row.id == combo_id:
+            return row.reason
+    raise KeyError(f"no known-bad combo {combo_id!r}")
+
+
+def validate_composition(
+    *,
+    family: str | None = None,
+    schedule: str | None = None,
+    mesh_axes: Mapping[str, int],
+    flags: Iterable[str] = (),
+) -> None:
+    """Raise ValueError with the first failing row's reason — the adapter-
+    construction entry point (PipelinedLlama/Bart/T5, Trainer)."""
+    bad = failing_combos(
+        family=family, schedule=schedule, mesh_axes=mesh_axes, flags=flags
+    )
+    if bad:
+        raise ValueError(bad[0].reason)
+
+
+def check_composition(
+    *,
+    family: str | None = None,
+    schedule: str | None = None,
+    mesh_axes: Mapping[str, int],
+    flags: Iterable[str] = (),
+) -> list[Finding]:
+    """The lint entry point: every failing row becomes an error finding;
+    a pipelined combo matching no good row gets an unproven warning."""
+    eff = effective_flags(family, flags)
+    findings = [
+        Finding(
+            severity="error",
+            pass_name="composition",
+            code=row.id,
+            message=row.reason,
+            context={
+                "family": family,
+                "schedule": schedule,
+                "mesh": dict(mesh_axes),
+            },
+        )
+        for row in failing_combos(
+            family=family, schedule=schedule, mesh_axes=mesh_axes, flags=flags
+        )
+    ]
+    if findings or mesh_axes.get("stage", 1) <= 1:
+        return findings
+
+    def good_matches(row: GoodCombo) -> bool:
+        if row.schedules is not None and schedule not in row.schedules:
+            return False
+        if not set(row.flags) <= eff:
+            return False
+        # every mesh axis actually in use must be one the row vouches for
+        used = {a for a, n in mesh_axes.items() if n > 1}
+        return used <= set(row.axes)
+
+    matched = [row for row in KNOWN_GOOD if good_matches(row)]
+    if matched:
+        findings.append(
+            Finding(
+                severity="info",
+                pass_name="composition",
+                code="composition-recognized",
+                message=f"combo matches known-good row {matched[0].id!r}: {matched[0].notes}",
+                context={"good_id": matched[0].id},
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                severity="warning",
+                pass_name="composition",
+                code="composition-unproven",
+                message=(
+                    "requested schedule × sharding × family combo matches no "
+                    "known-good table row; it may work, but nothing pins it — "
+                    "add a KNOWN_GOOD row once validated"
+                ),
+                context={
+                    "family": family,
+                    "schedule": schedule,
+                    "mesh": dict(mesh_axes),
+                },
+            )
+        )
+    return findings

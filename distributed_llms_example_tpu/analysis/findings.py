@@ -1,0 +1,75 @@
+"""Finding: the unit of lint output.
+
+Every pass produces a flat list of Findings; the CLI serializes them one
+JSON line per finding (the Valohai metadata convention, utils/jsonlog.py)
+so CI can grep ``"severity": "error"`` and operators can read the same
+stream humans do.  Severity contract:
+
+- ``error``   — will crash, hang, or silently waste HBM at scale (unknown
+                axis, oversized replicated param, known-bad composition).
+                Nonzero CLI exit.
+- ``warning`` — smells that are sometimes intentional (dead rules, ragged
+                fallbacks, IR promotion chains).  Nonzero exit only under
+                ``--strict``.
+- ``info``    — context the operator should see (pass skipped, collective
+                census).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Mapping
+
+SEVERITIES: tuple[str, ...] = ("error", "warning", "info")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    severity: str
+    pass_name: str  # "spec" | "ir" | "composition" | "cli"
+    code: str  # stable machine-readable slug, e.g. "unknown-mesh-axis"
+    message: str
+    context: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity {self.severity!r} not in {SEVERITIES}"
+            )
+
+    def to_json(self) -> dict:
+        out = {
+            "event": "lint_finding",
+            "severity": self.severity,
+            "pass": self.pass_name,
+            "code": self.code,
+            "message": self.message,
+        }
+        out.update(self.context)
+        return out
+
+    def render(self) -> str:
+        return f"{self.severity}: [{self.pass_name}/{self.code}] {self.message}"
+
+
+def has_errors(findings: Iterable[Finding]) -> bool:
+    return any(f.severity == "error" for f in findings)
+
+
+def count_by_severity(findings: Iterable[Finding]) -> dict[str, int]:
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] += 1
+    return counts
+
+
+def emit(findings: Iterable[Finding], *, as_json: bool, file=None) -> None:
+    """Print findings, one per line: JSON lines (``log_json``, process-0
+    gated like every other metadata producer) or the human rendering."""
+    from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+    for f in findings:
+        if as_json:
+            log_json(f.to_json(), file=file)
+        else:
+            print(f.render(), file=file)

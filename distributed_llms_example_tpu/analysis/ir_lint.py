@@ -1,0 +1,291 @@
+"""Pass 2 — lint the COMPILED train-step program for sharding smells.
+
+The spec lint (pass 1) checks what the operator *declared*; this pass
+checks what the compiler actually *built*.  The train step is lowered and
+compiled ahead-of-time from abstract ShapeDtypeStruct arguments — no
+weights are ever materialized (the same AOT plumbing as
+utils/memory_audit.py) — and the post-optimization HLO text is scanned:
+
+- ``full-param-all-gather``: an all-gather materializing ≥ threshold bytes
+  on a mesh with NO model-sharding axes (pure data parallel keeps params
+  replicated — any big gather is GSPMD resharding churn; error), or a
+  gather ≥ 2× the largest single parameter on an fsdp mesh (the prefetch
+  path gathers one param at a time; a mega-gather means XLA fused a
+  whole-tree gather and the memory cliff is back; warning).
+- ``bf16-matmul-promoted-to-f32``: a ``convert`` promoting a bf16 value to
+  f32 that then feeds a ``dot`` — the hot-path precision-policy violation
+  (core/precision.py supplies the (from, to) pair, so the pattern follows
+  the ACTIVE policy).  fp32 *accumulation* of a bf16 dot is fine and not
+  matched.
+- ``degenerate-collective``: a collective whose replica groups are all
+  singletons (or a self-loop collective-permute) — traffic over an axis
+  the config says is size 1; usually a spec naming an axis the mesh
+  doesn't actually split.
+
+The text scanner is pure (string in, findings out) so tests can seed
+violations deterministically; the compile driver wraps it.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Mapping
+
+from distributed_llms_example_tpu.analysis.findings import Finding
+
+# HLO element-type byte widths (only what transformer programs produce).
+_ITEMSIZE = {
+    "f64": 8, "s64": 8, "u64": 8,
+    "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+# `  %name = f32[8,128]{1,0} opcode(...operands...)` — also matches
+# layout-less and scalar forms; ROOT prefix optional.
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<dtype>[a-z]\w*)\[(?P<dims>[0-9,]*)\]\S*\s+"
+    r"(?P<op>[\w\-]+)\("
+)
+# Async collective forms define a TUPLE: `%ags = (bf16[..], bf16[..])
+# all-gather-start(...)` — the shape regex above cannot parse the leading
+# paren, so tuple defs get their own pattern; the per-element shapes are
+# re-parsed with _TUPLE_ELEM_RE (max element ≈ the gathered result size).
+_TUPLE_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"\((?P<elems>[^)]*)\)\s+"
+    r"(?P<op>[\w\-]+)\("
+)
+_TUPLE_ELEM_RE = re.compile(r"([a-z]\w*)\[([0-9,]*)\]")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\s*\{[^}]*\})*)\}")
+_SOURCE_TARGET_RE = re.compile(r"source_target_pairs=\{(\{[^}]*\}(?:,\s*\{[^}]*\})*)\}")
+
+_COLLECTIVE_OPS = (
+    "all-gather", "all-gather-start", "all-reduce", "all-reduce-start",
+    "reduce-scatter", "all-to-all", "collective-permute",
+    "collective-permute-start",
+)
+
+
+def _bytes_of(dtype: str, dims: str) -> int:
+    shape = [int(d) for d in dims.split(",") if d]
+    return int(math.prod(shape)) * _ITEMSIZE.get(dtype, 4)
+
+
+def scan_hlo_text(
+    hlo_text: str,
+    *,
+    mesh_axes: Mapping[str, int],
+    promotion_smell: tuple[str, str] | None = None,
+    largest_param_bytes: int = 0,
+    gather_bytes_threshold: int = 16 * 1024**2,
+) -> list[Finding]:
+    """Scan post-optimization HLO text.  Pure function of the text."""
+    findings: list[Finding] = []
+    defs: dict[str, tuple[str, str, str]] = {}  # name -> (dtype, dims, op)
+    sizes: dict[str, int] = {}  # name -> result bytes (max element for tuples)
+    operands: dict[str, list[str]] = {}
+    lines = hlo_text.splitlines()
+    for line in lines:
+        m = _DEF_RE.match(line)
+        if m:
+            name = m.group("name")
+            defs[name] = (m.group("dtype"), m.group("dims"), m.group("op"))
+            sizes[name] = _bytes_of(m.group("dtype"), m.group("dims"))
+            operands[name] = _OPERAND_RE.findall(line[m.end():])
+            continue
+        t = _TUPLE_DEF_RE.match(line)
+        if t:
+            name = t.group("name")
+            elems = _TUPLE_ELEM_RE.findall(t.group("elems"))
+            dt, dims = elems[0] if elems else ("f32", "")
+            defs[name] = (dt, dims, t.group("op"))
+            sizes[name] = max(
+                (_bytes_of(d, s) for d, s in elems), default=0
+            )
+            operands[name] = _OPERAND_RE.findall(line[t.end():])
+
+    model_sharded = any(
+        mesh_axes.get(a, 1) > 1 for a in ("fsdp", "tensor", "expert", "stage")
+    )
+
+    # ---- all-gather size accounting ------------------------------------
+    gathers = [
+        (name, sizes[name])
+        for name, (_, _, op) in defs.items()
+        if op in ("all-gather", "all-gather-start")
+    ]
+    big = [(n, b) for n, b in gathers if b >= gather_bytes_threshold]
+    if big and not model_sharded:
+        worst = max(big, key=lambda t: t[1])
+        findings.append(Finding(
+            severity="error",
+            pass_name="ir",
+            code="full-param-all-gather",
+            message=(
+                f"{len(big)} all-gather(s) materialize ≥ "
+                f"{gather_bytes_threshold / 1024**2:.0f} MiB (largest "
+                f"{worst[1] / 1024**2:.1f} MiB at %{worst[0]}) on a mesh with "
+                "no model-sharding axes — params should already be "
+                "replicated; this is GSPMD resharding churn from a spec "
+                "mismatch"
+            ),
+            context={"count": len(big), "max_bytes": worst[1]},
+        ))
+    elif largest_param_bytes and gathers:
+        mega = [(n, b) for n, b in gathers if b > 2 * largest_param_bytes]
+        if mega:
+            worst = max(mega, key=lambda t: t[1])
+            findings.append(Finding(
+                severity="warning",
+                pass_name="ir",
+                code="fused-mega-all-gather",
+                message=(
+                    f"an all-gather materializes {worst[1] / 1024**2:.1f} MiB "
+                    f"(> 2× the largest single parameter, "
+                    f"{largest_param_bytes / 1024**2:.1f} MiB) at %{worst[0]} "
+                    "— the fsdp prefetch path gathers one param at a time; a "
+                    "fused whole-tree gather brings the replicated-memory "
+                    "cliff back"
+                ),
+                context={"count": len(mega), "max_bytes": worst[1]},
+            ))
+
+    # ---- precision policy: convert(from→to) feeding a dot --------------
+    if promotion_smell is not None:
+        src_dt, dst_dt = promotion_smell
+        promoted = {
+            name
+            for name, (dt, _, op) in defs.items()
+            if op == "convert"
+            and dt == dst_dt
+            and any(defs.get(o, ("",))[0] == src_dt for o in operands[name])
+        }
+        bad_dots = [
+            name
+            for name, (_, _, op) in defs.items()
+            if op == "dot" and any(o in promoted for o in operands[name])
+        ]
+        if bad_dots:
+            findings.append(Finding(
+                severity="warning",
+                pass_name="ir",
+                code="matmul-precision-promotion",
+                message=(
+                    f"{len(bad_dots)} dot(s) consume operands promoted "
+                    f"{src_dt}→{dst_dt} (e.g. %{bad_dots[0]}) — hot-path "
+                    f"matmuls should run in {src_dt} per the precision "
+                    f"policy; {dst_dt} is for reductions"
+                ),
+                context={"count": len(bad_dots), "instructions": bad_dots[:8]},
+            ))
+
+    # ---- degenerate collectives ----------------------------------------
+    degenerate: list[str] = []
+    for line in lines:
+        m = _DEF_RE.match(line) or _TUPLE_DEF_RE.match(line)
+        if not m or m.group("op") not in _COLLECTIVE_OPS:
+            continue
+        rg = _REPLICA_GROUPS_RE.search(line)
+        if rg:
+            groups = re.findall(r"\{([^}]*)\}", rg.group(1) if "{" in rg.group(1) else rg.group(0))
+            if groups and all(len([x for x in g.split(",") if x.strip()]) <= 1 for g in groups):
+                degenerate.append(m.group("name"))
+                continue
+        st = _SOURCE_TARGET_RE.search(line)
+        if st:
+            pairs = re.findall(r"\{(\d+),\s*(\d+)\}", st.group(0))
+            if pairs and all(a == b for a, b in pairs):
+                degenerate.append(m.group("name"))
+    if degenerate:
+        findings.append(Finding(
+            severity="warning",
+            pass_name="ir",
+            code="degenerate-collective",
+            message=(
+                f"{len(degenerate)} collective(s) have singleton replica "
+                f"groups / self-loop permutes (e.g. %{degenerate[0]}) — "
+                "communication over an axis of size 1; usually a spec names "
+                "an axis the mesh does not actually split"
+            ),
+            context={"count": len(degenerate), "instructions": degenerate[:8]},
+        ))
+
+    # ---- census ---------------------------------------------------------
+    census: dict[str, int] = {}
+    for _, (_, _, op) in defs.items():
+        if op in _COLLECTIVE_OPS:
+            census[op] = census.get(op, 0) + 1
+    findings.append(Finding(
+        severity="info",
+        pass_name="ir",
+        code="collective-census",
+        message=(
+            "collectives in the compiled step: "
+            + (", ".join(f"{k}×{v}" for k, v in sorted(census.items())) or "none")
+        ),
+        context={"census": census},
+    ))
+    return findings
+
+
+def lint_train_step(
+    model_name: str,
+    *,
+    mesh_config: Any = None,
+    global_batch: int = 8,
+    src_len: int = 1024,
+    tgt_len: int = 128,
+    dtype: str = "bfloat16",
+    remat: bool = False,
+    grad_accum_steps: int = 1,
+    gather_bytes_threshold: int = 16 * 1024**2,
+) -> list[Finding]:
+    """AOT-compile the sharded train step from abstract args and scan it.
+
+    Needs a real device mesh (the SPMD partitioner inserts the collectives
+    this pass looks for at compile time); callers skip the pass when the
+    requested mesh exceeds the attached device count.
+    """
+    import jax
+
+    from distributed_llms_example_tpu.core.config import MeshConfig
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.core.precision import Policy, parse_dtype
+    from distributed_llms_example_tpu.utils.memory_audit import (
+        aot_compile_train_step,
+    )
+
+    mesh = build_mesh(mesh_config or MeshConfig())
+    # the ONE abstract-compile recipe, shared with the memory audit so the
+    # program linted here is the program audited there
+    compiled, lm, a_params, _, _ = aot_compile_train_step(
+        model_name, mesh,
+        global_batch=global_batch, src_len=src_len, tgt_len=tgt_len,
+        dtype=dtype, remat=remat, grad_accum_steps=grad_accum_steps,
+    )
+    text = compiled.as_text()
+    largest_param = max(
+        (int(math.prod(x.shape)) * x.dtype.itemsize for x in jax.tree.leaves(a_params)),
+        default=0,
+    )
+    policy = Policy(compute_dtype=parse_dtype(dtype))
+    return scan_hlo_text(
+        text,
+        mesh_axes=dict(mesh.shape),
+        promotion_smell=policy.matmul_promotion_smell(),
+        largest_param_bytes=largest_param,
+        gather_bytes_threshold=gather_bytes_threshold,
+    )
+
+
+def skipped(reason: str) -> list[Finding]:
+    return [Finding(
+        severity="info",
+        pass_name="ir",
+        code="ir-pass-skipped",
+        message=f"lowered-program lint skipped: {reason}",
+    )]

@@ -1,0 +1,248 @@
+"""The sharding-lint CLI — all three analysis passes from abstract inputs.
+
+    python -m distributed_llms_example_tpu.analysis.lint \
+        --model llama-2-7b --mesh fsdp=8 [--strict] [--json] [--no-ir]
+
+Runs entirely CPU-safe: the model is resolved to abstract shapes
+(``load_weights=False`` + ``eval_shape``), no parameter is ever
+materialized.  Output is one finding per line (JSON lines with ``--json``,
+reusing utils/jsonlog.py).  Exit status: nonzero when any ``error``
+finding is present — or any ``warning`` too under ``--strict`` — so the
+command slots straight into CI next to the memory audit.
+
+The same passes run at trainer startup (launch/cli.py, ``--lint warn`` by
+default) so an interactive run sees its typo'd spec before spending
+minutes compiling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any
+
+from distributed_llms_example_tpu.analysis import composition, ir_lint, spec_lint
+from distributed_llms_example_tpu.analysis.findings import (
+    Finding,
+    count_by_severity,
+    emit,
+    has_errors,
+)
+
+
+def _resolve_axis_sizes(mesh_cfg: Any) -> dict[str, int]:
+    """Axis sizes without touching devices: wildcards resolve against the
+    attached device count when the product works out, else to 1 (the lint
+    cares about the DECLARED sharding, not placement)."""
+    import jax
+
+    sizes = dict(mesh_cfg.axis_sizes())
+    fixed = 1
+    for v in sizes.values():
+        if v != -1:
+            fixed *= max(v, 1)
+    n_dev = jax.device_count()
+    for k, v in sizes.items():
+        if v == -1:
+            sizes[k] = max(1, n_dev // fixed) if n_dev % max(fixed, 1) == 0 else 1
+    return sizes
+
+
+def _parse_rules_json(text: str):
+    """``[["pattern", ["fsdp", ["tensor", "expert"], null]], ...]`` →
+    ShardingRules.  Lets operators lint a candidate rule set (or seed a
+    violation in tests) without editing code."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llms_example_tpu.parallel.sharding import ShardingRules
+
+    def entry(e):
+        return tuple(e) if isinstance(e, list) else e
+
+    raw = json.loads(text)
+    return ShardingRules(rules=[(pat, P(*[entry(e) for e in spec])) for pat, spec in raw])
+
+
+def run_passes(
+    *,
+    model: str,
+    mesh_cfg: Any,
+    schedule: str = "gpipe",
+    rules: Any = None,
+    fused_ce: bool = False,
+    attention_impl: str = "",
+    replicated_bytes_threshold: int = spec_lint.DEFAULT_REPLICATED_BYTES_THRESHOLD,
+    run_ir: bool = True,
+    global_batch: int = 8,
+    src_len: int = 1024,
+    tgt_len: int = 128,
+    dtype: str = "bfloat16",
+    remat: bool = False,
+) -> list[Finding]:
+    """The three passes over one (model, mesh, config) triple."""
+    import jax
+
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.parallel.sharding import default_rules
+
+    findings: list[Finding] = []
+    try:
+        lm = load_model(model, load_weights=False)
+    except ValueError as e:
+        return [Finding("error", "cli", "unknown-model", str(e))]
+    axis_sizes = _resolve_axis_sizes(mesh_cfg)
+
+    # Pass 1 — spec lint over the abstract param tree
+    a_params = jax.eval_shape(lambda: lm.init_params(0))
+    findings += spec_lint.lint_sharding_rules(
+        rules if rules is not None else default_rules(),
+        axis_sizes,
+        a_params,
+        replicated_bytes_threshold=replicated_bytes_threshold,
+    )
+
+    # Pass 3 — composition matrix (cheap; run before the compile pass so a
+    # known-crash combo is reported even when the compile would die)
+    pipelined = axis_sizes.get("stage", 1) > 1
+    findings += composition.check_composition(
+        family=lm.family,
+        schedule=schedule if pipelined else None,
+        mesh_axes=axis_sizes,
+        flags=composition.config_flags(
+            pipelined=pipelined,
+            fused_ce=fused_ce,
+            attention_impl=attention_impl,
+            num_experts=int(getattr(lm.config, "num_experts", 0) or 0),
+        ),
+    )
+
+    # Pass 2 — lowered-program lint (needs real devices for the SPMD
+    # partitioner; also meaningless for combos pass 3 already condemned)
+    if not run_ir:
+        findings += ir_lint.skipped("--no-ir")
+    elif has_errors(findings):
+        findings += ir_lint.skipped("spec/composition errors make the compile moot")
+    elif pipelined:
+        findings += ir_lint.skipped(
+            "stage>1 pipelines lower through shard_map schedules; IR smell "
+            "patterns for them are an open ROADMAP item"
+        )
+    else:
+        mesh_size = 1
+        for v in axis_sizes.values():
+            mesh_size *= v
+        if mesh_size > jax.device_count():
+            findings += ir_lint.skipped(
+                f"mesh size {mesh_size} exceeds attached device count "
+                f"{jax.device_count()} (run under "
+                f"--xla_force_host_platform_device_count={mesh_size})"
+            )
+        else:
+            from distributed_llms_example_tpu.core.config import MeshConfig
+
+            findings += ir_lint.lint_train_step(
+                model,
+                mesh_config=MeshConfig(**axis_sizes),
+                global_batch=global_batch,
+                src_len=src_len,
+                tgt_len=tgt_len,
+                dtype=dtype,
+                remat=remat,
+            )
+    return findings
+
+
+def startup_lint(cfg: Any) -> list[Finding]:
+    """Trainer-startup surface (launch/cli.py): passes 1 and 3 from the
+    resolved TrainConfig — no AOT compile, milliseconds not minutes."""
+    return run_passes(
+        model=cfg.model_ckpt,
+        mesh_cfg=cfg.mesh,
+        schedule=cfg.pipeline_schedule,
+        fused_ce=cfg.fused_ce,
+        attention_impl=cfg.attention_impl,
+        run_ir=False,
+        dtype=cfg.compute_dtype,
+        remat=cfg.remat,
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dllm-lint",
+        description="static sharding analysis over specs, lowered programs, "
+                    "and parallelism compositions",
+    )
+    p.add_argument("--model", required=True, help="registry name or local HF checkpoint dir")
+    p.add_argument("--mesh", type=str, default="data=-1", help="comma list axis=size")
+    p.add_argument("--pipeline-schedule", type=str, default="gpipe",
+                   choices=("gpipe", "1f1b", "interleaved"))
+    p.add_argument("--fused-ce", action="store_true")
+    p.add_argument("--attention-impl", type=str, default="",
+                   choices=("", "auto", "flash", "ring", "xla"))
+    p.add_argument("--rules-json", type=str, default="",
+                   help='lint this rule set instead of the defaults: '
+                        '[["pattern", ["fsdp", null]], ...]')
+    p.add_argument("--replicated-bytes-threshold", type=int,
+                   default=spec_lint.DEFAULT_REPLICATED_BYTES_THRESHOLD)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--src-len", type=int, default=1024)
+    p.add_argument("--tgt-len", type=int, default=128)
+    p.add_argument("--dtype", type=str, default="bfloat16")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--no-ir", action="store_true",
+                   help="skip the lowered-program pass (no AOT compile)")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also fail the run")
+    p.add_argument("--json", action="store_true", help="JSON-lines output")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    findings: list[Finding] = []
+    mesh_cfg = rules = None
+    try:
+        from distributed_llms_example_tpu.core.config import parse_mesh_arg
+
+        mesh_cfg = parse_mesh_arg(args.mesh)
+    except ValueError as e:
+        findings.append(Finding("error", "cli", "unknown-mesh-axis", str(e)))
+    if args.rules_json:
+        try:
+            rules = _parse_rules_json(args.rules_json)
+        except (ValueError, TypeError) as e:
+            findings.append(Finding("error", "cli", "bad-rules-json", str(e)))
+    if not findings:
+        findings = run_passes(
+            model=args.model,
+            mesh_cfg=mesh_cfg,
+            schedule=args.pipeline_schedule,
+            rules=rules,
+            fused_ce=args.fused_ce,
+            attention_impl=args.attention_impl,
+            replicated_bytes_threshold=args.replicated_bytes_threshold,
+            run_ir=not args.no_ir,
+            global_batch=args.batch,
+            src_len=args.src_len,
+            tgt_len=args.tgt_len,
+            dtype=args.dtype,
+            remat=args.remat,
+        )
+    emit(findings, as_json=args.json)
+    counts = count_by_severity(findings)
+    if args.json:
+        from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+        log_json({"event": "lint_summary", **counts})
+    else:
+        print(
+            f"lint: {counts['error']} error(s), {counts['warning']} "
+            f"warning(s), {counts['info']} info"
+        )
+    failed = counts["error"] > 0 or (args.strict and counts["warning"] > 0)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,200 @@
+"""Pass 1 — lint ShardingRules against the mesh and an abstract param tree.
+
+Runs entirely from ShapeDtypeStructs: no weights, no devices, CPU-safe.
+Catches the failure classes a typo'd rule produces at scale:
+
+- an axis name not in the mesh (``P("tensro", ...)``) — jax surfaces this
+  as an opaque KeyError at device_put time, after minutes of setup;
+- the same axis used twice in one spec (undivisible by construction);
+- a rule regex that matches no parameter path — the params it meant to
+  shard silently fall through to the replicated default;
+- a parameter above ``replicated_bytes_threshold`` that ends up fully
+  replicated on a mesh that HAS model-sharding axes to offer — the
+  "typo'd spec replicates a 7B weight until HBM blows" case;
+- spec'd dims the mesh cannot divide (``divisible_spec`` replicates them
+  at runtime with one log line; the lint says so up front).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping
+
+from distributed_llms_example_tpu.analysis.findings import Finding
+from distributed_llms_example_tpu.core.config import AXES
+
+# Replicating anything past this on a model-sharded mesh is flagged as an
+# error: 16 MiB is far above every legitimate replicated leaf (norm scales,
+# biases, small position tables) and far below any transformer matmul
+# weight at 7B scale (a llama-2-7b attention kernel is 64 MiB in fp32).
+DEFAULT_REPLICATED_BYTES_THRESHOLD = 16 * 1024**2
+
+# Axes whose purpose is splitting the MODEL (params/optimizer state);
+# ``data`` replicates params by design, so a pure-DP mesh never triggers
+# the oversized-replicated check.
+MODEL_SHARDING_AXES = ("fsdp", "tensor", "expert", "stage")
+
+
+def _spec_axes(spec) -> list[str]:
+    """Flat axis names referenced by a PartitionSpec."""
+    out: list[str] = []
+    for entry in spec:
+        if entry is None:
+            continue
+        out.extend(entry if isinstance(entry, tuple) else (entry,))
+    return out
+
+
+def _leaf_bytes(leaf: Any) -> int:
+    shape = tuple(getattr(leaf, "shape", ()))
+    dtype = getattr(leaf, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", 4) if dtype is not None else 4
+    return int(math.prod(shape)) * itemsize
+
+
+def lint_sharding_rules(
+    rules: Any,
+    mesh_axes: Mapping[str, int],
+    params: Any,
+    *,
+    replicated_bytes_threshold: int = DEFAULT_REPLICATED_BYTES_THRESHOLD,
+) -> list[Finding]:
+    """Lint ``rules`` (a ShardingRules) against axis sizes and an abstract
+    param tree (ShapeDtypeStruct leaves are fine)."""
+    from distributed_llms_example_tpu.core.config import unknown_axis_error
+    from distributed_llms_example_tpu.parallel.sharding import (
+        _clip_spec,
+        _path_str,
+        divisible_spec,
+        rule_match_counts,
+    )
+    import jax.tree_util as jtu
+
+    findings: list[Finding] = []
+    rule_seq = rules.match_rules()
+
+    # --- per-rule checks -------------------------------------------------
+    for pattern, spec in rule_seq:
+        axes = _spec_axes(spec)
+        for a in axes:
+            if a not in AXES:
+                findings.append(
+                    Finding(
+                        severity="error",
+                        pass_name="spec",
+                        code="unknown-mesh-axis",
+                        message=f"rule {pattern!r}: {unknown_axis_error(a)}",
+                        context={"rule": pattern, "axis": a},
+                    )
+                )
+        dupes = sorted({a for a in axes if axes.count(a) > 1})
+        if dupes:
+            findings.append(
+                Finding(
+                    severity="error",
+                    pass_name="spec",
+                    code="duplicate-spec-axis",
+                    message=(
+                        f"rule {pattern!r} names mesh axis(es) {dupes} more "
+                        "than once in one PartitionSpec — an array dim cannot "
+                        "be split twice over the same axis"
+                    ),
+                    context={"rule": pattern, "axes": dupes},
+                )
+            )
+
+    # The stock DEFAULT_RULES are a deliberate multi-family union (llama
+    # MoE rows are dead on t5, position-table rows dead on llama): dead
+    # entries there are design, not typos — info, so `--strict` stays
+    # green on every clean default config.  A CUSTOM rule set's dead rule
+    # is the typo this check exists for — warning.
+    from distributed_llms_example_tpu.parallel.sharding import DEFAULT_RULES
+
+    dead_severity = "info" if rule_seq is DEFAULT_RULES else "warning"
+    for (pattern, _), n in zip(rule_seq, rule_match_counts(rules, params)):
+        if n == 0:
+            findings.append(
+                Finding(
+                    severity=dead_severity,
+                    pass_name="spec",
+                    code="dead-rule",
+                    message=(
+                        f"rule {pattern!r} matched zero parameter paths "
+                        "(typo, or shadowed by an earlier rule); anything it "
+                        "targeted falls through to the replicated default"
+                    ),
+                    context={"rule": pattern},
+                )
+            )
+
+    # --- per-parameter checks -------------------------------------------
+    # Capacity = the model-sharding ways this RULE SET can actually use:
+    # fsdp/tensor/expert always (the default rules' axes), stage only when
+    # a rule names it — on a pure-stage mesh the non-stacked params are
+    # replicated by design (the pipeline shards the stacked blocks), not a
+    # lint error.
+    relevant = {"fsdp", "tensor", "expert"}
+    for _, spec in rule_seq:
+        relevant.update(a for a in _spec_axes(spec) if a in MODEL_SHARDING_AXES)
+    model_capacity = math.prod(max(1, mesh_axes.get(a, 1)) for a in sorted(relevant))
+    leaves: list[tuple[str, Any]] = []
+    jtu.tree_map_with_path(
+        lambda path, x: leaves.append((_path_str(path), x)), params
+    )
+
+    # divisible_spec wants a mesh-like object with ``.shape``; give it one
+    # so the lint stays device-free
+    mesh_view = type("_MeshView", (), {"shape": dict(mesh_axes)})()
+
+    for path, leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        ndim = len(shape)
+        spec = rules.spec_for(path, ndim)
+        if any(a not in AXES for a in _spec_axes(spec)):
+            continue  # already reported per-rule; divisibility is moot
+        effective = divisible_spec(spec, shape, mesh_view)
+        if effective != _clip_spec(spec, ndim):
+            findings.append(
+                Finding(
+                    severity="warning",
+                    pass_name="spec",
+                    code="ragged-dim-replicated",
+                    message=(
+                        f"{path}: shape {shape} is not divisible by spec "
+                        f"{spec} on mesh {dict(mesh_axes)}; the ragged dims "
+                        "will be replicated at runtime (per-device memory "
+                        "grows by the dropped factor)"
+                    ),
+                    context={"param": path, "spec": str(spec), "shape": list(shape)},
+                )
+            )
+        sharded_ways = math.prod(
+            max(1, mesh_axes.get(a, 1)) for a in _spec_axes(effective)
+        )
+        nbytes = _leaf_bytes(leaf)
+        if (
+            sharded_ways == 1
+            and model_capacity > 1
+            and nbytes > replicated_bytes_threshold
+            # only the DEFAULT fallthrough is an error: a matched rule that
+            # ends up replicated is either operator intent (an explicit
+            # P()) or a ragged fallback the warning above already names
+            and rules.match_path(path) is None
+        ):
+            findings.append(
+                Finding(
+                    severity="error",
+                    pass_name="spec",
+                    code="oversized-replicated-param",
+                    message=(
+                        f"{path} ({nbytes / 1024**2:.1f} MiB) fell through "
+                        "to the replicated default (no rule matched) "
+                        f"although the mesh offers {model_capacity}-way "
+                        "model sharding "
+                        f"({', '.join(a for a in sorted(relevant) if mesh_axes.get(a, 1) > 1)}) "
+                        "— every device pays the full copy"
+                    ),
+                    context={"param": path, "bytes": nbytes},
+                )
+            )
+    return findings

@@ -18,8 +18,27 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import difflib
 import json
 from typing import Any
+
+# The canonical mesh axis names, in physical-locality order (tensor is the
+# innermost / fastest-varying axis; stage is outermost so pipeline hops can
+# cross DCN).  Lives here — not in core/mesh.py — so axis-name validation
+# (parse_mesh_arg, the sharding lint) never needs jax importable; mesh.py
+# re-exports it for the device-mesh construction itself.
+AXES: tuple[str, ...] = ("stage", "data", "fsdp", "expert", "sequence", "tensor")
+
+
+def unknown_axis_error(name: str) -> ValueError:
+    """A typo'd mesh axis must name itself and its likely intent — the
+    alternative today is an opaque KeyError deep inside jax once the bad
+    name reaches a PartitionSpec."""
+    hint = difflib.get_close_matches(name, AXES, n=1)
+    did_you_mean = f" (did you mean {hint[0]!r}?)" if hint else ""
+    return ValueError(
+        f"unknown mesh axis {name!r}{did_you_mean}; valid axes: {', '.join(AXES)}"
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,8 +288,8 @@ def parse_mesh_arg(spec: str) -> MeshConfig:
         for part in spec.split(","):
             k, _, v = part.partition("=")
             k = k.strip()
-            if k not in ("stage", "data", "fsdp", "expert", "sequence", "tensor"):
-                raise ValueError(f"unknown mesh axis {k!r}")
+            if k not in AXES:
+                raise unknown_axis_error(k)
             kw[k] = int(v)
     # MeshConfig defaults data to -1 (wildcard); if the user put the wildcard
     # on a different axis, pin data to 1 so there is exactly one wildcard.

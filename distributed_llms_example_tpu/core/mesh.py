@@ -29,11 +29,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from distributed_llms_example_tpu.core.config import MeshConfig
+# AXES lives in core/config.py (the canonical home — importable without
+# jax, which is what the CLI parser and the sharding lint need); it is
+# re-exported here because the device-mesh constructor is its main user.
+from distributed_llms_example_tpu.core.config import AXES, MeshConfig
 
 logger = logging.getLogger(__name__)
-
-AXES: tuple[str, ...] = ("stage", "data", "fsdp", "expert", "sequence", "tensor")
 
 DEFAULT_COORDINATOR_PORT = 1234  # parity with reference train-task.py:420
 

@@ -21,6 +21,24 @@ _DTYPES = {
     "float16": jnp.float16,
 }
 
+# How the compiler IR spells each policy dtype (HLO/StableHLO element-type
+# names).  The analysis/ IR lint derives its precision-smell patterns from
+# the ACTIVE policy through this table rather than hardcoding "bf16"/"f32",
+# so a policy change re-targets the lint automatically.
+_HLO_NAMES = {
+    "float32": "f32",
+    "bfloat16": "bf16",
+    "float16": "f16",
+}
+
+
+def hlo_dtype_name(dtype: jnp.dtype) -> str:
+    name = jnp.dtype(dtype).name
+    try:
+        return _HLO_NAMES[name]
+    except KeyError:
+        raise ValueError(f"no HLO name known for dtype {name!r}") from None
+
 
 def parse_dtype(name: str) -> jnp.dtype:
     try:
@@ -55,3 +73,15 @@ class Policy:
             lambda x: x.astype(self.param_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
             tree,
         )
+
+    def matmul_promotion_smell(self) -> tuple[str, str] | None:
+        """The (from, to) HLO dtype pair that constitutes a hot-path
+        precision violation under this policy, or None when the policy has
+        nothing to violate.  With bf16 compute, a ``convert`` promoting a
+        bf16 operand to f32 that then feeds a ``dot`` forfeits MXU bf16
+        throughput — fp32 is reserved for reductions (loss, psums), never
+        matmul operands.  fp32 *accumulation* of a bf16 dot
+        (``f32[..] dot(bf16[..], bf16[..])``) is fine and not matched."""
+        if self.compute_dtype == jnp.bfloat16:
+            return (hlo_dtype_name(self.compute_dtype), "f32")
+        return None

@@ -37,6 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--source-column", type=str, default="")
     p.add_argument("--target-column", type=str, default="")
     p.add_argument("--dry-run", action="store_true", help="print resolved config and exit")
+    p.add_argument(
+        "--lint", type=str, default="warn", choices=("off", "warn", "strict"),
+        help="run the static sharding lint (analysis/) at startup: warn "
+             "logs findings and proceeds (default); strict aborts on any "
+             "error-level finding",
+    )
     return p
 
 
@@ -68,6 +74,27 @@ def main(argv: list[str] | None = None) -> int:
         print(cfg.to_json())
         return 0
     initialize_distributed(args.coordinator_address, args.num_processes, args.process_id)
+    if args.lint != "off":
+        # spec + composition passes from abstract shapes — milliseconds,
+        # and a typo'd spec or known-crash combo surfaces BEFORE minutes
+        # of weight loading and compilation.  Must run AFTER
+        # initialize_distributed: the lint touches the jax backend
+        # (device_count, eval_shape), and jax.distributed.initialize
+        # refuses to run once any computation has initialized XLA — and
+        # the lint wants the GLOBAL device count anyway.
+        from distributed_llms_example_tpu.analysis.findings import (
+            emit as emit_findings,
+            has_errors,
+        )
+        from distributed_llms_example_tpu.analysis.lint import startup_lint
+
+        findings = startup_lint(cfg)
+        emit_findings(findings, as_json=True)
+        if args.lint == "strict" and has_errors(findings):
+            raise SystemExit(
+                "startup lint found error-level findings (see lint_finding "
+                "lines above); rerun with --lint warn to proceed anyway"
+            )
     train_path, val_path = resolve_dataset_files(args.train_file, args.val_file)
     train_records = load_json_records(train_path)
     val_records = load_json_records(val_path) if val_path and os.path.exists(val_path) else None

@@ -285,21 +285,23 @@ class PipelinedBart:
     def __init__(self, config: BartConfig, mesh, dtype=jnp.float32,
                  num_microbatches: int = 0, remat: bool = True,
                  schedule: str = "gpipe"):
-        if mesh.shape.get("sequence", 1) > 1:
-            raise ValueError("pipeline (stage>1) does not compose with sequence parallelism")
-        if schedule not in ("gpipe", "1f1b"):
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"seq2seq pipeline schedule {schedule!r}: must be gpipe or 1f1b "
-                "(interleaved virtual stages are decoder-only for now)"
+                f"unknown pipeline schedule {schedule!r}: must be gpipe, "
+                "1f1b, or interleaved"
             )
-        if (schedule == "1f1b" and mesh.shape.get("fsdp", 1) > 1
-                and mesh.shape.get("stage", 1) > 1):
-            # see parallel/pipeline_seq2seq.py: the partitioner crashes on
-            # the twin chunk-pair program with fsdp-sharded block params
-            raise ValueError(
-                "the fused seq2seq 1f1b schedule does not support fsdp>1; "
-                "use gpipe on fsdp×stage meshes, or tensor parallelism with 1f1b"
-            )
+        # known-bad schedule × sharding combos (1f1b×fsdp partitioner
+        # crash, interleaved, sequence parallelism) are table rows in
+        # analysis/composition.py — one declarative check instead of
+        # scattered raises
+        from distributed_llms_example_tpu.analysis.composition import (
+            validate_composition,
+        )
+
+        validate_composition(
+            family="bart", schedule=schedule, mesh_axes=dict(mesh.shape),
+            flags=("pipelined",),
+        )
         stages = mesh.shape.get("stage", 1)
         for n, what in ((config.encoder_layers, "encoder"), (config.decoder_layers, "decoder")):
             if n % max(stages, 1):

@@ -184,12 +184,19 @@ class PipelinedLlama:
                 f"pipeline schedule {schedule!r}: must be gpipe, 1f1b, or interleaved"
             )
 
-        if mesh.shape.get("sequence", 1) > 1 and mesh.shape.get("stage", 1) > 1:
-            if getattr(config, "num_experts", 0) > 0:
-                raise ValueError(
-                    "pipeline stage×sequence does not compose with MoE "
-                    "(per-shard router statistics need their own reduction)"
-                )
+        # known-bad combos (MoE × sequence, ...) live as rows in the
+        # composition matrix (analysis/composition.py)
+        from distributed_llms_example_tpu.analysis.composition import (
+            validate_composition,
+        )
+
+        flags = ["pipelined"]
+        if getattr(config, "num_experts", 0) > 0:
+            flags.append("moe")
+        validate_composition(
+            family="llama", schedule=schedule, mesh_axes=dict(mesh.shape),
+            flags=flags,
+        )
         stages = mesh.shape.get("stage", 1)
         if config.num_hidden_layers % max(stages, 1):
             raise ValueError(

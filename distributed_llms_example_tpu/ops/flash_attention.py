@@ -46,6 +46,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pallas renamed TPUCompilerParams -> CompilerParams across jax versions
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+from distributed_llms_example_tpu.parallel.activation import compat_shard_map
+
 LANES = 128  # TPU vector lane count: last-dim unit for scratch/statistics
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -194,7 +199,7 @@ def _fwd(q, k, v, bias, lbias, *, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -414,7 +419,7 @@ def _bwd_dlbias(q, k, v, bias, lbias, lse, delta, do, *, scale, causal,
         out_specs=pl.BlockSpec((1, 1, block_q, block_k), lb_map),
         out_shape=jax.ShapeDtypeStruct(lbias.shape, lbias.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -467,7 +472,7 @@ def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, i
         out_specs=pl.BlockSpec((1, 1, block_q, d), q_map),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -524,7 +529,7 @@ def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, i
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -810,7 +815,7 @@ def make_flash_lbias_sharded(
         return o, lse[..., :1]
 
     def run_fwd(args, bias):
-        return jax.shard_map(
+        return compat_shard_map(
             fwd_shard, mesh=mesh, in_specs=fwd_in_specs(bias),
             out_specs=(qkv_spec, lse_spec), check_vma=False,
         )(*args)
@@ -845,7 +850,7 @@ def make_flash_lbias_sharded(
 
         in_specs = (*fwd_in_specs(bias), qkv_spec, lse_spec, qkv_spec)
         args = tuple(x for x in (q, k, v, bias, lbias, o, lse1, do) if x is not None)
-        dq, dk, dv, dlb = jax.shard_map(
+        dq, dk, dv, dlb = compat_shard_map(
             bwd_shard, mesh=mesh, in_specs=in_specs,
             out_specs=(qkv_spec, qkv_spec, qkv_spec, lb_spec), check_vma=False,
         )(*args)

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributed_llms_example_tpu.parallel.activation import compat_shard_map
+
 from distributed_llms_example_tpu.ops.attention import (
     NEG_INF,
     beam_grouped_attention,
@@ -459,6 +461,6 @@ def flash_run(
         )
         args = (*args, bias)
         in_specs = (*in_specs, bias_spec)
-    return jax.shard_map(
+    return compat_shard_map(
         run, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
     )(*args)

@@ -50,7 +50,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distributed_llms_example_tpu.parallel.activation import manual_sequence, pvary_to
+from distributed_llms_example_tpu.analysis.composition import reason_for
+from distributed_llms_example_tpu.parallel.activation import (
+    compat_shard_map,
+    manual_sequence,
+    pvary_to,
+)
 
 
 def stack_blocks(params: dict, prefix: str = "block_", out_key: str = "stacked_blocks") -> dict:
@@ -388,11 +393,9 @@ def pipeline_apply(
         hidden, extras, mesh=mesh, axis_name=axis_name, seq_axis=seq_axis,
     )
     if seq_axis is not None and with_aux:
-        raise ValueError(
-            "pipeline with_aux (MoE load-balance loss) does not compose with "
-            "sequence parallelism: per-shard router statistics would need "
-            "their own cross-sequence reduction"
-        )
+        # deep twin of the adapter-construction check: the message comes
+        # from the composition table so it cannot drift
+        raise ValueError(reason_for("pipeline-sequence-moe"))
 
     def body(local_params: Any, h: jnp.ndarray, ex: Any, key: Any) -> jnp.ndarray:
         # Manual over ``stage`` only: shapes here are GLOBAL in every other
@@ -507,7 +510,7 @@ def pipeline_apply(
 
     out_specs = (hidden_spec, P()) if with_aux else hidden_spec
 
-    result = jax.shard_map(
+    result = compat_shard_map(
         outer,
         mesh=mesh,
         axis_names=set(axes_all),
@@ -733,7 +736,7 @@ def _pvg_shard_map(body, *, mesh, axis_name, axes_all, seq_axis, n_seq,
         with manual_sequence(seq_axis, n_seq):
             return body(sp, pp, h, ex, lb, rt)
 
-    return jax.shard_map(
+    return compat_shard_map(
         outer,
         mesh=mesh,
         axis_names=set(axes_all),
@@ -847,11 +850,7 @@ def pipeline_value_and_grad(
     if L % max(S, 1):
         raise ValueError(f"{L} layers not divisible into {S} pipeline stages")
     if with_aux and seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1:
-        raise ValueError(
-            "pipeline with_aux (MoE load-balance loss) does not compose with "
-            "sequence parallelism: per-shard router statistics would need "
-            "their own cross-sequence reduction"
-        )
+        raise ValueError(reason_for("pipeline-sequence-moe"))
     run_stage = _make_run_stage(layer_fn, checkpoint, with_aux)
     _pvg_check_batch(hidden.shape[0], mesh, M, batch_axes)
     if S == 1:
@@ -1053,11 +1052,7 @@ def pipeline_value_and_grad_interleaved(
     v = int(virtual_stages)
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     if with_aux and seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1:
-        raise ValueError(
-            "pipeline with_aux (MoE load-balance loss) does not compose with "
-            "sequence parallelism: per-shard router statistics would need "
-            "their own cross-sequence reduction"
-        )
+        raise ValueError(reason_for("pipeline-sequence-moe"))
     run_stage = _make_run_stage(layer_fn, checkpoint, with_aux)
     _pvg_check_batch(hidden.shape[0], mesh, M, batch_axes)
     if S == 1:

@@ -66,6 +66,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributed_llms_example_tpu.parallel.activation import compat_shard_map
 from distributed_llms_example_tpu.parallel.pipeline import (
     _full_spec,
     _make_run_stage,
@@ -149,18 +150,18 @@ def pipeline_value_and_grad_seq2seq(
     S = mesh.shape.get(axis_name, 1)
     M = num_microbatches
     if S > 1 and mesh.shape.get("fsdp", 1) > 1:
-        # the XLA SPMD partitioner SIGABRTs (no diagnostic) compiling this
-        # executor's chunk-pair program with dim-0-fsdp-sharded block
-        # params, under BOTH dispatch modes and with the param gather
-        # hoisted out of the branches — reproduced on XLA CPU, jax 0.9.
-        # The llama 1f1b executor (single chunk body, no pair) compiles
-        # fine on the same mesh, so this is specific to the twin shape.
-        # Until the compiler moves: seq2seq fsdp×stage uses gpipe.
-        raise ValueError(
-            "the fused seq2seq 1f1b schedule does not support fsdp>1 "
-            "(XLA partitioner crash); use --pipeline-schedule gpipe on "
-            "fsdp×stage meshes, or tensor parallelism with 1f1b"
-        )
+        # The crash class lives as a row in the composition matrix
+        # (analysis/composition.py, id "seq2seq-1f1b-fsdp"); the adapters
+        # reject it at construction, and this deep guard covers direct
+        # executor calls with the same table-driven message.  Technical
+        # detail: the partitioner SIGABRTs under BOTH dispatch modes and
+        # with the param gather hoisted out of the branches — reproduced
+        # on XLA CPU; the llama 1f1b executor (single chunk body, no pair)
+        # compiles fine on the same mesh, so this is specific to the twin
+        # shape.  Until the compiler moves: seq2seq fsdp×stage uses gpipe.
+        from distributed_llms_example_tpu.analysis.composition import reason_for
+
+        raise ValueError(reason_for("seq2seq-1f1b-fsdp"))
     seam_params = {} if seam_params is None else seam_params
     diff_extras = {} if diff_extras is None else diff_extras
     for stacked, what in ((stacked_enc, "encoder"), (stacked_dec, "decoder")):
@@ -472,7 +473,7 @@ def pipeline_value_and_grad_seq2seq(
     rng_tree = {} if rng is None else {"key": rng}
     repl = lambda t: jax.tree.map(lambda _: P(), t)  # noqa: E731
 
-    return jax.shard_map(
+    return compat_shard_map(
         body,
         mesh=mesh,
         axis_names={axis_name},

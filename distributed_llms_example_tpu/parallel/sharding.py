@@ -58,13 +58,28 @@ class ShardingRules:
     default: P = dataclasses.field(default_factory=P)
 
     def spec_for(self, path: str, ndim: int) -> P:
-        for pattern, spec in self.rules:
-            if re.search(pattern, path):
-                return _clip_spec(spec, ndim)
+        i = self.match_path(path)
+        if i is not None:
+            return _clip_spec(self.match_rules()[i][1], ndim)
         return _clip_spec(self.default, ndim)
 
+    def match_rules(self) -> Sequence[tuple[str, P]]:
+        """The (pattern, spec) sequence ``match_path`` indexes into — the
+        surface the dead-rule check and the spec lint walk."""
+        return self.rules
+
+    def match_path(self, path: str) -> int | None:
+        """Index of the first rule matching ``path`` (first match wins), or
+        None for the default fallthrough."""
+        for i, (pattern, _) in enumerate(self.match_rules()):
+            if re.search(pattern, path):
+                return i
+        return None
+
     def tree_specs(self, params: Any) -> Any:
-        return jax.tree.map_with_path(
+        # tree_util spelling: jax.tree.map_with_path only exists on newer
+        # jax than this image ships; tree_util has carried it for years
+        return jax.tree_util.tree_map_with_path(
             lambda path, x: self.spec_for(_path_str(path), getattr(x, "ndim", 0)), params
         )
 
@@ -139,9 +154,48 @@ class PipelineShardingRules(ShardingRules):
             return _clip_spec(P("stage", *inner), ndim)
         return self.inner.spec_for(path, ndim)
 
+    def match_rules(self) -> Sequence[tuple[str, P]]:
+        return self.inner.match_rules()
+
+    def match_path(self, path: str) -> int | None:
+        m = re.search(r"stacked_[a-z]*_?blocks/", path)
+        return self.inner.match_path(path[m.end():] if m else path)
+
 
 def pipeline_rules() -> ShardingRules:
     return PipelineShardingRules(rules=())
+
+
+def tree_paths(tree: Any) -> list[str]:
+    """'/'-joined path of every leaf — the strings the rule regexes see."""
+    paths: list[str] = []
+    jax.tree_util.tree_map_with_path(
+        lambda path, _: paths.append(_path_str(path)), tree
+    )
+    return paths
+
+
+def rule_match_counts(rules: ShardingRules, tree: Any) -> list[int]:
+    """How many leaf paths each rule wins (first match wins — a rule
+    shadowed by an earlier one counts as unmatched), aligned with
+    ``rules.match_rules()``."""
+    counts = [0] * len(rules.match_rules())
+    for path in tree_paths(tree):
+        i = rules.match_path(path)
+        if i is not None:
+            counts[i] += 1
+    return counts
+
+
+def find_dead_rules(rules: ShardingRules, tree: Any) -> list[str]:
+    """Patterns that matched zero parameter paths.  A dead rule is how a
+    typo'd regex silently replicates the parameters it meant to shard —
+    the tree it intended to match falls through to ``rules.default``."""
+    return [
+        pattern
+        for (pattern, _), n in zip(rules.match_rules(), rule_match_counts(rules, tree))
+        if n == 0
+    ]
 
 
 def divisible_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
@@ -218,6 +272,28 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_params(params: Any, mesh: Mesh, rules: ShardingRules | None = None) -> Any:
-    """Device-put a host param tree onto the mesh with the rule shardings."""
+    """Device-put a host param tree onto the mesh with the rule shardings.
+
+    Dead rules (regexes that matched zero parameter paths) are logged
+    after mapping the tree: the normal-path surface of the analysis/ spec
+    lint's core check — a typo'd pattern means the params it meant to
+    shard fell through to the replicated default.  Severity "warning"
+    for a caller-supplied rule set; "info" for the stock DEFAULT_RULES,
+    whose multi-family union is dead-by-design on any single model."""
+    from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+    rules = rules or default_rules()
+    dead = find_dead_rules(rules, params)
+    if dead:
+        log_json({
+            "event": "dead_sharding_rules",
+            "severity": (
+                "info" if rules.match_rules() is DEFAULT_RULES else "warning"
+            ),
+            "reason": "sharding rules matched zero parameter paths; the "
+                      "params they targeted (if any) fell through to the "
+                      "replicated default",
+            "patterns": dead,
+        })
     shardings = infer_param_shardings(params, mesh, rules)
     return jax.tree.map(lambda x, s: jax.device_put(x, s), params, shardings)

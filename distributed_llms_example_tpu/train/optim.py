@@ -41,7 +41,7 @@ def decay_mask(params: Any) -> Any:
         leaf = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
         return p.ndim >= 2 and leaf not in ("scale", "bias")
 
-    return jax.tree.map_with_path(is_decay, params)
+    return jax.tree_util.tree_map_with_path(is_decay, params)
 
 
 def make_optimizer(

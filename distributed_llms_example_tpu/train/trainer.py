@@ -155,13 +155,18 @@ class Trainer:
                 remat=cfg.remat,
             )
             if cfg.pipeline_schedule in ("1f1b", "interleaved"):
-                if cfg.pipeline_schedule == "interleaved" and self.loaded.family != "llama":
-                    raise ValueError(
-                        "--pipeline-schedule interleaved currently supports "
-                        f"decoder-only (llama) families, not {self.loaded.family!r}; "
-                        "the seq2seq families pipeline under gpipe or the fused "
-                        "twin-pipeline 1f1b"
-                    )
+                # the adapters re-validate at construction; checking the
+                # composition table here too fails before the stacking work
+                from distributed_llms_example_tpu.analysis.composition import (
+                    validate_composition,
+                )
+
+                validate_composition(
+                    family=self.loaded.family,
+                    schedule=cfg.pipeline_schedule,
+                    mesh_axes=dict(self.mesh.shape),
+                    flags=("pipelined",),
+                )
                 adapter_kw["schedule"] = cfg.pipeline_schedule
                 if cfg.pipeline_schedule == "interleaved":
                     adapter_kw["virtual_stages"] = cfg.pipeline_virtual_stages
@@ -237,60 +242,33 @@ class Trainer:
                           f"target_cap={tgt_cap} not all divisible by sequence={seq_axis}",
             })
 
-        # --fused-ce composes only with data/fsdp meshes on causal
-        # families: under tensor>1 the vocab-chunked slicing would gather
-        # the vocab-sharded lm_head kernel every chunk (a silent perf/HBM
-        # regression), and the pipelined adapters own their loss paths so
-        # the flag would be silently inert — fail loudly instead.
-        if cfg.fused_ce:
-            if self.loaded.is_seq2seq:
-                raise ValueError(
-                    "--fused-ce supports causal (decoder-only) families; "
-                    f"{cfg.model_ckpt!r} is seq2seq"
-                )
-            bad = [
-                a for a in ("tensor", "stage", "sequence")
-                if self.mesh.shape.get(a, 1) > 1
-            ]
-            if bad:
-                raise ValueError(
-                    f"--fused-ce does not compose with mesh axes {bad}: the "
-                    "vocab-chunked LM head wants an unsharded vocab dim and "
-                    "the standard (non-pipelined) loss path; use data/fsdp "
-                    "axes or drop the flag"
-                )
-
-        # forced-ring misconfiguration must fail HERE, loudly: the selection
-        # logic quietly falls back on mesh-less traces (module init, the
-        # pipeline's per-stage bodies), so a bad mesh would otherwise train
-        # the whole run on XLA attention with only a log line to show for it
-        if cfg.attention_impl == "ring":
-            if self.mesh.shape.get("sequence", 1) <= 1:
-                raise ValueError(
-                    "--attention-impl ring requires a mesh with a sequence axis > 1 "
-                    f"(got {dict(self.mesh.shape)})"
-                )
-            if self.pipelined and self.loaded.family != "llama":
-                raise ValueError(
-                    "--attention-impl ring composes with stage>1 only for the "
-                    "llama family (ONE manual region over {stage, sequence}, "
-                    "gpipe or 1f1b); the seq2seq families run ring as its own "
-                    "fully-manual shard_map, which does not nest"
-                )
-        elif (
-            cfg.attention_impl in ("xla", "flash")
-            and self.pipelined
-            and self.mesh.shape.get("sequence", 1) > 1
-            and self.loaded.family == "llama"
-        ):
-            # stage×sequence executes ring attention inside the manual
-            # region — a forced non-ring impl would only fail at first
-            # trace; fail here at startup with the config named
+        # --fused-ce / forced-attention misconfigurations must fail HERE,
+        # loudly, before any compile: the known-bad combos are rows in the
+        # composition matrix (analysis/composition.py) — fused-ce on
+        # seq2seq or tensor/stage/sequence meshes, ring on pipelined
+        # seq2seq, forced xla/flash on a stage×sequence llama mesh.
+        if cfg.attention_impl == "ring" and self.mesh.shape.get("sequence", 1) <= 1:
+            # not a combo — ring simply has nothing to shard over
             raise ValueError(
-                f"--attention-impl {cfg.attention_impl} cannot run on a "
-                "stage×sequence mesh (the pipeline's manual region executes "
-                "ring attention only); use auto or ring"
+                "--attention-impl ring requires a mesh with a sequence axis > 1 "
+                f"(got {dict(self.mesh.shape)})"
             )
+        from distributed_llms_example_tpu.analysis.composition import (
+            config_flags,
+            validate_composition,
+        )
+
+        validate_composition(
+            family=self.loaded.family,
+            schedule=cfg.pipeline_schedule if self.pipelined else None,
+            mesh_axes=dict(self.mesh.shape),
+            flags=config_flags(
+                pipelined=self.pipelined,
+                fused_ce=cfg.fused_ce,
+                attention_impl=cfg.attention_impl,
+                num_experts=int(getattr(self.config, "num_experts", 0) or 0),
+            ),
+        )
 
         self.use_dropout = self.config.dropout_rate > 0.0
         build = make_train_step(

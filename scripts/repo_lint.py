@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""AST lint for repo conventions the type system cannot hold.
+
+Two rules, both born from real regressions at TPU scale:
+
+1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
+   ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
+   one stray sync in the step function serializes every device round-trip
+   and the pipelining the whole module exists for is gone.
+
+2. **No bare PartitionSpec literals outside the sharding layer.**  A
+   ``P("tensro", ...)`` typo'd in some far-away module bypasses every rule
+   check and surfaces as an opaque KeyError inside jax.  Axis-name specs
+   belong in ``parallel/`` (the sharding/pipeline layer); the few
+   historical exceptions are pinned in an explicit allowlist so NEW ones
+   fail review here.
+
+Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
+into the fast test suite (tests/test_analysis.py) next to the analysis-CLI
+smoke run.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+PACKAGE = "distributed_llms_example_tpu"
+
+# Files where .block_until_ready / jax.device_get would poison the async
+# dispatch pipeline.
+HOT_PATH_FILES = (
+    os.path.join(PACKAGE, "train", "step.py"),
+)
+
+# Directories whose job IS axis-name specs.
+SPEC_LAYER_DIRS = (
+    os.path.join(PACKAGE, "parallel"),
+)
+
+# Pinned exceptions: (file, why).  Add here only with a comment-worthy
+# reason — the point is that new bare specs fail loudly.
+SPEC_LITERAL_ALLOWLIST = {
+    # micro-batch sharding constraint for the grad-accum scan; the axis
+    # tuple mirrors batch_sharding() and changing either means both
+    os.path.join(PACKAGE, "train", "step.py"),
+    # the MoE dispatch spec is part of the expert-parallel kernel contract
+    os.path.join(PACKAGE, "ops", "moe.py"),
+}
+
+FORBIDDEN_SYNC_ATTRS = ("block_until_ready",)
+FORBIDDEN_SYNC_CALLS = (("jax", "device_get"),)
+
+
+def _spec_call_has_str_literal(node: ast.Call) -> bool:
+    def holds_str(n: ast.AST) -> bool:
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            return True
+        if isinstance(n, ast.Tuple):
+            return any(holds_str(e) for e in n.elts)
+        return False
+
+    return any(holds_str(a) for a in node.args)
+
+
+def lint_file(path: str, rel: str) -> list[str]:
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=rel)
+        except SyntaxError as e:
+            return [f"{rel}: syntax error: {e}"]
+    violations: list[str] = []
+    hot = rel in HOT_PATH_FILES
+    in_spec_layer = any(rel.startswith(d + os.sep) for d in SPEC_LAYER_DIRS)
+    allowed_spec = rel in SPEC_LITERAL_ALLOWLIST
+
+    for node in ast.walk(tree):
+        if hot and isinstance(node, ast.Attribute) and node.attr in FORBIDDEN_SYNC_ATTRS:
+            violations.append(
+                f"{rel}:{node.lineno}: .{node.attr}() in the train-step hot "
+                "path stalls async dispatch"
+            )
+        if hot and isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and (fn.value.id, fn.attr) in FORBIDDEN_SYNC_CALLS
+            ):
+                violations.append(
+                    f"{rel}:{node.lineno}: {fn.value.id}.{fn.attr}() in the "
+                    "train-step hot path forces a device sync"
+                )
+        if (
+            not in_spec_layer
+            and not allowed_spec
+            and isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("P", "PartitionSpec")
+            and _spec_call_has_str_literal(node)
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: bare PartitionSpec with literal axis "
+                "names outside parallel/ — route it through "
+                "parallel/sharding.py rules (or pin an allowlist entry in "
+                "scripts/repo_lint.py with a reason)"
+            )
+    return violations
+
+
+def main(argv: list[str] | None = None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations: list[str] = []
+    pkg_root = os.path.join(root, PACKAGE)
+    for dirpath, _, files in os.walk(pkg_root):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            violations.extend(lint_file(path, rel))
+    for v in violations:
+        print(v)
+    if not violations:
+        print("repo_lint: clean")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -156,7 +156,7 @@ def test_state_shardings_cover_opt_state(mesh8, setup):
     state = create_train_state(params, tx)
     sh = state_shardings(state, mesh8)
     # adam moments of q_proj kernels must be sharded like the kernel itself
-    flat = jax.tree.leaves_with_path(sh)
+    flat = jax.tree_util.tree_leaves_with_path(sh)
     qproj = [s for path, s in flat if "q_proj" in str(path)]
     assert len(qproj) >= 3  # param + mu + nu
     assert len({str(s) for s in qproj}) == 1

@@ -74,6 +74,8 @@ def test_trainer_resume(tiny_cfg):
     assert result["steps"] == trainer.total_steps  # nothing re-run
 
 
+@pytest.mark.slow  # ~40-60s of real CPU training: the fast tier keeps
+# the cheap resume/CLI legs; the full e2e loops run in the slow suite
 def test_trainer_profile_trace(tmp_path, capsys):
     """--profile-dir wiring: a short run must produce a jax.profiler trace
     (SURVEY.md §7 step 8) and log a profile_trace event."""
@@ -133,6 +135,8 @@ def test_cli_requires_train_file():
         main(["--model-ckpt", "t5-test"])
 
 
+@pytest.mark.slow  # ~40-60s of real CPU training: the fast tier keeps
+# the cheap resume/CLI legs; the full e2e loops run in the slow suite
 def test_preemption_checkpoints_and_resumes(tmp_path):
     """SIGTERM mid-training → trainer finishes the in-flight step, saves a
     checkpoint, returns preempted=True; a fresh Trainer resumes from that

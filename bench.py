@@ -836,13 +836,15 @@ def main() -> None:
     from distributed_llms_example_tpu.parallel.activation import activation_mesh
 
     flops_per_step = 0.0
+    lowered = None
     try:
         # HLO-level analysis on the Lowered stage: no second backend compile.
         # Must lower under the mesh context — jit caches the traced jaxpr,
         # and a trace made without the ambient mesh would bake constraint
         # no-ops into the very program the benchmark then measures.
         with activation_mesh(step_fn.mesh):
-            ca = step_fn.jitted.lower(state, gb).cost_analysis()
+            lowered = step_fn.jitted.lower(state, gb)
+        ca = lowered.cost_analysis()
         if isinstance(ca, list):  # some backends return one dict per device
             ca = ca[0] if ca else {}
         flops_per_step = float((ca or {}).get("flops", 0.0))
@@ -850,6 +852,23 @@ def main() -> None:
         print(f"bench: cost_analysis unavailable ({e}); using 6*N*tokens", file=sys.stderr)
     if flops_per_step <= 0.0:
         flops_per_step = 6.0 * n_params * tokens_per_step
+
+    # Per-step collective-traffic account (obs/gauges.py) from the compiled
+    # step's HLO — gradient vs activation bytes per collective op.  The AOT
+    # compile shares the persistent compilation cache with the first jit
+    # call, so this costs one disk hit, not a second real compile.
+    comm_bytes = None
+    if lowered is not None and os.environ.get("BENCH_COMM_BYTES", "1") != "0":
+        try:
+            from distributed_llms_example_tpu.obs.gauges import collective_traffic
+
+            comm_bytes = collective_traffic(
+                lowered.compile().as_text(),
+                [int(x.size) for x in jax.tree.leaves(params)],
+                n_chips,
+            )
+        except Exception as e:
+            print(f"bench: collective-traffic account unavailable ({e})", file=sys.stderr)
 
     # warmup/compile
     for _ in range(2):
@@ -876,7 +895,10 @@ def main() -> None:
         times.append(time.perf_counter() - t1)
 
     peak_flops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12  # v5e bf16
+    from distributed_llms_example_tpu.obs.spans import percentiles
+
     order = sorted(times)
+    p50, p95 = percentiles(times, (0.50, 0.95))
     tps = tokens_per_step * steps / dt
     tps_chip = tps / n_chips
     mfu = flops_per_step * steps / dt / (n_chips * peak_flops)
@@ -893,12 +915,15 @@ def main() -> None:
         "chips": n_chips,
         "backend": jax.default_backend(),
         "step_time_ms_sync_inclusive": {
-            "p50": round(order[len(order) // 2] * 1e3, 1),
+            "p50": round(p50 * 1e3, 1),
             "p90": round(order[min(len(order) - 1, int(0.9 * len(order)))] * 1e3, 1),
+            "p95": round(p95 * 1e3, 1),
             "min": round(order[0] * 1e3, 1),
             "max": round(order[-1] * 1e3, 1),
         },
     }
+    if comm_bytes is not None:
+        result["comm_bytes_per_step"] = comm_bytes
     # Emit the record NOW and again after each add-on lands: if an add-on
     # overruns the supervisor's kill (budget gates check only at add-on
     # START), the supervisor salvages the newest line from the dead

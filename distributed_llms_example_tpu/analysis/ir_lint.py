@@ -28,9 +28,10 @@ violations deterministically; the compile driver wraps it.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import re
-from typing import Any, Mapping
+from typing import Any, Iterable, Mapping
 
 from distributed_llms_example_tpu.analysis.findings import Finding
 
@@ -75,6 +76,94 @@ def _bytes_of(dtype: str, dims: str) -> int:
     return int(math.prod(shape)) * _ITEMSIZE.get(dtype, 4)
 
 
+def _elems_of(dims: str) -> int:
+    return int(math.prod([int(d) for d in dims.split(",") if d]))
+
+
+@dataclasses.dataclass(frozen=True)
+class HloInstr:
+    """One parsed HLO instruction definition (post-optimization text).
+
+    For tuple-shaped defs (async collective ``-start`` forms) ``bytes``/
+    ``elems``/``dtype``/``dims`` describe the LARGEST tuple element — for
+    an all-gather-start that is the gathered result, the size that
+    matters for traffic and memory accounting alike.
+    """
+
+    name: str
+    dtype: str
+    dims: str
+    op: str
+    bytes: int
+    elems: int
+    operands: tuple[str, ...]
+    line: str
+
+
+def parse_hlo_instructions(hlo_text: str) -> dict[str, HloInstr]:
+    """Instruction-name → parsed def, for every definition in the text.
+
+    THE one HLO text parser: the lint passes below and the obs collective
+    -traffic account (obs/gauges.py) both consume it, so their byte
+    arithmetic cannot drift."""
+    out: dict[str, HloInstr] = {}
+    for line in hlo_text.splitlines():
+        m = _DEF_RE.match(line)
+        if m:
+            name = m.group("name")
+            out[name] = HloInstr(
+                name=name,
+                dtype=m.group("dtype"),
+                dims=m.group("dims"),
+                op=m.group("op"),
+                bytes=_bytes_of(m.group("dtype"), m.group("dims")),
+                elems=_elems_of(m.group("dims")),
+                operands=tuple(_OPERAND_RE.findall(line[m.end():])),
+                line=line,
+            )
+            continue
+        t = _TUPLE_DEF_RE.match(line)
+        if t:
+            name = t.group("name")
+            elems = _TUPLE_ELEM_RE.findall(t.group("elems"))
+            if elems:
+                dt, dims = max(elems, key=lambda e: _bytes_of(*e))
+            else:
+                dt, dims = "f32", ""
+            out[name] = HloInstr(
+                name=name,
+                dtype=dt,
+                dims=dims,
+                op=t.group("op"),
+                bytes=_bytes_of(dt, dims),
+                elems=_elems_of(dims),
+                operands=tuple(_OPERAND_RE.findall(line[t.end():])),
+                line=line,
+            )
+    return out
+
+
+def model_tree_element_candidates(
+    param_elems: Iterable[int], mesh_size: int
+) -> set[int]:
+    """Element counts a model-tree (parameter/gradient) tensor can carry
+    in the compiled per-device program: each leaf's full count plus every
+    even shard of it over a divisor of the mesh size.  Collectives whose
+    tensors match one of these counts are gradient/parameter traffic; the
+    rest move activations.  Shared by the IR lint census and the obs
+    collective-traffic account so both classify identically."""
+    divisors = [d for d in range(1, max(1, int(mesh_size)) + 1) if mesh_size % d == 0]
+    out: set[int] = set()
+    for e in param_elems:
+        e = int(e)
+        if e <= 0:
+            continue
+        for d in divisors:
+            if e % d == 0:
+                out.add(e // d)
+    return out
+
+
 def scan_hlo_text(
     hlo_text: str,
     *,
@@ -82,31 +171,19 @@ def scan_hlo_text(
     promotion_smell: tuple[str, str] | None = None,
     largest_param_bytes: int = 0,
     gather_bytes_threshold: int = 16 * 1024**2,
+    param_element_counts: Iterable[int] | None = None,
 ) -> list[Finding]:
-    """Scan post-optimization HLO text.  Pure function of the text."""
+    """Scan post-optimization HLO text.  Pure function of the text.
+
+    ``param_element_counts`` (full per-leaf element counts of the model's
+    parameter tree) additionally splits the collective census byte totals
+    into gradient/parameter vs activation traffic."""
     findings: list[Finding] = []
-    defs: dict[str, tuple[str, str, str]] = {}  # name -> (dtype, dims, op)
-    sizes: dict[str, int] = {}  # name -> result bytes (max element for tuples)
-    operands: dict[str, list[str]] = {}
+    instrs = parse_hlo_instructions(hlo_text)
+    defs = {n: (i.dtype, i.dims, i.op) for n, i in instrs.items()}
+    sizes = {n: i.bytes for n, i in instrs.items()}
+    operands = {n: list(i.operands) for n, i in instrs.items()}
     lines = hlo_text.splitlines()
-    for line in lines:
-        m = _DEF_RE.match(line)
-        if m:
-            name = m.group("name")
-            defs[name] = (m.group("dtype"), m.group("dims"), m.group("op"))
-            sizes[name] = _bytes_of(m.group("dtype"), m.group("dims"))
-            operands[name] = _OPERAND_RE.findall(line[m.end():])
-            continue
-        t = _TUPLE_DEF_RE.match(line)
-        if t:
-            name = t.group("name")
-            elems = _TUPLE_ELEM_RE.findall(t.group("elems"))
-            dt, dims = elems[0] if elems else ("f32", "")
-            defs[name] = (dt, dims, t.group("op"))
-            sizes[name] = max(
-                (_bytes_of(d, s) for d, s in elems), default=0
-            )
-            operands[name] = _OPERAND_RE.findall(line[t.end():])
 
     model_sharded = any(
         mesh_axes.get(a, 1) > 1 for a in ("fsdp", "tensor", "expert", "stage")
@@ -216,9 +293,27 @@ def scan_hlo_text(
 
     # ---- census ---------------------------------------------------------
     census: dict[str, int] = {}
-    for _, (_, _, op) in defs.items():
+    bytes_by_op: dict[str, int] = {}
+    for name, (_, _, op) in defs.items():
         if op in _COLLECTIVE_OPS:
             census[op] = census.get(op, 0) + 1
+            bytes_by_op[op] = bytes_by_op.get(op, 0) + sizes[name]
+    context: dict[str, Any] = {"census": census, "bytes_by_op": bytes_by_op}
+    if param_element_counts is not None:
+        mesh_size = 1
+        for v in mesh_axes.values():
+            mesh_size *= max(1, int(v))
+        candidates = model_tree_element_candidates(param_element_counts, mesh_size)
+        grad_bytes: dict[str, int] = {}
+        for name, instr in instrs.items():
+            if instr.op not in _COLLECTIVE_OPS:
+                continue
+            touched = {instr.elems} | {
+                instrs[o].elems for o in instr.operands if o in instrs
+            }
+            if touched & candidates:
+                grad_bytes[instr.op] = grad_bytes.get(instr.op, 0) + instr.bytes
+        context["gradient_bytes_by_op"] = grad_bytes
     findings.append(Finding(
         severity="info",
         pass_name="ir",
@@ -227,7 +322,7 @@ def scan_hlo_text(
             "collectives in the compiled step: "
             + (", ".join(f"{k}×{v}" for k, v in sorted(census.items())) or "none")
         ),
-        context={"census": census},
+        context=context,
     ))
     return findings
 
@@ -268,8 +363,9 @@ def lint_train_step(
         dtype=dtype, remat=remat, grad_accum_steps=grad_accum_steps,
     )
     text = compiled.as_text()
+    leaves = jax.tree.leaves(a_params)
     largest_param = max(
-        (int(math.prod(x.shape)) * x.dtype.itemsize for x in jax.tree.leaves(a_params)),
+        (int(math.prod(x.shape)) * x.dtype.itemsize for x in leaves),
         default=0,
     )
     policy = Policy(compute_dtype=parse_dtype(dtype))
@@ -279,6 +375,7 @@ def lint_train_step(
         promotion_smell=policy.matmul_promotion_smell(),
         largest_param_bytes=largest_param,
         gather_bytes_threshold=gather_bytes_threshold,
+        param_element_counts=[int(math.prod(x.shape)) for x in leaves],
     )
 
 

@@ -180,10 +180,32 @@ class TrainConfig:
     # --- logging (reference cadences: 10/300/100 steps; we default to 100) ---
     log_every_steps: int = 100
 
+    # --- observability (obs/): the layered telemetry stack ---
+    # "stdout": spans/heartbeat events ride the Valohai stdout channel;
+    # "jsonl": additionally tee schema-versioned records into
+    # <output_dir>/obs/metrics-p{process}.jsonl and turn the gauge compile
+    # on (obs_gauges=auto); "off": no obs instrumentation (the stdout
+    # metric channel itself never turns off — it is the platform contract)
+    obs: str = "stdout"
+    # static-gauge AOT compile (MFU FLOPs + collective-traffic account):
+    # "auto" = only under --obs jsonl; "on"/"off" force it
+    obs_gauges: str = "auto"
+    # heartbeat cadence in steps (0 = off).  Multi-host: every process
+    # probes at the same global step, process 0 reports skew/laggards
+    obs_heartbeat_steps: int = 0
+    # MFU denominator: peak per-chip FLOP/s in TFLOP/s (v5e bf16 ≈ 197)
+    obs_peak_tflops: float = 197.0
+
     # --- profiling (SURVEY.md §7 step 8: jax.profiler hooks; the reference's
     #     only "profiling" is an nvidia-smi report at startup) ---
     profile_dir: str = ""  # "" = profiling off; else write a trace here
-    profile_steps: int = 3  # trace this many steps after the first (compiled) one
+    # legacy count ("3": trace 3 steps after the first compiled one; needs
+    # profile_dir) or an absolute inclusive step window ("100:105", trace
+    # dir defaults under output_dir) — obs/profile.py parses both
+    profile_steps: int | str = 3
+    # trigger file polled at step cadence for on-demand capture;
+    # "" = <output_dir>/obs/profile.trigger when obs is enabled
+    profile_trigger: str = ""
 
     # --- nested ---
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -271,7 +293,29 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--tokenizer", type=str, default=_D.tokenizer)
     p.add_argument("--prefetch-batches", type=int, default=_D.prefetch_batches)
     p.add_argument("--profile-dir", type=str, default=_D.profile_dir)
-    p.add_argument("--profile-steps", type=int, default=_D.profile_steps)
+    p.add_argument(
+        "--profile-steps", type=str, default=str(_D.profile_steps),
+        help="jax.profiler capture: step count ('3', needs --profile-dir) "
+             "or absolute inclusive window ('100:105')",
+    )
+    p.add_argument(
+        "--profile-trigger", type=str, default=_D.profile_trigger,
+        help="trigger-file path polled every step for on-demand capture "
+             "(default: <output-dir>/obs/profile.trigger when --obs is on)",
+    )
+    p.add_argument(
+        "--obs", type=str, default=_D.obs, choices=("off", "stdout", "jsonl"),
+        help="telemetry (obs/): stdout-only events, + JSONL file under the "
+             "output dir, or off (metric stdout always stays on)",
+    )
+    p.add_argument(
+        "--obs-gauges", type=str, default=_D.obs_gauges,
+        choices=("auto", "on", "off"),
+        help="AOT-compile the train step at startup for MFU FLOPs + the "
+             "collective-traffic account (auto = only under --obs jsonl)",
+    )
+    p.add_argument("--obs-heartbeat-steps", type=int, default=_D.obs_heartbeat_steps)
+    p.add_argument("--obs-peak-tflops", type=float, default=_D.obs_peak_tflops)
     p.add_argument("--save-every-steps", type=int, default=_D.checkpoint.save_every_steps)
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--mesh", type=str, default="data=-1", help="comma list axis=size, e.g. data=2,fsdp=4,tensor=1")

@@ -145,6 +145,18 @@ def initialize_distributed(
         )
     if ":" not in coordinator_address:
         coordinator_address = f"{coordinator_address}:{DEFAULT_COORDINATOR_PORT}"
+    # The CPU client defaults to NO cross-process collectives backend
+    # (jax_cpu_collectives_implementation="none") and then every
+    # multi-process computation — put_batch's global arrays, the
+    # preemption/heartbeat allgathers — dies with "Multiprocess
+    # computations aren't implemented on the CPU backend".  Gloo over TCP
+    # is jax's supported CPU answer; the flag only affects CPU client
+    # construction (TPU/GPU ignore it), so set it before initialize
+    # whenever this jax version has it.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # older/newer jax without the flag: keep its default
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

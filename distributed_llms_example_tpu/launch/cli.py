@@ -11,6 +11,12 @@ One command serves all three of the reference's launch modes (SURVEY.md §7):
 - Valohai step: dataset files resolve via ``valohai.inputs('dataset')``
   exactly like the reference's ``run()`` functions
   (reference train-torchrun.py:151-159) when no --train-file is given.
+
+Observability: ``--obs jsonl`` tees every metric line into
+``<output-dir>/obs/metrics-p*.jsonl`` and turns on the derived gauges
+(MFU, collective-traffic account); ``--obs-heartbeat-steps N`` adds the
+multi-host liveness probe; ``--profile-steps 100:105`` captures a
+jax.profiler trace for that step window (see README "Observability").
 """
 
 from __future__ import annotations
@@ -102,7 +108,14 @@ def main(argv: list[str] | None = None) -> int:
     from distributed_llms_example_tpu.train.trainer import Trainer
 
     trainer = Trainer(cfg, train_records=train_records, val_records=val_records)
-    trainer.train()
+    try:
+        trainer.train()
+    finally:
+        # flush the JSONL file channel (--obs jsonl) even on a crash —
+        # the telemetry written so far is exactly what the postmortem needs
+        from distributed_llms_example_tpu.obs.sink import current_sink
+
+        current_sink().close()
     return 0
 
 

@@ -1,0 +1,216 @@
+"""Layered telemetry for the training system.
+
+The reference's observability contract is ONE channel: a JSON line per
+metric window printed to stdout, parsed by Valohai as execution metadata
+(utils/jsonlog.py).  That is enough to watch a loss curve and nothing
+else — pjit-at-scale training reports (PAPERS.md: arxiv 2204.06514) treat
+MFU and per-step comm/compute breakdowns as the primary tuning signal,
+and weight-update-sharding work (arxiv 2004.13336) shows gradient-traffic
+accounting is what separates a correctly sharded step from a 2× overweight
+one.  This package supplies those signals in four layers:
+
+- ``spans``     host-side monotonic-clock span tracing (data_wait /
+                step_dispatch / device_sync / eval / checkpoint) with a
+                ring buffer and per-window step-time percentiles; zero
+                device syncs off the logging cadence
+- ``gauges``    derived device gauges: MFU from the AOT-compiled train
+                step's HLO cost analysis (the shared compile recipe in
+                utils/memory_audit.py), live HBM via ``memory_stats()``,
+                and a static per-step collective-traffic account scanned
+                from the same HLO the IR lint parses
+- ``profile``   on-demand ``jax.profiler`` capture for a step window
+                (``--profile-steps 100:105``) or a trigger file polled at
+                step cadence
+- ``heartbeat`` multi-host liveness/step-skew probe so process 0 reports
+                laggards before a collective hangs silently
+
+Everything funnels through ``sink`` (stdout Valohai channel + optional
+JSONL file, same schema).  ``TrainerObs`` below is the one object the
+Trainer holds — it owns the wiring so the train loop stays readable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable, Iterator
+
+from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.heartbeat import Heartbeat
+from distributed_llms_example_tpu.obs.profile import ProfileController
+from distributed_llms_example_tpu.obs.sink import build_sink, install_sink
+from distributed_llms_example_tpu.obs.spans import SpanRecorder
+
+
+class TrainerObs:
+    """The Trainer's telemetry bundle.
+
+    Owns the sink, the span recorder, the (optional) static gauges, the
+    heartbeat, and the profiler controller.  Everything here is host-side
+    bookkeeping except: the startup gauge compile (one AOT compile of the
+    train step, gated by ``obs_gauges``), the heartbeat's cadenced
+    cross-process gather, and the profiler's start/stop syncs — none of
+    which ever lands on a non-cadence step.
+    """
+
+    def __init__(self, cfg: Any, *, start_step: int = 0, manage_sink: bool = True):
+        self.cfg = cfg
+        self.enabled = getattr(cfg, "obs", "stdout") != "off"
+        if manage_sink:
+            # standalone use (tests, tools); the Trainer installs its sink
+            # itself — before its first device_report line — and passes
+            # manage_sink=False so the file channel is opened exactly once
+            install_sink(build_sink(getattr(cfg, "obs", "stdout"), cfg.output_dir))
+        self.spans = SpanRecorder()
+        self.every = max(1, int(cfg.log_every_steps))
+        self.flops_per_step: float | None = None
+        self.peak_flops_per_chip = float(
+            getattr(cfg, "obs_peak_tflops", 197.0)
+        ) * 1e12
+        hb_every = int(getattr(cfg, "obs_heartbeat_steps", 0) or 0)
+        self.heartbeat = Heartbeat(every_steps=hb_every) if (
+            self.enabled and hb_every > 0
+        ) else None
+        self._trigger = getattr(cfg, "profile_trigger", "") or (
+            os.path.join(cfg.output_dir, "obs", "profile.trigger")
+            if self.enabled
+            else ""
+        )
+        self.profiler = self._build_profiler(start_step)
+
+    def _build_profiler(self, start_step: int) -> ProfileController:
+        return ProfileController(
+            profile_dir=self.cfg.profile_dir,
+            steps_spec=self.cfg.profile_steps,
+            trigger_path=self._trigger,
+            start_step=start_step,
+            output_dir=self.cfg.output_dir,
+        )
+
+    def set_start_step(self, start_step: int) -> None:
+        """Re-anchor the legacy relative profile window once the Trainer
+        knows its resume step (checkpoint restore happens after obs
+        construction)."""
+        self.profiler = self._build_profiler(start_step)
+
+    # -- startup ---------------------------------------------------------
+
+    def startup_gauges(self, mesh: Any, *, tgt_cap: int) -> None:
+        """AOT-compile the train step via the shared recipe
+        (utils/memory_audit.py) and emit the static gauges: per-step HLO
+        FLOPs (the MFU numerator) and the collective-traffic account.
+        One extra compile at startup — on TPU with the persistent
+        compilation cache it is a disk hit for any program the run will
+        compile anyway."""
+        cfg = self.cfg
+        mode = getattr(cfg, "obs_gauges", "auto")
+        want = mode == "on" or (mode == "auto" and getattr(cfg, "obs", "") == "jsonl")
+        if not (self.enabled and want):
+            return
+        from distributed_llms_example_tpu.obs import gauges
+
+        try:
+            with self.spans.span("obs_gauge_compile"):
+                report = gauges.train_step_static_gauges(
+                    cfg.model_ckpt,
+                    mesh,
+                    global_batch=cfg.batch_size,
+                    src_len=cfg.max_source_length,
+                    tgt_len=tgt_cap,
+                    dtype=cfg.compute_dtype,
+                    remat=cfg.remat,
+                    remat_policy=cfg.remat_policy,
+                    grad_accum_steps=cfg.grad_accum_steps,
+                )
+        except Exception as e:  # never fail training for telemetry
+            sink_mod.emit({
+                "event": "obs_gauges_skipped",
+                "reason": str(e)[:300],
+            })
+            return
+        self.flops_per_step = report["flops_per_step"]
+        sink_mod.emit({
+            "event": "obs_gauges",
+            "peak_flops_per_chip": self.peak_flops_per_chip,
+            **report,
+        })
+
+    # -- the step loop ---------------------------------------------------
+
+    def wrap_batches(self, batches: Iterable[dict]) -> Iterator[dict]:
+        """Time host-batch availability as ``data_wait`` spans — the time
+        the device loop spends blocked on tokenize/pad/bucket (or on the
+        prefetcher when it cannot keep up)."""
+        it = iter(batches)
+        while True:
+            with self.spans.span("data_wait"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
+    def step_span(self):
+        return self.spans.span("step_dispatch")
+
+    def sync_span(self):
+        return self.spans.span("device_sync")
+
+    def eval_span(self):
+        return self.spans.span("eval")
+
+    def checkpoint_span(self):
+        return self.spans.span("checkpoint")
+
+    def on_step(self, step: int, epoch: int, metrics: dict) -> None:
+        """Per-step bookkeeping: host clocks only, except the profiler's
+        stop sync (cadenced) and the heartbeat gather (cadenced)."""
+        self.profiler.after_step(step, metrics.get("loss"))
+        self.spans.step_complete()
+        if self.heartbeat is not None and step % self.heartbeat.every == 0:
+            self.heartbeat.beat(step)
+        if self.enabled and step % self.every == 0:
+            self.emit_window(step, epoch)
+
+    def emit_window(self, step: int, epoch: int | None = None) -> None:
+        summary = self.spans.summary()
+        if summary is None:
+            return
+        record: dict[str, Any] = {"event": "obs_window", "step": step}
+        if epoch is not None:
+            record["epoch"] = epoch
+        record.update(summary)
+        mfu = self.window_mfu(summary)
+        if mfu is not None:
+            # significant digits, not decimal places: a CPU-mesh MFU of
+            # 2e-9 must not round to a flat 0.0
+            record["mfu"] = float(f"{mfu:.4g}")
+        from distributed_llms_example_tpu.obs.gauges import hbm_stats
+
+        hbm = hbm_stats()
+        if hbm is not None:
+            record["hbm"] = hbm
+        sink_mod.emit(record)
+
+    def window_mfu(self, summary: dict) -> float | None:
+        """MFU over the just-closed window: compiled-step FLOPs × steps
+        over wall seconds and aggregate peak FLOPs.  None until the
+        startup gauge compile has supplied the numerator."""
+        if not self.flops_per_step or not summary.get("window_seconds"):
+            return None
+        import jax
+
+        from distributed_llms_example_tpu.obs.gauges import mfu
+
+        return mfu(
+            self.flops_per_step,
+            summary["window_seconds"] / max(1, summary["window_steps"]),
+            jax.device_count(),
+            self.peak_flops_per_chip,
+        )
+
+    # -- shutdown --------------------------------------------------------
+
+    def finalize(self, step: int, epoch: int | None = None, sync_leaf: Any = None) -> None:
+        self.profiler.finalize(sync_leaf)
+        if self.enabled:
+            self.emit_window(step, epoch)

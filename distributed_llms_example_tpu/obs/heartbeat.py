@@ -1,0 +1,110 @@
+"""Multi-host liveness / step-skew heartbeat.
+
+Synchronous SPMD fails silently-by-hanging: when one host stalls, every
+other host blocks inside the next collective with no diagnostic.  The
+heartbeat gets ahead of that: at a coarse step cadence every process
+contributes ``(step, wall-clock arrival)`` to a tiny device all-gather
+(the psum-family probe ``multihost_utils.process_allgather`` lowers to —
+a few dozen bytes over the same interconnect training uses, so a
+heartbeat that completes IS a liveness proof for the collective fabric),
+and process 0 publishes the spread:
+
+- ``skew_steps``   max−min step counter across processes.  Nonzero means
+                   a host is running a different loop (crash-restarted,
+                   wrong resume step) — the config-drift failure mode.
+- ``arrival_spread_s``  latest−earliest wall-clock arrival at the probe.
+                   The gather is a barrier, so the spread is exactly how
+                   long fast hosts waited for the straggler since the
+                   last synchronization point.
+- ``laggards``     process indices that arrived ``laggard_threshold_s``
+                   after the earliest — the hosts to go look at before
+                   the next collective hangs for real.
+
+The probe must be called at the SAME global step by every process (the
+trainer calls it on its deterministic step cadence, the same guarantee
+the preemption agreement uses) — a conditional heartbeat on one host
+would itself deadlock the pod.
+
+Wall clocks ride as int32 (seconds, microseconds) because x64 is off by
+default and ~1.7e9 epoch-seconds in f32 quantizes to ~100 s; cross-host
+comparability is then bounded by NTP skew, which is plenty for "which
+host is seconds behind".
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from distributed_llms_example_tpu.obs import sink as sink_mod
+
+DEFAULT_LAGGARD_THRESHOLD_S = 5.0
+
+
+def detect_laggards(
+    steps: "np.ndarray",
+    arrivals_s: "np.ndarray",
+    *,
+    laggard_threshold_s: float = DEFAULT_LAGGARD_THRESHOLD_S,
+) -> dict:
+    """Pure skew analysis over per-process ``(step, arrival time)``
+    vectors — unit-testable without a multi-process rendezvous."""
+    steps = np.asarray(steps)
+    arrivals_s = np.asarray(arrivals_s, dtype=np.float64)
+    earliest = float(arrivals_s.min())
+    spread = float(arrivals_s.max() - earliest)
+    laggards = [
+        int(i)
+        for i in range(len(arrivals_s))
+        if float(arrivals_s[i] - earliest) > laggard_threshold_s
+    ]
+    return {
+        "min_step": int(steps.min()),
+        "max_step": int(steps.max()),
+        "skew_steps": int(steps.max() - steps.min()),
+        "arrival_spread_s": round(spread, 3),
+        "laggards": laggards,
+    }
+
+
+class Heartbeat:
+    def __init__(
+        self,
+        every_steps: int,
+        *,
+        laggard_threshold_s: float = DEFAULT_LAGGARD_THRESHOLD_S,
+    ):
+        self.every = max(1, int(every_steps))
+        self.laggard_threshold_s = float(laggard_threshold_s)
+
+    def beat(self, step: int) -> dict | None:
+        """Contribute this process's probe and, on process 0, emit the
+        heartbeat record.  MUST be called by every process at the same
+        global step.  Returns the record on process 0 (None elsewhere)."""
+        import jax
+
+        t = time.time()
+        local = np.asarray(
+            [int(step), int(t), int((t % 1.0) * 1e6)], dtype=np.int32
+        )
+        if jax.process_count() == 1:
+            gathered = local[None, :]
+        else:
+            from jax.experimental import multihost_utils
+
+            gathered = np.asarray(multihost_utils.process_allgather(local))
+        if jax.process_index() != 0:
+            return None
+        steps = gathered[:, 0]
+        arrivals = gathered[:, 1].astype(np.float64) + gathered[:, 2] / 1e6
+        record = {
+            "event": "heartbeat",
+            "step": int(step),
+            "process_count": int(gathered.shape[0]),
+            **detect_laggards(
+                steps, arrivals, laggard_threshold_s=self.laggard_threshold_s
+            ),
+        }
+        sink_mod.emit(record)
+        return record

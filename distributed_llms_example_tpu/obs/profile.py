@@ -1,0 +1,156 @@
+"""On-demand ``jax.profiler`` capture for the train loop.
+
+Two triggers, one controller:
+
+- **Config window** — ``--profile-steps`` accepts the legacy count form
+  (``3``: trace 3 steps starting 2 after the run's first step, skipping
+  the compile step) or an absolute inclusive window (``100:105``: trace
+  exactly those global steps, e.g. the steps right before a known OOM).
+  Requires ``--profile-dir`` in count form; window form defaults the
+  trace dir under the output dir.
+- **Trigger file** — an operator touches
+  ``<output_dir>/obs/profile.trigger`` on any host and the NEXT step
+  starts a trace there (file contents = step count, default 3).  Polled
+  once per step: one ``os.path.exists`` on the host, nothing on the
+  device.  The file is consumed (removed) when the capture starts so a
+  shared filesystem does not re-trigger every host forever.
+
+Traces land under ``<trace_dir>/proc{process_index:03d}`` — every process
+captures its own host's view (jax.profiler traces are process-local), and
+the index keeps a shared output dir collision-free.
+
+The stop path syncs on the step's loss before ``stop_trace`` so the
+traced window contains completed steps — the one deliberate device sync,
+and it only ever happens on the window's closing step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from distributed_llms_example_tpu.obs import sink as sink_mod
+
+DEFAULT_TRIGGER_STEPS = 3
+
+
+def parse_profile_steps(spec: Any) -> tuple[int, int] | int | None:
+    """``"a:b"`` → absolute inclusive window (a, b); ``"n"``/``n`` → the
+    legacy relative count; 0/""/None → off."""
+    if spec is None or spec == "":
+        return None
+    if isinstance(spec, int):
+        return spec if spec > 0 else None
+    s = str(spec).strip()
+    if ":" in s:
+        a, _, b = s.partition(":")
+        start, stop = int(a), int(b)
+        if stop < start or start < 1:
+            raise ValueError(
+                f"--profile-steps window {spec!r} must be start:stop with "
+                "1 <= start <= stop"
+            )
+        return (start, stop)
+    n = int(s)
+    return n if n > 0 else None
+
+
+class ProfileController:
+    """Owns profiler state for one training run."""
+
+    def __init__(
+        self,
+        *,
+        profile_dir: str = "",
+        steps_spec: Any = 0,
+        trigger_path: str = "",
+        start_step: int = 0,
+        output_dir: str = "",
+    ):
+        spec = parse_profile_steps(steps_spec)
+        self.trigger_path = trigger_path
+        self.window: tuple[int, int] | None = None
+        self.profile_dir = profile_dir
+        if isinstance(spec, tuple):
+            self.window = spec
+        elif isinstance(spec, int) and profile_dir:
+            # legacy: skip the first (compiled) step so the trace holds
+            # steady-state steps; window is inclusive
+            first = start_step + 2
+            self.window = (first, first + spec - 1)
+        if not self.profile_dir and output_dir:
+            # window/trigger captures without an explicit --profile-dir
+            # land under the output dir
+            self.profile_dir = os.path.join(output_dir, "obs", "profile")
+        self.active = False
+        self._stop_step = 0
+        self._trace_dir = ""
+
+    # -- loop hooks ------------------------------------------------------
+
+    def before_step(self, next_step: int) -> None:
+        """Called before dispatching ``next_step``: open the trace when
+        the configured window begins here, or when the trigger file
+        appeared since the last step."""
+        if self.active:
+            return
+        # range, not equality: a run that resumes INSIDE the window (the
+        # preempt-at-102-of-100:105 case) still captures the remainder
+        if self.window and self.window[0] <= next_step <= self.window[1]:
+            self._start(self.window[1])
+            return
+        if self.trigger_path and os.path.exists(self.trigger_path):
+            steps = DEFAULT_TRIGGER_STEPS
+            try:
+                with open(self.trigger_path) as f:
+                    text = f.read().strip()
+                if text:
+                    steps = max(1, int(text))
+            except (OSError, ValueError):
+                pass
+            try:  # consume so a shared FS doesn't re-trigger forever
+                os.remove(self.trigger_path)
+            except OSError:
+                pass
+            self._start(next_step + steps - 1)
+
+    def after_step(self, step: int, sync_leaf: Any = None) -> None:
+        if self.active and step >= self._stop_step:
+            self._stop(sync_leaf, truncated=False)
+
+    def finalize(self, sync_leaf: Any = None) -> None:
+        """Training ended inside an open window: flush the (short) trace
+        rather than losing it."""
+        if self.active:
+            self._stop(sync_leaf, truncated=True)
+
+    # -- internals -------------------------------------------------------
+
+    def _start(self, stop_step: int) -> None:
+        import jax
+
+        self._trace_dir = os.path.join(
+            self.profile_dir or ".", f"proc{jax.process_index():03d}"
+        )
+        os.makedirs(self._trace_dir, exist_ok=True)
+        jax.profiler.start_trace(self._trace_dir)
+        self.active = True
+        self._stop_step = stop_step
+
+    def _stop(self, sync_leaf: Any, *, truncated: bool) -> None:
+        import jax
+
+        if sync_leaf is not None:
+            jax.block_until_ready(sync_leaf)
+        jax.profiler.stop_trace()
+        self.active = False
+        record = {"event": "profile_trace", "dir": self.profile_dir or self._trace_dir}
+        if truncated:
+            record["truncated"] = True
+        elif self.window and self._stop_step == self.window[1]:
+            record["steps"] = self.window[1] - self.window[0] + 1
+        else:
+            record["trace_dir"] = self._trace_dir
+        # every capturing process announces its own trace (all_processes:
+        # a trigger may fire on one non-zero host only)
+        sink_mod.emit(record, all_processes=True)

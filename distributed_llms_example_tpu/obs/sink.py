@@ -1,0 +1,139 @@
+"""The pluggable metric sink — every JSON-line producer funnels here.
+
+Channels:
+
+- ``StdoutSink``: the Valohai metadata contract.  Byte-for-byte the line
+  the pre-obs ``log_json`` printed (``json.dumps`` with default
+  separators, one line, flushed) so the platform parser and every
+  stdout-scraping consumer (bench supervisor, tests) see an unchanged
+  stream.  Process-0 gated like every producer before it.
+- ``JsonlFileSink``: the same records appended to a per-process JSONL
+  file under the output dir, each stamped with ``schema_version`` so
+  offline consumers can evolve.  Best-effort: a full disk or a vanished
+  output dir must never kill a training step.
+- ``TeeSink``: fan-out.
+
+The module-level sink is what ``utils.jsonlog.log_json`` routes through;
+``install_sink`` swaps it (the Trainer installs per --obs mode at
+startup).  The process gate lives in ``wants`` and is checked BEFORE the
+caller converts device scalars to host floats — on non-zero processes a
+record nobody will emit must not cost a device sync.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Mapping
+
+SCHEMA_VERSION = 1
+
+
+def _process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+class StdoutSink:
+    """The Valohai stdout channel (process 0 only, byte-parity lines)."""
+
+    def wants(self, *, all_processes: bool = False) -> bool:
+        return all_processes or _process_index() == 0
+
+    def emit(self, record: Mapping[str, Any], *, all_processes: bool = False) -> None:
+        if not self.wants(all_processes=all_processes):
+            return
+        print(json.dumps(record), file=sys.stdout, flush=True)
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlFileSink:
+    """Append records to a JSONL file, one ``schema_version``-stamped
+    object per line.  Opened lazily so constructing a sink for a not-yet-
+    created output dir is free; IO errors are swallowed (telemetry must
+    never take down the run)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self._dead = False
+
+    def wants(self, *, all_processes: bool = False) -> bool:
+        return not self._dead and (all_processes or _process_index() == 0)
+
+    def emit(self, record: Mapping[str, Any], *, all_processes: bool = False) -> None:
+        if not self.wants(all_processes=all_processes):
+            return
+        try:
+            if self._f is None:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._f = open(self.path, "a", buffering=1)
+            self._f.write(json.dumps({"schema_version": SCHEMA_VERSION, **record}) + "\n")
+        except OSError:
+            self._dead = True
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+
+class TeeSink:
+    def __init__(self, sinks: list):
+        self.sinks = list(sinks)
+
+    def wants(self, *, all_processes: bool = False) -> bool:
+        return any(s.wants(all_processes=all_processes) for s in self.sinks)
+
+    def emit(self, record: Mapping[str, Any], *, all_processes: bool = False) -> None:
+        for s in self.sinks:
+            s.emit(record, all_processes=all_processes)
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()
+
+
+_DEFAULT = StdoutSink()
+_SINK = _DEFAULT
+
+
+def current_sink():
+    return _SINK
+
+
+def install_sink(sink) -> None:
+    """Swap the process-wide sink (closing the old one unless it is the
+    default stdout sink, which is shared and stateless)."""
+    global _SINK
+    if _SINK is not _DEFAULT and _SINK is not sink:
+        _SINK.close()
+    _SINK = sink
+
+
+def build_sink(mode: str, output_dir: str):
+    """``--obs`` mode → sink.  "off"/"stdout" keep the stdout contract
+    alone ("off" disables the obs *instrumentation*, never the Valohai
+    channel); "jsonl" tees it into ``<output_dir>/obs/metrics-p{i}.jsonl``
+    (process index in the name: multi-host runs share one output dir)."""
+    if mode != "jsonl":
+        return _DEFAULT
+    path = os.path.join(
+        output_dir, "obs", f"metrics-p{_process_index():03d}.jsonl"
+    )
+    return TeeSink([_DEFAULT, JsonlFileSink(path)])
+
+
+def wants(*, all_processes: bool = False) -> bool:
+    return _SINK.wants(all_processes=all_processes)
+
+
+def emit(record: Mapping[str, Any], *, all_processes: bool = False) -> None:
+    _SINK.emit(record, all_processes=all_processes)

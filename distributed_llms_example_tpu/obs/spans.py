@@ -1,0 +1,141 @@
+"""Host-side span tracing for the train loop.
+
+Monotonic-clock spans (``data_wait``, ``step_dispatch``, ``device_sync``,
+``eval``, ``checkpoint``, nested freely) plus a per-step ring buffer from
+which each logging window reports step-time percentiles (p50/p95/max) and
+a straggler flag.  Everything is ``time.perf_counter`` arithmetic on the
+host — recording a span costs two clock reads and a dict update, and
+NOTHING here touches a device, so instrumented non-logging steps keep the
+zero-sync async-dispatch property MetricLogger already guarantees.
+
+The clock is injectable so tests drive the recorder deterministically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Sequence
+
+# step-time max > STRAGGLER_FACTOR × p50 within a window flags the window:
+# on a healthy synchronous-SPMD step the distribution is tight, and a fat
+# max means some host stalled (GC, page cache, a slow storage read) — the
+# local precursor of the cross-host skew the heartbeat watches for.
+STRAGGLER_FACTOR = 2.0
+
+
+def percentiles(values: Sequence[float], qs: Sequence[float]) -> list[float]:
+    """Nearest-rank percentiles of ``values`` (no numpy: callers live on
+    the trainer hot path's cadence and in bench post-processing)."""
+    if not values:
+        return [0.0 for _ in qs]
+    s = sorted(values)
+    out = []
+    for q in qs:
+        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        out.append(s[idx])
+    return out
+
+
+class SpanRecorder:
+    """Ring-buffered span/step-time recorder with window summaries.
+
+    ``span(name)`` times a (possibly nested) region; ``step_complete()``
+    closes one loop iteration and records its wall duration in the ring.
+    ``summary()`` reports the window since the previous summary —
+    per-step percentiles plus per-span aggregates — and resets the window
+    (the ring keeps ``ring_size`` steps for end-of-run retrospectives).
+    """
+
+    def __init__(
+        self,
+        ring_size: int = 512,
+        clock: Callable[[], float] = time.perf_counter,
+        straggler_factor: float = STRAGGLER_FACTOR,
+    ):
+        self.ring_size = int(ring_size)
+        self.clock = clock
+        self.straggler_factor = float(straggler_factor)
+        self._ring: list[float] = []  # per-step wall seconds, newest last
+        self._depth = 0
+        self._window_spans: dict[str, list[float]] = {}  # name → [total_s, count, max_s]
+        self._window_steps = 0
+        self._window_t0 = clock()
+        self._step_t0: float | None = None
+
+    # -- recording -------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        self._depth += 1
+        t0 = self.clock()
+        try:
+            yield
+        finally:
+            dt = self.clock() - t0
+            self._depth -= 1
+            agg = self._window_spans.get(name)
+            if agg is None:
+                self._window_spans[name] = [dt, 1, dt]
+            else:
+                agg[0] += dt
+                agg[1] += 1
+                if dt > agg[2]:
+                    agg[2] = dt
+
+    def step_complete(self) -> None:
+        """One train-loop iteration finished: record its wall duration
+        (time since the previous ``step_complete`` / window start)."""
+        now = self.clock()
+        t0 = self._step_t0 if self._step_t0 is not None else self._window_t0
+        self._ring.append(now - t0)
+        if len(self._ring) > self.ring_size:
+            del self._ring[: len(self._ring) - self.ring_size]
+        self._step_t0 = now
+        self._window_steps += 1
+
+    def mark_step_start(self) -> None:
+        """Re-anchor the per-step clock.  The trainer calls this after
+        cadenced non-step work (checkpoint save, eval) so that wall time
+        — already tracked under its own span — is not also charged to
+        the NEXT step's ring-buffer duration (which would fire the
+        straggler flag on every healthy eval cadence)."""
+        self._step_t0 = self.clock()
+
+    # -- reporting -------------------------------------------------------
+
+    def window_step_times(self) -> list[float]:
+        if self._window_steps == 0:
+            return []
+        return self._ring[-min(self._window_steps, len(self._ring)):]
+
+    def summary(self) -> dict | None:
+        """Close the window: step-time percentiles + span aggregates.
+        None when no step completed since the last summary (telemetry
+        cadence fired before any work — nothing to report)."""
+        times = self.window_step_times()
+        if not times:
+            return None
+        now = self.clock()
+        p50, p95 = percentiles(times, (0.50, 0.95))
+        mx = max(times)
+        out = {
+            "window_steps": self._window_steps,
+            "window_seconds": round(now - self._window_t0, 6),
+            "step_ms_p50": round(p50 * 1e3, 3),
+            "step_ms_p95": round(p95 * 1e3, 3),
+            "step_ms_max": round(mx * 1e3, 3),
+            "straggler": bool(p50 > 0 and mx > self.straggler_factor * p50),
+            "spans": {
+                name: {
+                    "total_ms": round(total * 1e3, 3),
+                    "count": count,
+                    "max_ms": round(peak * 1e3, 3),
+                }
+                for name, (total, count, peak) in sorted(self._window_spans.items())
+            },
+        }
+        self._window_spans = {}
+        self._window_steps = 0
+        self._window_t0 = now
+        return out

@@ -72,6 +72,11 @@ class Trainer:
         mesh: Any | None = None,
     ):
         self.cfg = cfg
+        # sink first: every log_json below (device_report included) must
+        # already flow through the --obs channel
+        from distributed_llms_example_tpu.obs.sink import build_sink, install_sink
+
+        install_sink(build_sink(getattr(cfg, "obs", "stdout"), cfg.output_dir))
         self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
         log_json({"event": "device_report", **device_report()})
 
@@ -450,6 +455,17 @@ class Trainer:
         # hardware RNG — mask generation is then nearly free, where
         # threefry's counter math can cost ~20% of a dropout-on step
         self.set_prng_impl(cfg.prng_impl)
+        # telemetry bundle (obs/): span recorder, profiler controller,
+        # heartbeat, and — under --obs jsonl / --obs-gauges on — the
+        # startup AOT gauge compile (MFU FLOPs numerator + the static
+        # collective-traffic account).  stage>1 skips the gauge compile:
+        # the shared recipe, like the IR lint, does not cover pipelined
+        # shard_map programs yet (ROADMAP open item).
+        from distributed_llms_example_tpu.obs import TrainerObs
+
+        self.obs = TrainerObs(cfg, start_step=self.start_step, manage_sink=False)
+        if not self.pipelined:
+            self.obs.startup_gauges(self.mesh, tgt_cap=tgt_cap)
 
     # ------------------------------------------------------------------
 
@@ -719,20 +735,17 @@ class Trainer:
 
     def _train_loop(self) -> dict[str, Any]:
         cfg = self.cfg
+        obs = self.obs
+        obs.set_start_step(self.start_step)
         logger = MetricLogger(every=cfg.log_every_steps)
         self._preempt_sync_every = max(1, cfg.log_every_steps)
         step = self.start_step
         t0 = time.perf_counter()
         last_eval: dict[str, float] = {}
+        last_metrics: dict[str, Any] | None = None
         steps_per_epoch = self.batches.steps_per_epoch()
         start_epoch = step // steps_per_epoch
-        profile_stop_step = 0
-        profiling_active = False
-        if cfg.profile_dir and cfg.profile_steps > 0:
-            # skip step 1 (compilation) so the trace holds steady-state steps;
-            # the traced window is [start, start + profile_steps - 1] inclusive
-            profile_start_step = self.start_step + 2
-            profile_stop_step = profile_start_step + cfg.profile_steps - 1
+        epoch = start_epoch
         for epoch in range(start_epoch, cfg.num_epochs):
             # assemble host batches (tokenize/pad/bucket) on a background
             # thread, prefetch_batches ahead, so input work overlaps the
@@ -745,37 +758,45 @@ class Trainer:
             if cfg.prefetch_batches > 0:
                 epoch_batches = Prefetcher(epoch_batches, depth=cfg.prefetch_batches)
             try:
-                for batch in epoch_batches:
-                    if profile_stop_step and step + 1 == profile_start_step:
-                        jax.profiler.start_trace(cfg.profile_dir)
-                        profiling_active = True
-                    gb = put_batch(batch, self.mesh, sequence_sharded=self.sequence_sharded)
-                    if self.use_dropout:
-                        self._rng, sub = jax.random.split(self._rng)
-                        self.state, metrics = self.train_step(self.state, gb, sub)
-                    else:
-                        self.state, metrics = self.train_step(self.state, gb)
+                for batch in obs.wrap_batches(epoch_batches):
+                    obs.profiler.before_step(step + 1)
+                    with obs.step_span():
+                        gb = put_batch(batch, self.mesh, sequence_sharded=self.sequence_sharded)
+                        if self.use_dropout:
+                            self._rng, sub = jax.random.split(self._rng)
+                            self.state, metrics = self.train_step(self.state, gb, sub)
+                        else:
+                            self.state, metrics = self.train_step(self.state, gb)
                     step += 1
-                    if profiling_active and step == profile_stop_step:
-                        jax.block_until_ready(metrics["loss"])
-                        jax.profiler.stop_trace()
-                        log_json({"event": "profile_trace", "dir": cfg.profile_dir, "steps": cfg.profile_steps})
-                        profiling_active = False
+                    last_metrics = metrics
                     tokens = self._batch_tokens(batch) * jax.process_count()
                     # pass DEVICE scalars: converting here (float(...)) would
                     # block on the step every iteration and serialize JAX's
-                    # async dispatch — the logger converts only on emit
-                    logger.step(
-                        step,
-                        metrics["loss"],
-                        lr=metrics["learning_rate"],
-                        tokens=tokens,
-                        epoch=epoch,
-                    )
+                    # async dispatch — the logger converts only on emit (the
+                    # device_sync span times exactly that cadenced readback)
+                    with obs.sync_span():
+                        logger.step(
+                            step,
+                            metrics["loss"],
+                            lr=metrics["learning_rate"],
+                            tokens=tokens,
+                            epoch=epoch,
+                        )
+                    # per-step obs bookkeeping: step-time ring, profiler
+                    # stop, cadenced heartbeat + window summary — before
+                    # checkpoint/eval so their wall time rides their own
+                    # spans, not this step's duration
+                    obs.on_step(step, epoch, metrics)
                     if self.checkpointer.should_save(step):
-                        self.checkpointer.save(step, self._with_layout(self.state))
+                        with obs.checkpoint_span():
+                            self.checkpointer.save(step, self._with_layout(self.state))
                     if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
-                        last_eval = self.evaluate(epoch)
+                        with obs.eval_span():
+                            last_eval = self.evaluate(epoch)
+                    # re-anchor the step clock: checkpoint/eval time is on
+                    # their own spans and must not inflate the NEXT step's
+                    # ring-buffer duration (false straggler flags)
+                    obs.spans.mark_step_start()
                     if self._check_preemption(step):
                         self._preempted = True  # agreed across hosts
                         break
@@ -794,13 +815,18 @@ class Trainer:
                 self._preempted = self._preemption_agreed()
             if self._preempted:
                 break
-            last_eval = self.evaluate(epoch)  # per-epoch eval, reference parity
-        if profiling_active:
-            # training ended inside the trace window — close it so the trace
-            # (however short) is flushed rather than lost
-            jax.block_until_ready(metrics["loss"])
-            jax.profiler.stop_trace()
-            log_json({"event": "profile_trace", "dir": cfg.profile_dir, "truncated": True})
+            # epoch boundary: emit the partial metric window (the fix for
+            # the lost-final-window cadence bug) before the eval resets
+            # the wall clocks
+            logger.flush(step, epoch=epoch)
+            with obs.eval_span():
+                last_eval = self.evaluate(epoch)  # per-epoch eval, reference parity
+        logger.flush(step, epoch=epoch)
+        # close any open trace window (flushed, not lost) and emit the
+        # final obs window
+        obs.finalize(
+            step, epoch, sync_leaf=last_metrics["loss"] if last_metrics else None
+        )
         if self._preempted:
             # save where we stopped and get out; resume restarts from here
             self.checkpointer.save(step, self._with_layout(self.state), force=True)

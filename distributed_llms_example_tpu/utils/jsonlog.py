@@ -7,12 +7,17 @@ train-accelerator.py:230-232 loss dumps, train-task.py:301-303), each with
 its own rank-noise control (non-main ranks silenced via log levels,
 train-accelerator.py:45-51).  Here there is one producer and it is
 process-0-only by construction.
+
+Since the obs subsystem landed, ``log_json`` routes through the pluggable
+sink (obs/sink.py): the stdout channel stays byte-for-byte what it always
+printed (the Valohai contract — guarded by tests/test_obs.py), and
+``--obs jsonl`` tees the same records, ``schema_version``-stamped, into a
+JSONL file under the output dir.
 """
 
 from __future__ import annotations
 
 import json
-import sys
 import time
 from typing import Any, Mapping
 
@@ -29,13 +34,26 @@ def _to_scalar(v: Any) -> Any:
 
 
 def log_json(metrics: Mapping[str, Any], *, all_processes: bool = False, file=None) -> None:
-    """Print ``metrics`` as a single JSON line from process 0 (parity with
-    the reference's PrinterCallback, train-torchrun.py:144-147, which strips
-    the ``total_flos`` noise key — callers here just don't add noise)."""
-    if not all_processes and jax.process_index() != 0:
+    """Emit ``metrics`` as a single JSON line through the active sink
+    (stdout by default, process-0 gated: parity with the reference's
+    PrinterCallback, train-torchrun.py:144-147).  An explicit ``file``
+    bypasses the sink (callers that capture findings into a buffer).
+
+    The sink/process gate runs BEFORE scalar conversion: on non-emitting
+    processes the device values are never ``.item()``-ed, so non-logging
+    ranks keep costing zero device syncs."""
+    if file is not None:
+        if not all_processes and jax.process_index() != 0:
+            return
+        out = {k: _to_scalar(v) for k, v in metrics.items()}
+        print(json.dumps(out), file=file, flush=True)
+        return
+    from distributed_llms_example_tpu.obs import sink
+
+    if not sink.wants(all_processes=all_processes):
         return
     out = {k: _to_scalar(v) for k, v in metrics.items()}
-    print(json.dumps(out), file=file or sys.stdout, flush=True)
+    sink.emit(out, all_processes=all_processes)
 
 
 class MetricLogger:
@@ -43,7 +61,10 @@ class MetricLogger:
 
     Cadence control replaces the reference's three hardcoded cadences
     (10/300/100 steps — train-torchrun.py:122, train-accelerator.py:230,
-    train-task.py:301) with one configurable ``every``.
+    train-task.py:301) with one configurable ``every``.  The first report
+    lands at step ``every`` — never at step 0, whose window would be
+    empty — and ``flush()`` (called by the Trainer at epoch/run end)
+    emits the final partial window instead of dropping it.
     """
 
     def __init__(self, every: int = 100):
@@ -51,6 +72,7 @@ class MetricLogger:
         self._t0 = time.perf_counter()
         self._tokens_since = 0
         self._steps_since = 0
+        self._last: tuple[Any, Any] | None = None  # (loss, lr) of newest step
 
     def step(self, step: int, loss: Any, lr: Any = None, tokens: int = 0, **extra: Any) -> None:
         """``loss``/``lr`` may be 0-d device arrays: they are converted to
@@ -59,8 +81,21 @@ class MetricLogger:
         pipelining across the logging cadence."""
         self._tokens_since += tokens
         self._steps_since += 1
-        if step % self.every != 0:
+        self._last = (loss, lr)
+        if step == 0 or step % self.every != 0:
             return
+        self._emit(step, loss, lr, extra)
+
+    def flush(self, step: int, **extra: Any) -> None:
+        """Emit the pending partial window (no-op when the last report
+        already covered every step).  Uses the most recent step's
+        loss/lr — still device scalars, converted only here."""
+        if self._steps_since == 0 or self._last is None:
+            return
+        loss, lr = self._last
+        self._emit(step, loss, lr, extra)
+
+    def _emit(self, step: int, loss: Any, lr: Any, extra: Mapping[str, Any]) -> None:
         dt = time.perf_counter() - self._t0
         m: dict[str, Any] = {"step": step, "loss": loss}
         if lr is not None:

@@ -32,7 +32,6 @@ Two views are reported:
 from __future__ import annotations
 
 import argparse
-import json
 from typing import Any
 
 HBM_BYTES_V5E = 16 * 1024**3
@@ -374,7 +373,11 @@ def main(argv: list[str] | None = None) -> int:
         grad_accum_steps=args.grad_accum_steps,
         compile=not args.analytic,
     )
-    print(json.dumps(report))
+    # the audit JSON line rides the metric sink (scripts/repo_lint.py
+    # forbids direct print(json.dumps(...)) emission outside obs/)
+    from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+    log_json(report)
     fits = report["fits_v5e_hbm"] and (
         not args.strict or report["fits_v5e_hbm_conservative"]
     )

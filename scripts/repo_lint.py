@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Two rules, both born from real regressions at TPU scale:
+Three rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -15,9 +15,17 @@ Two rules, both born from real regressions at TPU scale:
    historical exceptions are pinned in an explicit allowlist so NEW ones
    fail review here.
 
+3. **No direct ``print(json.dumps(...))`` metric emission outside the
+   sink layer.**  The JSON-lines stdout stream is a parsed platform
+   contract (Valohai metadata) with one schema and one process gate —
+   a rogue producer bypasses the ``--obs`` sink (its records never reach
+   the JSONL file channel), skips ``schema_version`` stamping, and emits
+   from every process.  Emission belongs in ``obs/`` and
+   ``utils/jsonlog.py``; everyone else calls ``log_json``.
+
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
-into the fast test suite (tests/test_analysis.py) next to the analysis-CLI
-smoke run.
+into the fast test suite (tests/test_analysis.py, tests/test_obs.py) next
+to the analysis-CLI smoke run.
 """
 
 from __future__ import annotations
@@ -52,6 +60,24 @@ SPEC_LITERAL_ALLOWLIST = {
 FORBIDDEN_SYNC_ATTRS = ("block_until_ready",)
 FORBIDDEN_SYNC_CALLS = (("jax", "device_get"),)
 
+# The sink layer: the only places allowed to print JSON lines directly.
+JSON_EMIT_ALLOW_DIRS = (
+    os.path.join(PACKAGE, "obs"),
+)
+JSON_EMIT_ALLOW_FILES = {
+    os.path.join(PACKAGE, "utils", "jsonlog.py"),
+}
+
+
+def _is_json_dumps_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "dumps"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "json"
+    )
+
 
 def _spec_call_has_str_literal(node: ast.Call) -> bool:
     def holds_str(n: ast.AST) -> bool:
@@ -74,8 +100,24 @@ def lint_file(path: str, rel: str) -> list[str]:
     hot = rel in HOT_PATH_FILES
     in_spec_layer = any(rel.startswith(d + os.sep) for d in SPEC_LAYER_DIRS)
     allowed_spec = rel in SPEC_LITERAL_ALLOWLIST
+    json_emit_ok = rel in JSON_EMIT_ALLOW_FILES or any(
+        rel.startswith(d + os.sep) for d in JSON_EMIT_ALLOW_DIRS
+    )
 
     for node in ast.walk(tree):
+        if (
+            not json_emit_ok
+            and isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+            and any(_is_json_dumps_call(a) for a in node.args)
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: print(json.dumps(...)) outside "
+                "obs//utils/jsonlog.py bypasses the metric sink (no "
+                "schema_version, no process gate, invisible to --obs "
+                "jsonl) — emit through utils.jsonlog.log_json"
+            )
         if hot and isinstance(node, ast.Attribute) and node.attr in FORBIDDEN_SYNC_ATTRS:
             violations.append(
                 f"{rel}:{node.lineno}: .{node.attr}() in the train-step hot "

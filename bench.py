@@ -931,6 +931,40 @@ def main() -> None:
     # Consumers take the LAST result line (module docstring contract).
     print(json.dumps(result), flush=True)
 
+    # health-telemetry overhead: the SAME step compiled with the in-graph
+    # numerics (param norm, per-bucket update ratios, non-finite counts —
+    # train/step.py health_metrics).  The contract is <2% vs the plain
+    # step: a handful of elementwise reductions must stay invisible next
+    # to the matmuls, or --health on costs real throughput at scale.
+    max_overhead = float(os.environ.get("BENCH_HEALTH_MAX_OVERHEAD", "0.02"))
+    if os.environ.get("BENCH_HEALTH", "1") != "0" and not over_budget("health step"):
+        try:
+            build_h = make_train_step(lm.module, lm.config, tx, schedule, mesh, health=True)
+            step_h, _ = build_h(state)
+            for _ in range(2):
+                state, metrics = step_h(state, gb)
+            sync(state, metrics)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step_h(state, gb)
+            sync(state, metrics)
+            dth = time.perf_counter() - t0
+            tps_chip_health = tokens_per_step * steps / dth / n_chips
+            overhead = 1.0 - tps_chip_health / tps_chip
+            result["health_tokens_per_sec_chip"] = round(tps_chip_health, 1)
+            result["health_overhead_frac"] = round(overhead, 4)
+            result["health_overhead_ok"] = bool(overhead <= max_overhead)
+            if overhead > max_overhead:
+                print(
+                    f"bench: HEALTH OVERHEAD {overhead:.1%} exceeds the "
+                    f"{max_overhead:.0%} budget — the in-graph numerics are "
+                    "on the critical path",
+                    file=sys.stderr,
+                )
+            print(json.dumps(result), flush=True)
+        except Exception as e:
+            print(f"bench: health-step bench failed ({e})", file=sys.stderr)
+
     # The Trainer trains with the model's real dropout (bart-large-cnn:
     # 0.1, the reference's recipe) while the headline synthetic step runs
     # dropout-free — measured on v5e, dropout alone costs ~20%.  Measure a

@@ -164,6 +164,63 @@ def model_tree_element_candidates(
     return out
 
 
+def account_gradient_bytes_by_op(account: Mapping[str, Any]) -> dict[str, int]:
+    """Adapter: the obs collective-traffic account (obs/gauges.py
+    ``collective_traffic`` — per-op dicts with ``gradient_bytes``) →
+    the flat ``{op: gradient_bytes}`` map the reduce-scatter predicate
+    consumes, so the SAME predicate runs over the IR census and the
+    runtime account."""
+    out: dict[str, int] = {}
+    for op, slot in account.items():
+        if isinstance(slot, Mapping) and "gradient_bytes" in slot:
+            out[op] = int(slot["gradient_bytes"])
+    return out
+
+
+def reduce_scatter_smell(
+    gradient_bytes_by_op: Mapping[str, int],
+    mesh_axes: Mapping[str, Any],
+    *,
+    ratio: float = 2.0,
+    min_bytes: int = 1 << 20,
+) -> Finding | None:
+    """The ROADMAP reduce-scatter smell as a PURE predicate over a
+    gradient-byte account: on an fsdp mesh, gradient bytes riding
+    all-reduce ≫ bytes riding reduce-scatter means the partitioner kept
+    the gradients replicated through the reduction — the 2× gradient-
+    traffic anti-pattern (arxiv 2004.13336).  ``-start`` async forms are
+    folded into their base op; ``min_bytes`` keeps toy programs quiet.
+    Works identically over the IR census's ``gradient_bytes_by_op`` and
+    the obs runtime account (via ``account_gradient_bytes_by_op``)."""
+    if int(mesh_axes.get("fsdp", 1) or 1) <= 1:
+        return None
+    merged: dict[str, int] = {}
+    for op, b in gradient_bytes_by_op.items():
+        base = op[: -len("-start")] if op.endswith("-start") else op
+        merged[base] = merged.get(base, 0) + int(b)
+    ar = merged.get("all-reduce", 0)
+    rs = merged.get("reduce-scatter", 0)
+    if ar < max(int(min_bytes), int(ratio * max(rs, 1))):
+        return None
+    return Finding(
+        severity="warning",
+        pass_name="ir",
+        code="gradient-all-reduce-not-reduce-scatter",
+        message=(
+            f"{ar / 1024**2:.1f} MiB of gradient bytes ride all-reduce vs "
+            f"{rs / 1024**2:.1f} MiB on reduce-scatter on an fsdp mesh "
+            f"(fsdp={mesh_axes.get('fsdp')}) — sharded gradients should "
+            "reduce-scatter; an all-reduce keeps them replicated through "
+            "the reduction and pays ~2× the gradient traffic"
+        ),
+        context={
+            "all_reduce_gradient_bytes": ar,
+            "reduce_scatter_gradient_bytes": rs,
+            "ratio_threshold": ratio,
+        },
+    )
+
+
 def scan_hlo_text(
     hlo_text: str,
     *,
@@ -314,6 +371,9 @@ def scan_hlo_text(
             if touched & candidates:
                 grad_bytes[instr.op] = grad_bytes.get(instr.op, 0) + instr.bytes
         context["gradient_bytes_by_op"] = grad_bytes
+        smell = reduce_scatter_smell(grad_bytes, mesh_axes)
+        if smell is not None:
+            findings.append(smell)
     findings.append(Finding(
         severity="info",
         pass_name="ir",

@@ -196,6 +196,30 @@ class TrainConfig:
     # MFU denominator: peak per-chip FLOP/s in TFLOP/s (v5e bf16 ≈ 197)
     obs_peak_tflops: float = 197.0
 
+    # --- training health (obs/health.py + in-graph numerics in train/step.py) ---
+    # "on": the compiled step also returns param norm, per-bucket update
+    # ratios and non-finite grad counts (computed in-graph, zero extra
+    # device syncs) and the anomaly watchdog consumes them at the log
+    # cadence; "auto" = on under --obs jsonl; "off" = neither
+    health: str = "auto"
+    # what the run does when an anomaly is agreed across hosts:
+    # "warn" logs obs_anomaly and continues; "halt" stops the run (no
+    # extra save); "checkpoint" force-saves a resumable checkpoint, dumps
+    # the flight recorder, and stops
+    on_anomaly: str = "warn"
+    # flight-recorder ring capacity in steps (0 = off): the last N steps'
+    # metrics + batch fingerprints, dumped on anomaly/SIGTERM/crash
+    recorder_steps: int = 256
+    # loss-spike threshold: loss above the EWMA by this many mean
+    # absolute deviations trips "loss_spike"
+    health_loss_spike_factor: float = 4.0
+    # grad-norm explosion threshold: grad_norm above this multiple of its
+    # EWMA trips "grad_explosion"
+    health_grad_norm_factor: float = 10.0
+    # finite steps the EWMAs absorb before spike/explosion detection arms
+    # (the NaN/Inf tripwire is always armed)
+    health_warmup_steps: int = 20
+
     # --- profiling (SURVEY.md §7 step 8: jax.profiler hooks; the reference's
     #     only "profiling" is an nvidia-smi report at startup) ---
     profile_dir: str = ""  # "" = profiling off; else write a trace here
@@ -316,6 +340,35 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--obs-heartbeat-steps", type=int, default=_D.obs_heartbeat_steps)
     p.add_argument("--obs-peak-tflops", type=float, default=_D.obs_peak_tflops)
+    p.add_argument(
+        "--health", type=str, default=_D.health, choices=("auto", "on", "off"),
+        help="in-graph numerics (param norm, per-bucket update ratios, "
+             "non-finite counts) + the anomaly watchdog at the log cadence "
+             "(auto = on under --obs jsonl)",
+    )
+    p.add_argument(
+        "--on-anomaly", type=str, default=_D.on_anomaly,
+        choices=("warn", "halt", "checkpoint"),
+        help="agreed-anomaly policy: warn and continue, halt the run, or "
+             "force-save a resumable checkpoint + flight-recorder bundle "
+             "and stop",
+    )
+    p.add_argument(
+        "--recorder-steps", type=int, default=_D.recorder_steps,
+        help="flight-recorder ring capacity in steps (0 = off); dumped to "
+             "<output-dir>/obs/flight-recorder-p*.json on anomaly/SIGTERM/crash",
+    )
+    p.add_argument(
+        "--health-loss-spike-factor", type=float,
+        default=_D.health_loss_spike_factor,
+    )
+    p.add_argument(
+        "--health-grad-norm-factor", type=float,
+        default=_D.health_grad_norm_factor,
+    )
+    p.add_argument(
+        "--health-warmup-steps", type=int, default=_D.health_warmup_steps,
+    )
     p.add_argument("--save-every-steps", type=int, default=_D.checkpoint.save_every_steps)
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--mesh", type=str, default="data=-1", help="comma list axis=size, e.g. data=2,fsdp=4,tensor=1")

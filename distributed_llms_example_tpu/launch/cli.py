@@ -17,6 +17,16 @@ Observability: ``--obs jsonl`` tees every metric line into
 (MFU, collective-traffic account); ``--obs-heartbeat-steps N`` adds the
 multi-host liveness probe; ``--profile-steps 100:105`` captures a
 jax.profiler trace for that step window (see README "Observability").
+
+Training health: ``--health`` (auto under ``--obs jsonl``) makes the
+compiled step return in-graph numerics (param norm, per-bucket update
+ratios, non-finite counts — zero extra device syncs) and arms the
+anomaly watchdog; ``--on-anomaly warn|halt|checkpoint`` sets the agreed
+policy; ``--recorder-steps N`` keeps a flight-recorder ring dumped on
+anomaly/SIGTERM/crash.  Post-mortem: ``python -m
+distributed_llms_example_tpu.obs.report <output-dir>`` merges the
+per-process JSONL into a cross-host timeline (see README "Training
+health & post-mortem").
 """
 
 from __future__ import annotations

@@ -23,6 +23,18 @@ one.  This package supplies those signals in four layers:
                 step cadence
 - ``heartbeat`` multi-host liveness/step-skew probe so process 0 reports
                 laggards before a collective hangs silently
+- ``health``    the training-signal watchdog: consumes the in-graph
+                numerics (train/step.py ``health_metrics``) at the log
+                cadence — NaN/Inf tripwire, EWMA loss-spike, grad-norm
+                explosion — with multi-host agreement over the heartbeat
+                allgather channel and a ``warn``/``halt``/``checkpoint``
+                policy
+- ``recorder``  the flight recorder: a bounded ring of the last N steps'
+                metrics + batch fingerprints, dumped as a schema-stamped
+                bundle on anomaly / SIGTERM / crash
+- ``report``    the offline consumer: merges the per-process JSONL into
+                a cross-host step timeline (``python -m
+                distributed_llms_example_tpu.obs.report <output_dir>``)
 
 Everything funnels through ``sink`` (stdout Valohai channel + optional
 JSONL file, same schema).  ``TrainerObs`` below is the one object the
@@ -31,14 +43,26 @@ Trainer holds — it owns the wiring so the train loop stays readable.
 
 from __future__ import annotations
 
+import math
 import os
 from typing import Any, Iterable, Iterator
 
+from distributed_llms_example_tpu.obs import health as health_mod
 from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.health import HealthWatchdog, health_enabled
 from distributed_llms_example_tpu.obs.heartbeat import Heartbeat
 from distributed_llms_example_tpu.obs.profile import ProfileController
+from distributed_llms_example_tpu.obs.recorder import FlightRecorder, batch_fingerprint
 from distributed_llms_example_tpu.obs.sink import build_sink, install_sink
 from distributed_llms_example_tpu.obs.spans import SpanRecorder
+
+__all__ = [
+    "TrainerObs",
+    "HealthWatchdog",
+    "FlightRecorder",
+    "batch_fingerprint",
+    "health_enabled",
+]
 
 
 class TrainerObs:
@@ -70,6 +94,29 @@ class TrainerObs:
         self.heartbeat = Heartbeat(every_steps=hb_every) if (
             self.enabled and hb_every > 0
         ) else None
+        # training-health layer: the watchdog consumes the in-graph
+        # numerics at the log cadence; the recorder rings every step
+        self.health_on = health_enabled(cfg)
+        self.on_anomaly = getattr(cfg, "on_anomaly", "warn")
+        self.watchdog = (
+            HealthWatchdog(
+                loss_spike_factor=float(getattr(cfg, "health_loss_spike_factor", 4.0)),
+                grad_norm_factor=float(getattr(cfg, "health_grad_norm_factor", 10.0)),
+                warmup_steps=int(getattr(cfg, "health_warmup_steps", 20)),
+            )
+            if self.health_on
+            else None
+        )
+        # gated on obs OR health: --obs off --health on --on-anomaly
+        # checkpoint still promises a bundle with the checkpoint
+        rec_steps = int(getattr(cfg, "recorder_steps", 0) or 0)
+        self.recorder = (
+            FlightRecorder(rec_steps)
+            if (rec_steps > 0 and (self.enabled or self.health_on))
+            else None
+        )
+        self._pending_health: list[tuple[int, dict]] = []
+        self._last_health: dict[str, Any] | None = None
         self._trigger = getattr(cfg, "profile_trigger", "") or (
             os.path.join(cfg.output_dir, "obs", "profile.trigger")
             if self.enabled
@@ -161,15 +208,74 @@ class TrainerObs:
     def checkpoint_span(self):
         return self.spans.span("checkpoint")
 
-    def on_step(self, step: int, epoch: int, metrics: dict) -> None:
-        """Per-step bookkeeping: host clocks only, except the profiler's
-        stop sync (cadenced) and the heartbeat gather (cadenced)."""
+    def on_step(
+        self,
+        step: int,
+        epoch: int,
+        metrics: dict,
+        fingerprint: dict | None = None,
+    ) -> str:
+        """Per-step bookkeeping: host clocks only (pointer appends for the
+        recorder/health pending list), except the profiler's stop sync
+        (cadenced), the heartbeat gather (cadenced), and the health
+        window's one device_get (cadenced).  Returns the anomaly policy
+        action for the train loop: "ok" / "warn" / "halt" / "checkpoint".
+        """
         self.profiler.after_step(step, metrics.get("loss"))
         self.spans.step_complete()
+        if self.recorder is not None:
+            self.recorder.record(step, epoch, metrics, fingerprint)
+        if self.watchdog is not None:
+            self._pending_health.append((step, dict(metrics)))
         if self.heartbeat is not None and step % self.heartbeat.every == 0:
             self.heartbeat.beat(step)
-        if self.enabled and step % self.every == 0:
-            self.emit_window(step, epoch)
+        action = "ok"
+        if step % self.every == 0:
+            if self.watchdog is not None:
+                action = self._health_cadence(step)
+            if self.enabled:
+                self.emit_window(step, epoch)
+        return action
+
+    def _health_cadence(self, step: int) -> str:
+        """The log-cadence health check: resolve the window's device
+        scalars to host floats (ONE transfer — the same fetch the metric
+        logger pays), run the detectors, agree across hosts, apply the
+        policy.  Every process runs this at the same step, so the
+        returned action is pod-consistent."""
+        if not self._pending_health:
+            return "ok"
+        entries = health_mod.to_host(self._pending_health)
+        self._pending_health = []
+        if self.recorder is not None:
+            for s, vals in entries:
+                self.recorder.annotate(s, vals)
+        last_step, last_vals = entries[-1]
+        # non-finite values become strings: an anomalous window is exactly
+        # when these are NaN, and a bare NaN literal is invalid JSON on
+        # the stdout/JSONL channels (same convention as the recorder)
+        self._last_health = {
+            k: (float(f"{v:.6g}") if math.isfinite(v) else repr(v))
+            for k, v in last_vals.items()
+            if k in ("param_norm", "grad_norm", "nonfinite_count")
+            or k.startswith("update_ratio_")
+        }
+        anomalies = self.watchdog.check(entries)
+        event = health_mod.agree_and_emit(
+            anomalies, step=step, policy=self.on_anomaly
+        )
+        if event is None:
+            return "ok"
+        if self.recorder is not None:
+            self.recorder.dump(
+                self.cfg.output_dir,
+                reason=f"anomaly:{event['code']}",
+                step=step,
+                anomalies=anomalies,
+            )
+        # the last window must survive whatever the policy does next
+        sink_mod.flush(fsync=True)
+        return self.on_anomaly
 
     def emit_window(self, step: int, epoch: int | None = None) -> None:
         summary = self.spans.summary()
@@ -184,12 +290,16 @@ class TrainerObs:
             # significant digits, not decimal places: a CPU-mesh MFU of
             # 2e-9 must not round to a flat 0.0
             record["mfu"] = float(f"{mfu:.4g}")
+        if self._last_health is not None:
+            record["health"] = self._last_health
         from distributed_llms_example_tpu.obs.gauges import hbm_stats
 
         hbm = hbm_stats()
         if hbm is not None:
             record["hbm"] = hbm
-        sink_mod.emit(record)
+        # local: every process's window lands in its OWN jsonl file (the
+        # cross-host timeline obs/report.py merges); stdout stays p0-only
+        sink_mod.emit(record, local=True)
 
     def window_mfu(self, summary: dict) -> float | None:
         """MFU over the just-closed window: compiled-step FLOPs × steps
@@ -210,7 +320,17 @@ class TrainerObs:
 
     # -- shutdown --------------------------------------------------------
 
-    def finalize(self, step: int, epoch: int | None = None, sync_leaf: Any = None) -> None:
+    def finalize(self, step: int, epoch: int | None = None, sync_leaf: Any = None) -> str:
+        """End of run: close the profiler, run the health check over the
+        final partial window (a NaN in the last steps must still fire),
+        emit the final span window, and push the file channel to disk.
+        Returns the final health action (informational — the loop is
+        already over)."""
         self.profiler.finalize(sync_leaf)
+        action = "ok"
+        if self.watchdog is not None and self._pending_health:
+            action = self._health_cadence(step)
         if self.enabled:
             self.emit_window(step, epoch)
+        sink_mod.flush(fsync=True)
+        return action

@@ -1,0 +1,252 @@
+"""The training-health anomaly watchdog.
+
+The in-graph numerics (train/step.py ``health_metrics``) make every step
+self-describing: loss, global grad norm, per-bucket update ratios, and a
+non-finite element count ride the metrics dict as device scalars.  This
+module is their consumer — host-side, cadence-gated:
+
+- every step the Trainer's obs bundle APPENDS the device scalars to a
+  pending list (two pointer writes, no device sync);
+- at the logging cadence the whole window converts to host floats in one
+  ``jax.device_get`` (the same fetch the MetricLogger already pays) and
+  the detectors run over the per-step values — so an anomaly is
+  attributed to the exact step it happened, not the cadence step that
+  noticed it;
+- detectors: a NaN/Inf **tripwire** (non-finite loss or any non-finite
+  grad element — fires immediately, no warmup), an EWMA **loss-spike**
+  detector (loss above the running mean by ``spike_factor`` mean
+  absolute deviations), and a **grad-norm explosion** threshold
+  (``grad_factor`` × the EWMA grad norm, plus an optional absolute cap);
+- multi-host **agreement** rides the heartbeat allgather channel
+  (obs/heartbeat.py ``gather_probe``): every process contributes its
+  local verdict at the same cadence step, so one bad host trips a
+  rank-attributed ``obs_anomaly`` event on process 0 and EVERY process
+  computes the same policy action (``warn`` / ``halt`` / ``checkpoint``)
+  — a host-local decision would desynchronize the pod exactly like an
+  un-agreed preemption.
+
+Host clocks and floats only; the one ``jax.device_get`` lives in
+``to_host`` and runs only at the cadence (pinned by the repo lint's
+step-cadence sync rule and tests/test_health.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from distributed_llms_example_tpu.obs import sink as sink_mod
+
+ANOMALY_POLICIES = ("warn", "halt", "checkpoint")
+
+# stable wire codes for the agreement allgather (int32 payload)
+CODE_IDS = {"nonfinite": 1, "loss_spike": 2, "grad_explosion": 3}
+ID_CODES = {v: k for k, v in CODE_IDS.items()}
+
+
+def health_enabled(cfg: Any) -> bool:
+    """Resolve the ``--health`` tri-state: "on"/"off" are literal, "auto"
+    follows ``--obs jsonl`` (the same convention as ``--obs-gauges``)."""
+    mode = getattr(cfg, "health", "auto")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return getattr(cfg, "obs", "stdout") == "jsonl"
+
+
+@dataclasses.dataclass(frozen=True)
+class Anomaly:
+    step: int
+    code: str  # "nonfinite" | "loss_spike" | "grad_explosion"
+    value: float
+    detail: str
+
+
+def to_host(pending: Sequence[tuple[int, Mapping[str, Any]]]) -> list[tuple[int, dict]]:
+    """Convert a window of per-step device-scalar metric dicts to host
+    floats in ONE transfer.  This is the log-cadence fetch — the only
+    place the health path touches a device."""
+    import jax
+
+    host = jax.device_get([dict(m) for _, m in pending])
+    out = []
+    for (step, _), vals in zip(pending, host):
+        out.append((step, {k: float(v) for k, v in vals.items()}))
+    return out
+
+
+class HealthWatchdog:
+    """EWMA-based per-step anomaly detection over host-float metrics.
+
+    State persists across windows (the EWMAs are the run's memory); the
+    detectors run per step inside each window so the reported anomaly
+    step is the step the signal broke, not the cadence boundary.
+    """
+
+    def __init__(
+        self,
+        *,
+        loss_spike_factor: float = 4.0,
+        grad_norm_factor: float = 10.0,
+        grad_norm_max: float = 0.0,  # 0 = no absolute cap
+        warmup_steps: int = 20,
+        ewma_alpha: float = 0.05,
+    ):
+        self.loss_spike_factor = float(loss_spike_factor)
+        self.grad_norm_factor = float(grad_norm_factor)
+        self.grad_norm_max = float(grad_norm_max)
+        self.warmup_steps = int(warmup_steps)
+        self.alpha = float(ewma_alpha)
+        self.n = 0  # finite samples absorbed
+        self.loss_ewma = 0.0
+        self.loss_dev_ewma = 0.0  # EWMA of |loss - mean|
+        self.grad_ewma = 0.0
+
+    # -- detection -------------------------------------------------------
+
+    def _check_one(self, step: int, m: Mapping[str, float]) -> Anomaly | None:
+        loss = float(m.get("loss", 0.0))
+        grad = float(m.get("grad_norm", 0.0))
+        nonfinite = float(m.get("nonfinite_count", 0.0))
+        if not np.isfinite(loss) or not np.isfinite(grad) or nonfinite > 0:
+            return Anomaly(
+                step=step,
+                code="nonfinite",
+                value=nonfinite if nonfinite > 0 else loss,
+                detail=(
+                    f"loss={loss!r}, grad_norm={grad!r}, "
+                    f"{nonfinite:.0f} non-finite grad elements"
+                ),
+            )
+        if self.grad_norm_max > 0 and grad > self.grad_norm_max:
+            return Anomaly(
+                step=step,
+                code="grad_explosion",
+                value=grad,
+                detail=f"grad_norm {grad:.4g} > absolute cap {self.grad_norm_max:.4g}",
+            )
+        if self.n >= self.warmup_steps:
+            if grad > self.grad_norm_factor * max(self.grad_ewma, 1e-12):
+                return Anomaly(
+                    step=step,
+                    code="grad_explosion",
+                    value=grad,
+                    detail=(
+                        f"grad_norm {grad:.4g} > {self.grad_norm_factor:g}× "
+                        f"EWMA {self.grad_ewma:.4g}"
+                    ),
+                )
+            # deviation floor: a perfectly flat loss stream must not turn
+            # epsilon wiggles into spikes
+            floor = max(self.loss_dev_ewma, 1e-3 * max(abs(self.loss_ewma), 1.0))
+            if loss - self.loss_ewma > self.loss_spike_factor * floor:
+                return Anomaly(
+                    step=step,
+                    code="loss_spike",
+                    value=loss,
+                    detail=(
+                        f"loss {loss:.4g} > EWMA {self.loss_ewma:.4g} + "
+                        f"{self.loss_spike_factor:g}× deviation {floor:.4g}"
+                    ),
+                )
+        return None
+
+    def _absorb(self, m: Mapping[str, float]) -> None:
+        loss = float(m.get("loss", 0.0))
+        grad = float(m.get("grad_norm", 0.0))
+        if not (np.isfinite(loss) and np.isfinite(grad)):
+            return  # never learn from garbage
+        if self.n == 0:
+            self.loss_ewma, self.grad_ewma = loss, grad
+        else:
+            a = self.alpha
+            self.loss_dev_ewma = (1 - a) * self.loss_dev_ewma + a * abs(loss - self.loss_ewma)
+            self.loss_ewma = (1 - a) * self.loss_ewma + a * loss
+            self.grad_ewma = (1 - a) * self.grad_ewma + a * grad
+        self.n += 1
+
+    def check(self, entries: Sequence[tuple[int, Mapping[str, float]]]) -> list[Anomaly]:
+        """Run the detectors over one window of (step, host metrics).
+        Returns the anomalies in step order; a non-finite step ends the
+        scan (every later value is arithmetic on garbage).
+
+        Flagged FINITE samples are still absorbed after detection: a
+        legitimate permanent level shift (curriculum change, new data
+        mix) must re-baseline the EWMAs within ~1/alpha steps instead of
+        firing — and re-dumping the flight recorder — on every window
+        for the rest of the run.  A genuine divergence keeps firing
+        while it outruns the re-baselining; a one-off spike fires once.
+        """
+        out: list[Anomaly] = []
+        for step, m in entries:
+            a = self._check_one(step, m)
+            if a is not None:
+                out.append(a)
+                if a.code == "nonfinite":
+                    break
+            self._absorb(m)  # finite values only (_absorb guards)
+        return out
+
+
+def agree_and_emit(
+    anomalies: Sequence[Anomaly],
+    *,
+    step: int,
+    policy: str,
+    extra: Mapping[str, Any] | None = None,
+) -> dict | None:
+    """Multi-host anomaly agreement + the ``obs_anomaly`` event.
+
+    Every process calls this at the same cadence step (the Trainer's
+    deterministic log cadence) with its LOCAL verdict; the verdicts ride
+    the heartbeat allgather channel, so all processes return the same
+    agreed record (→ the same policy action) and process 0 emits the
+    rank-attributed event.  Returns None when no rank flagged anything.
+    Single-process: no collective.
+    """
+    import jax
+
+    from distributed_llms_example_tpu.obs.heartbeat import gather_probe
+
+    first = anomalies[0] if anomalies else None
+    local = np.asarray(
+        [
+            1 if first is not None else 0,
+            first.step if first is not None else 0,
+            CODE_IDS.get(first.code, 0) if first is not None else 0,
+        ],
+        np.int32,
+    )
+    gathered = gather_probe(local)  # single-process: just the local row
+    ranks = [i for i in range(gathered.shape[0]) if int(gathered[i, 0])]
+    if not ranks:
+        return None
+    # attribute to the EARLIEST flagged step across ranks (with in-graph
+    # numerics the verdicts usually agree; host-local detectors may not)
+    steps = [int(gathered[r, 1]) for r in ranks]
+    r0 = ranks[int(np.argmin(steps))]
+    record: dict[str, Any] = {
+        "event": "obs_anomaly",
+        "code": ID_CODES.get(int(gathered[r0, 2]), "unknown"),
+        "step": int(gathered[r0, 1]),
+        "detected_at_step": int(step),
+        "ranks": ranks,
+        "policy": policy,
+        "process_count": int(gathered.shape[0]),
+    }
+    if first is not None:
+        # each rank stamps ITS OWN numeric view; the agreed fields above
+        # are identical everywhere.  Non-finite values go as strings:
+        # "NaN" is not valid JSON.
+        v = float(first.value)
+        record["value"] = round(v, 6) if np.isfinite(v) else repr(v)
+        record["detail"] = first.detail
+        record["detail_rank"] = int(jax.process_index())
+    # local: every rank's metrics-p*.jsonl carries its verdict (the
+    # flagging rank's file is where the numbers live when process 0
+    # itself saw nothing); stdout stays process-0-only as always
+    sink_mod.emit(record, local=True)
+    return record

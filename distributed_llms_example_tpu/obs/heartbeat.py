@@ -42,6 +42,23 @@ from distributed_llms_example_tpu.obs import sink as sink_mod
 DEFAULT_LAGGARD_THRESHOLD_S = 5.0
 
 
+def gather_probe(local: "np.ndarray") -> "np.ndarray":
+    """THE heartbeat allgather channel: every process contributes one
+    small int32 vector, every process receives the (P, n) stack.  MUST be
+    called by all processes at the same global step (same contract as
+    ``Heartbeat.beat``).  The health watchdog's multi-host anomaly
+    agreement rides this same channel at the logging cadence.
+    Single-process: no collective, just the local row."""
+    import jax
+
+    local = np.asarray(local, dtype=np.int32)
+    if jax.process_count() == 1:
+        return local[None, :]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(local))
+
+
 def detect_laggards(
     steps: "np.ndarray",
     arrivals_s: "np.ndarray",
@@ -88,12 +105,7 @@ class Heartbeat:
         local = np.asarray(
             [int(step), int(t), int((t % 1.0) * 1e6)], dtype=np.int32
         )
-        if jax.process_count() == 1:
-            gathered = local[None, :]
-        else:
-            from jax.experimental import multihost_utils
-
-            gathered = np.asarray(multihost_utils.process_allgather(local))
+        gathered = gather_probe(local)
         if jax.process_index() != 0:
             return None
         steps = gathered[:, 0]

@@ -1,0 +1,190 @@
+"""The flight recorder: a bounded ring of the last N steps' evidence.
+
+A crashed or diverged run is only debuggable if the steps LEADING UP to
+the failure are reconstructable: which batch (shapes, content hash, where
+the data iterator stood), what the numerics looked like, when.  The
+recorder keeps exactly that — a ring of per-step entries holding the
+step's metrics (device scalars until the cadence fetch resolves them;
+never a per-step sync) and a host-side batch fingerprint — and dumps it
+as a schema-stamped JSON bundle when an anomaly fires, a SIGTERM lands,
+or the train loop raises.
+
+The bundle write is ATOMIC (tmp file + fsync + rename in the same
+directory): a kill -9 mid-dump leaves either the previous bundle or the
+complete new one, never a torn JSON.  Per-process file names
+(``flight-recorder-p{process}.json``) keep a shared output dir
+collision-free, exactly like the JSONL metric files.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import zlib
+from typing import Any, Mapping, Sequence
+
+from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.sink import SCHEMA_VERSION
+
+
+def batch_fingerprint(
+    batch: Mapping[str, Any], *, epoch: int, epoch_step: int
+) -> dict[str, Any]:
+    """Host-side identity of one host-local batch: array shapes, a crc32
+    of the token ids and labels (cheap — zlib's C loop over the raw
+    bytes), and the data-iterator position.  Enough to answer "was it the
+    data?" post-mortem: replaying the deterministic batch plan at
+    (seed, epoch, epoch_step) must reproduce these hashes."""
+    import numpy as np
+
+    fp: dict[str, Any] = {
+        "epoch": int(epoch),
+        "epoch_step": int(epoch_step),
+        "shapes": {k: list(np.asarray(v).shape) for k, v in batch.items()},
+    }
+    for key in ("input_ids", "labels"):
+        v = batch.get(key)
+        if v is not None:
+            fp[f"{key}_crc32"] = zlib.crc32(np.ascontiguousarray(v).tobytes()) & 0xFFFFFFFF
+    return fp
+
+
+class FlightRecorder:
+    """Bounded ring of per-step records, dumped on demand.
+
+    ``record`` is on the step cadence: it stores REFERENCES to the step's
+    device-scalar metrics (no conversion, no sync).  The health cadence
+    resolves them to host floats via ``annotate``; anything still
+    unresolved at ``dump`` time is converted then (dump only happens on
+    anomaly / shutdown, where a sync is free).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self._by_step: dict[int, dict] = {}
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(
+        self,
+        step: int,
+        epoch: int,
+        metrics: Mapping[str, Any],
+        fingerprint: Mapping[str, Any] | None = None,
+    ) -> None:
+        if len(self._ring) == self.capacity:
+            evicted = self._ring[0]
+            self._by_step.pop(evicted["step"], None)
+        entry: dict[str, Any] = {
+            "step": int(step),
+            "epoch": int(epoch),
+            "metrics": dict(metrics),
+            "resolved": False,
+        }
+        if fingerprint is not None:
+            entry["fingerprint"] = dict(fingerprint)
+        self._ring.append(entry)
+        self._by_step[int(step)] = entry
+
+    def annotate(self, step: int, host_metrics: Mapping[str, float]) -> None:
+        """Replace a step's device-scalar metrics with the host floats the
+        health cadence already fetched — dump then needs no sync for any
+        step the watchdog has seen."""
+        entry = self._by_step.get(int(step))
+        if entry is not None:
+            entry["metrics"] = dict(host_metrics)
+            entry["resolved"] = True
+
+    # -- dumping ---------------------------------------------------------
+
+    @staticmethod
+    def _to_jsonable(v: Any) -> Any:
+        # broad except: unresolved entries hold DEVICE scalars, and dump
+        # runs on the crash path — if the runtime died with the step,
+        # float(v) raises a backend error, and losing one value must not
+        # lose the bundle ("telemetry never takes down the run")
+        try:
+            f = float(v)
+        except Exception:
+            return str(v)[:80]
+        if f != f or f in (float("inf"), float("-inf")):
+            return repr(f)  # "nan"/"inf": NaN literals are not valid JSON
+        return round(f, 6)
+
+    def bundle_path(self, output_dir: str) -> str:
+        import jax
+
+        return os.path.join(
+            output_dir, "obs", f"flight-recorder-p{jax.process_index():03d}.json"
+        )
+
+    def dump(
+        self,
+        output_dir: str,
+        *,
+        reason: str,
+        step: int,
+        anomalies: Sequence[Any] = (),
+    ) -> str | None:
+        """Write the ring as a schema-stamped bundle (atomic: tmp + fsync
+        + rename) and announce it on the sink.  Telemetry must never take
+        down the run: IO errors are reported, not raised."""
+        import jax
+
+        path = self.bundle_path(output_dir)
+        entries = []
+        for e in self._ring:
+            out = {
+                "step": e["step"],
+                "epoch": e["epoch"],
+                "metrics": {k: self._to_jsonable(v) for k, v in e["metrics"].items()},
+            }
+            if "fingerprint" in e:
+                out["fingerprint"] = e["fingerprint"]
+            entries.append(out)
+        bundle = {
+            "schema_version": SCHEMA_VERSION,
+            "event": "flight_recorder",
+            "reason": reason,
+            "step": int(step),
+            "process_index": int(jax.process_index()),
+            "capacity": self.capacity,
+            "entries": entries,
+            "anomalies": [
+                {
+                    "step": int(a.step),
+                    "code": a.code,
+                    "value": self._to_jsonable(a.value),
+                    "detail": a.detail,
+                }
+                for a in anomalies
+            ],
+        }
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(bundle, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            sink_mod.emit(
+                {"event": "recorder_dump_failed", "reason": str(e)[:200]},
+                local=True,
+            )
+            return None
+        sink_mod.emit(
+            {
+                "event": "recorder_dump",
+                "path": path,
+                "reason": reason,
+                "step": int(step),
+                "steps_recorded": len(entries),
+            },
+            local=True,
+        )
+        return path

@@ -1,0 +1,416 @@
+"""Offline consumer for the per-process ``--obs jsonl`` telemetry.
+
+``python -m distributed_llms_example_tpu.obs.report <output_dir>`` reads
+every ``obs/metrics-p*.jsonl`` (and any ``obs/flight-recorder-p*.json``
+bundle) a run left behind, validates ``schema_version`` on every line,
+and reconstructs the run:
+
+- a **merged per-step timeline** joining, on the global ``step`` field,
+  process 0's metric lines (loss / lr / tokens-per-sec), every process's
+  ``obs_window`` span summaries, eval events (``val_loss`` — same
+  ``step`` field as train events), heartbeat skew, and anomalies;
+- **window trends**: p50/p95 step time per process across the run (is it
+  getting slower? did one host drift?);
+- **straggler attribution**: which ranks the heartbeat named laggards
+  and how often, next to each rank's own window p95 — the "go look at
+  host N" answer;
+- the **comm-bytes account** from the startup gauges, with the
+  reduce-scatter smell predicate (analysis/ir_lint.py) evaluated over it
+  — an fsdp run whose gradient bytes ride all-reduce is flagged right in
+  the report;
+- the **anomaly log** (``obs_anomaly`` events + flight-recorder
+  bundles).
+
+Output: human markdown (default) or ``--json``.  Schema drift is
+reported per line; ``--strict`` turns any invalid line into a nonzero
+exit.  Pure file reader — jax is imported by nothing on this path, so
+the report runs anywhere the output dir is mounted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any
+
+from distributed_llms_example_tpu.obs.sink import SCHEMA_VERSION
+
+_PROC_RE = re.compile(r"-p(\d+)\.jsonl?$")
+
+
+def load_jsonl(path: str) -> tuple[list[dict], list[str]]:
+    """Parse one JSONL file, checking ``schema_version`` on every line.
+    Returns (valid records, per-line error strings).  A trailing torn
+    line (kill mid-write) is an error entry, not an exception."""
+    records: list[dict] = []
+    errors: list[str] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path}:{lineno}: unparseable line ({e})")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"{path}:{lineno}: not a JSON object")
+                continue
+            v = rec.get("schema_version")
+            if v != SCHEMA_VERSION:
+                errors.append(
+                    f"{path}:{lineno}: schema_version {v!r} != {SCHEMA_VERSION}"
+                )
+                continue
+            records.append(rec)
+    return records, errors
+
+
+def load_run(output_dir: str) -> dict[str, Any]:
+    """Read every per-process stream + recorder bundle under
+    ``<output_dir>/obs/``."""
+    obs_dir = os.path.join(output_dir, "obs")
+    processes: dict[int, list[dict]] = {}
+    errors: list[str] = []
+    for path in sorted(glob.glob(os.path.join(obs_dir, "metrics-p*.jsonl"))):
+        m = _PROC_RE.search(path)
+        if not m:
+            continue
+        recs, errs = load_jsonl(path)
+        processes[int(m.group(1))] = recs
+        errors.extend(errs)
+    recorders: dict[int, dict] = {}
+    for path in sorted(glob.glob(os.path.join(obs_dir, "flight-recorder-p*.json"))):
+        m = re.search(r"-p(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                bundle = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"{path}: unreadable bundle ({e})")
+            continue
+        if bundle.get("schema_version") != SCHEMA_VERSION:
+            errors.append(
+                f"{path}: schema_version {bundle.get('schema_version')!r} "
+                f"!= {SCHEMA_VERSION}"
+            )
+            continue
+        recorders[int(m.group(1))] = bundle
+    return {"processes": processes, "recorders": recorders, "errors": errors}
+
+
+def _by_event(records: list[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for r in records:
+        out.setdefault(r.get("event", "metric"), []).append(r)
+    return out
+
+
+def merge_timeline(processes: dict[int, list[dict]]) -> list[dict]:
+    """Join every process's records on the global ``step`` field into one
+    chronological per-step timeline."""
+    steps: dict[int, dict[str, Any]] = {}
+
+    def at(step: Any) -> dict | None:
+        if not isinstance(step, (int, float)):
+            return None
+        return steps.setdefault(int(step), {"step": int(step)})
+
+    for proc, records in sorted(processes.items()):
+        ev = _by_event(records)  # bucket once per process
+        for r in ev.get("metric", []):
+            row = at(r.get("step"))
+            if row is None or "loss" not in r:
+                continue
+            for k in ("loss", "learning_rate", "tokens_per_sec", "steps_per_sec", "epoch"):
+                if k in r:
+                    row[k] = r[k]
+        for r in ev.get("obs_window", []):
+            row = at(r.get("step"))
+            if row is None:
+                continue
+            row.setdefault("windows", {})[proc] = {
+                "p50": r.get("step_ms_p50"),
+                "p95": r.get("step_ms_p95"),
+                "max": r.get("step_ms_max"),
+                "straggler": r.get("straggler"),
+            }
+            if "health" in r:
+                row.setdefault("health", {})[proc] = r["health"]
+        for r in ev.get("eval", []):
+            row = at(r.get("step"))
+            if row is None:
+                continue
+            for k, v in r.items():
+                if k not in ("event", "step", "schema_version"):
+                    row.setdefault("eval", {})[k] = v
+        for r in ev.get("heartbeat", []):
+            row = at(r.get("step"))
+            if row is None:
+                continue
+            row["heartbeat"] = {
+                k: r.get(k)
+                for k in ("skew_steps", "arrival_spread_s", "laggards", "process_count")
+            }
+        for r in ev.get("obs_anomaly", []):
+            row = at(r.get("step"))
+            if row is None:
+                continue
+            row.setdefault("anomalies", []).append(
+                {
+                    k: r.get(k)
+                    for k in ("code", "ranks", "policy", "value", "detail", "detected_at_step")
+                    if k in r
+                }
+            )
+    return [steps[s] for s in sorted(steps)]
+
+
+def straggler_attribution(processes: dict[int, list[dict]]) -> dict[str, Any]:
+    """Who was slow: heartbeat laggard counts per rank (the gather is a
+    barrier, so a laggard there really did keep everyone waiting) next to
+    each rank's own mean window p95."""
+    laggard_counts: dict[int, int] = {}
+    max_skew = 0
+    max_spread = 0.0
+    per_rank_p95: dict[int, float] = {}
+    straggler_windows: dict[int, int] = {}
+    for proc, records in sorted(processes.items()):
+        ev = _by_event(records)  # bucket once per process
+        for r in ev.get("heartbeat", []):
+            for lag in r.get("laggards", []) or []:
+                laggard_counts[int(lag)] = laggard_counts.get(int(lag), 0) + 1
+            max_skew = max(max_skew, int(r.get("skew_steps", 0) or 0))
+            max_spread = max(max_spread, float(r.get("arrival_spread_s", 0.0) or 0.0))
+        windows = ev.get("obs_window", [])
+        p95s = [
+            r["step_ms_p95"]
+            for r in windows
+            if isinstance(r.get("step_ms_p95"), (int, float))
+        ]
+        if p95s:
+            per_rank_p95[proc] = round(sum(p95s) / len(p95s), 3)
+        straggler_windows[proc] = sum(1 for r in windows if r.get("straggler"))
+    return {
+        "heartbeat_laggard_counts": {str(k): v for k, v in sorted(laggard_counts.items())},
+        "max_skew_steps": max_skew,
+        "max_arrival_spread_s": max_spread,
+        "mean_step_ms_p95_by_rank": {str(k): v for k, v in sorted(per_rank_p95.items())},
+        "straggler_windows_by_rank": {str(k): v for k, v in sorted(straggler_windows.items())},
+    }
+
+
+def window_trends(processes: dict[int, list[dict]]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for proc, records in sorted(processes.items()):
+        out[str(proc)] = [
+            {
+                "step": r.get("step"),
+                "p50": r.get("step_ms_p50"),
+                "p95": r.get("step_ms_p95"),
+                "mfu": r.get("mfu"),
+            }
+            for r in _by_event(records).get("obs_window", [])
+        ]
+    return out
+
+
+def comm_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
+    """The startup gauges' collective-traffic account, with the
+    reduce-scatter smell predicate evaluated over it."""
+    for records in processes.values():
+        for r in _by_event(records).get("obs_gauges", []):
+            comm = r.get("comm")
+            if not isinstance(comm, dict):
+                continue
+            out: dict[str, Any] = {
+                "mesh": r.get("mesh"),
+                "flops_per_step": r.get("flops_per_step"),
+                "flops_source": r.get("flops_source"),
+                "comm": comm,
+            }
+            from distributed_llms_example_tpu.analysis.ir_lint import (
+                account_gradient_bytes_by_op,
+                reduce_scatter_smell,
+            )
+
+            smell = reduce_scatter_smell(
+                account_gradient_bytes_by_op(comm), r.get("mesh") or {}
+            )
+            if smell is not None:
+                out["reduce_scatter_smell"] = smell.to_json()
+            return out
+    return None
+
+
+def build_report(output_dir: str) -> dict[str, Any]:
+    run = load_run(output_dir)
+    processes = run["processes"]
+    anomalies = [
+        r
+        for records in processes.values()
+        for r in _by_event(records).get("obs_anomaly", [])
+    ]
+    report: dict[str, Any] = {
+        "output_dir": output_dir,
+        "schema_version": SCHEMA_VERSION,
+        "processes": sorted(processes),
+        "records": sum(len(r) for r in processes.values()),
+        "schema_errors": run["errors"],
+        "timeline": merge_timeline(processes),
+        "trends": window_trends(processes),
+        "stragglers": straggler_attribution(processes),
+        "comm": comm_report(processes),
+        "anomalies": anomalies,
+        "recorders": {
+            str(p): {
+                "reason": b.get("reason"),
+                "step": b.get("step"),
+                "steps_recorded": len(b.get("entries", [])),
+                "anomalies": b.get("anomalies", []),
+            }
+            for p, b in run["recorders"].items()
+        },
+    }
+    return report
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return "" if v is None else str(v)
+
+
+def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
+    lines: list[str] = []
+    add = lines.append
+    add(f"# obs report — {report['output_dir']}")
+    add("")
+    add(
+        f"processes: {report['processes'] or 'none'} · records: "
+        f"{report['records']} · schema errors: {len(report['schema_errors'])}"
+    )
+    for e in report["schema_errors"][:10]:
+        add(f"- schema error: {e}")
+    timeline = report["timeline"]
+    add("")
+    add(f"## Step timeline ({len(timeline)} steps with events; last {last} shown)")
+    add("")
+    add("| step | loss | val_loss | p50/p95 ms by rank | skew | anomalies |")
+    add("|---|---|---|---|---|---|")
+    for row in timeline[-last:]:
+        win = row.get("windows", {})
+        winfmt = " ".join(
+            f"r{p}:{_fmt(w['p50'])}/{_fmt(w['p95'])}"
+            + ("!" if w.get("straggler") else "")
+            for p, w in sorted(win.items())
+        )
+        hb = row.get("heartbeat") or {}
+        anom = "; ".join(
+            f"{a.get('code')}@ranks{a.get('ranks')}" for a in row.get("anomalies", [])
+        )
+        add(
+            f"| {row['step']} | {_fmt(row.get('loss'))} | "
+            f"{_fmt((row.get('eval') or {}).get('val_loss'))} | {winfmt} | "
+            f"{_fmt(hb.get('skew_steps'))} | {anom} |"
+        )
+    add("")
+    add("## Trends (window p50/p95 ms)")
+    for proc, ws in report["trends"].items():
+        if not ws:
+            continue
+        first, final = ws[0], ws[-1]
+        add(
+            f"- rank {proc}: p50 {_fmt(first['p50'])} → {_fmt(final['p50'])}, "
+            f"p95 {_fmt(first['p95'])} → {_fmt(final['p95'])} over {len(ws)} windows"
+            + (f", last mfu {_fmt(final['mfu'])}" if final.get("mfu") is not None else "")
+        )
+    s = report["stragglers"]
+    add("")
+    add("## Straggler attribution")
+    add(
+        f"- max heartbeat skew: {s['max_skew_steps']} steps; max arrival "
+        f"spread: {_fmt(s['max_arrival_spread_s'])} s"
+    )
+    if s["heartbeat_laggard_counts"]:
+        for rank, n in s["heartbeat_laggard_counts"].items():
+            add(f"- rank {rank}: named laggard in {n} heartbeat(s)")
+    else:
+        add("- no laggards named by any heartbeat")
+    if s["mean_step_ms_p95_by_rank"]:
+        add(
+            "- mean window p95 by rank: "
+            + ", ".join(
+                f"r{k}={_fmt(v)}ms" for k, v in s["mean_step_ms_p95_by_rank"].items()
+            )
+        )
+    comm = report["comm"]
+    add("")
+    add("## Comm account")
+    if comm is None:
+        add("- no obs_gauges record (run without --obs-gauges?)")
+    else:
+        acct = comm["comm"]
+        add(
+            f"- total {acct.get('total_bytes', 0):,} B/step — gradient "
+            f"{acct.get('gradient_bytes', 0):,} B, activation "
+            f"{acct.get('activation_bytes', 0):,} B (mesh {comm.get('mesh')})"
+        )
+        for op, slot in sorted(acct.items()):
+            if isinstance(slot, dict):
+                add(
+                    f"  - {op}: ×{slot.get('count')} — grad "
+                    f"{slot.get('gradient_bytes', 0):,} B, act "
+                    f"{slot.get('activation_bytes', 0):,} B"
+                )
+        if "reduce_scatter_smell" in comm:
+            add(f"- **smell**: {comm['reduce_scatter_smell'].get('message')}")
+    add("")
+    add(f"## Anomalies ({len(report['anomalies'])})")
+    for a in report["anomalies"]:
+        add(
+            f"- step {a.get('step')} [{a.get('code')}] ranks {a.get('ranks')} "
+            f"policy {a.get('policy')}: {a.get('detail', '')}"
+        )
+    for proc, rec in report["recorders"].items():
+        add(
+            f"- flight recorder p{proc}: reason {rec['reason']!r} at step "
+            f"{rec['step']}, {rec['steps_recorded']} steps recorded"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_llms_example_tpu.obs.report",
+        description=__doc__,
+    )
+    p.add_argument("output_dir", help="a run's --output-dir (containing obs/)")
+    p.add_argument("--json", action="store_true", help="emit the full report as JSON")
+    p.add_argument("--last", type=int, default=20, help="timeline rows to render")
+    p.add_argument(
+        "--strict", action="store_true",
+        help="nonzero exit on any schema-invalid line",
+    )
+    args = p.parse_args(argv)
+    if not os.path.isdir(os.path.join(args.output_dir, "obs")):
+        print(f"no obs/ directory under {args.output_dir}", file=sys.stderr)
+        return 2
+    report = build_report(args.output_dir)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render_markdown(report, last=args.last), end="")
+    if args.strict and report["schema_errors"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

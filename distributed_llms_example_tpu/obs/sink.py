@@ -37,15 +37,28 @@ def _process_index() -> int:
 
 
 class StdoutSink:
-    """The Valohai stdout channel (process 0 only, byte-parity lines)."""
+    """The Valohai stdout channel (process 0 only, byte-parity lines).
 
-    def wants(self, *, all_processes: bool = False) -> bool:
+    ``local`` records (per-process telemetry: span windows, recorder
+    events) do NOT widen the stdout gate — the platform channel stays
+    process-0-only; only file channels fan out per process."""
+
+    def wants(self, *, all_processes: bool = False, local: bool = False) -> bool:
         return all_processes or _process_index() == 0
 
-    def emit(self, record: Mapping[str, Any], *, all_processes: bool = False) -> None:
-        if not self.wants(all_processes=all_processes):
+    def emit(
+        self,
+        record: Mapping[str, Any],
+        *,
+        all_processes: bool = False,
+        local: bool = False,
+    ) -> None:
+        if not self.wants(all_processes=all_processes, local=local):
             return
         print(json.dumps(record), file=sys.stdout, flush=True)
+
+    def flush(self, *, fsync: bool = False) -> None:
+        pass  # print() above already flushes per line
 
     def close(self) -> None:
         pass
@@ -62,23 +75,50 @@ class JsonlFileSink:
         self._f = None
         self._dead = False
 
-    def wants(self, *, all_processes: bool = False) -> bool:
-        return not self._dead and (all_processes or _process_index() == 0)
+    def wants(self, *, all_processes: bool = False, local: bool = False) -> bool:
+        # ``local``: per-process telemetry (span windows, recorder events)
+        # lands in every process's OWN file — cross-host timelines need
+        # every host's view, and the file is already per-process by path
+        return not self._dead and (all_processes or local or _process_index() == 0)
 
-    def emit(self, record: Mapping[str, Any], *, all_processes: bool = False) -> None:
-        if not self.wants(all_processes=all_processes):
+    def emit(
+        self,
+        record: Mapping[str, Any],
+        *,
+        all_processes: bool = False,
+        local: bool = False,
+    ) -> None:
+        if not self.wants(all_processes=all_processes, local=local):
             return
         try:
             if self._f is None:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
                 self._f = open(self.path, "a", buffering=1)
+            # ONE write() per record: the line (payload + newline) reaches
+            # the OS atomically w.r.t. this process's own later writes, so
+            # a kill can truncate only the final line, never interleave
             self._f.write(json.dumps({"schema_version": SCHEMA_VERSION, **record}) + "\n")
+        except OSError:
+            self._dead = True
+
+    def flush(self, *, fsync: bool = False) -> None:
+        """Push buffered lines to the OS — and with ``fsync`` to DISK, so
+        the last window survives a kill -9 (the anomaly/final-flush
+        durability contract; per-line fsync would put a disk round-trip
+        on every cadence)."""
+        if self._f is None:
+            return
+        try:
+            self._f.flush()
+            if fsync:
+                os.fsync(self._f.fileno())
         except OSError:
             self._dead = True
 
     def close(self) -> None:
         if self._f is not None:
             try:
+                self.flush(fsync=True)
                 self._f.close()
             except OSError:
                 pass
@@ -89,12 +129,24 @@ class TeeSink:
     def __init__(self, sinks: list):
         self.sinks = list(sinks)
 
-    def wants(self, *, all_processes: bool = False) -> bool:
-        return any(s.wants(all_processes=all_processes) for s in self.sinks)
+    def wants(self, *, all_processes: bool = False, local: bool = False) -> bool:
+        return any(
+            s.wants(all_processes=all_processes, local=local) for s in self.sinks
+        )
 
-    def emit(self, record: Mapping[str, Any], *, all_processes: bool = False) -> None:
+    def emit(
+        self,
+        record: Mapping[str, Any],
+        *,
+        all_processes: bool = False,
+        local: bool = False,
+    ) -> None:
         for s in self.sinks:
-            s.emit(record, all_processes=all_processes)
+            s.emit(record, all_processes=all_processes, local=local)
+
+    def flush(self, *, fsync: bool = False) -> None:
+        for s in self.sinks:
+            s.flush(fsync=fsync)
 
     def close(self) -> None:
         for s in self.sinks:
@@ -131,9 +183,18 @@ def build_sink(mode: str, output_dir: str):
     return TeeSink([_DEFAULT, JsonlFileSink(path)])
 
 
-def wants(*, all_processes: bool = False) -> bool:
-    return _SINK.wants(all_processes=all_processes)
+def wants(*, all_processes: bool = False, local: bool = False) -> bool:
+    return _SINK.wants(all_processes=all_processes, local=local)
 
 
-def emit(record: Mapping[str, Any], *, all_processes: bool = False) -> None:
-    _SINK.emit(record, all_processes=all_processes)
+def emit(
+    record: Mapping[str, Any], *, all_processes: bool = False, local: bool = False
+) -> None:
+    _SINK.emit(record, all_processes=all_processes, local=local)
+
+
+def flush(*, fsync: bool = False) -> None:
+    """Flush the active sink's file channels (``fsync=True`` → to disk).
+    Called on anomaly and at final close so the freshest telemetry
+    survives even a kill -9 right after."""
+    _SINK.flush(fsync=fsync)

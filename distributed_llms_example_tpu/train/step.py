@@ -46,6 +46,85 @@ class TrainState:
     opt_state: Any
 
 
+# ---------------------------------------------------------------------------
+# In-graph training-health telemetry (the obs/health.py numerics source).
+#
+# Everything here is computed INSIDE the pjit'd step — a handful of
+# elementwise reductions riding the same program as the loss, so the
+# values are device scalars like ``loss``/``grad_norm`` and cost zero
+# extra device syncs: the watchdog converts them to host floats only at
+# the logging cadence (the same fetch the MetricLogger already pays).
+# ---------------------------------------------------------------------------
+
+# Coarse parameter buckets for the per-bucket update ratio.  A uniform
+# whole-tree ratio hides the classic failure signatures (an embedding
+# whose updates dwarf its weights while the MLPs are healthy, a head
+# diverging under a bad label stream), and a per-leaf report would be
+# thousands of scalars; four buckets is the resolution operators act on.
+HEALTH_BUCKETS = ("embed", "attn", "mlp", "head")
+
+# The per-step scalars a health-enabled step adds to its metrics dict.
+HEALTH_METRIC_KEYS: tuple[str, ...] = (
+    "param_norm",
+    "nonfinite_count",
+) + tuple(f"update_ratio_{b}" for b in HEALTH_BUCKETS)
+
+
+def bucket_of_path(path: tuple) -> str:
+    """Coarse bucket for one parameter path (a jax key-path tuple).
+
+    Name matching covers every family in models/: llama (embed_tokens /
+    self_attn / mlp / lm_head), t5 (shared / self_attn / cross_attn /
+    mlp / lm_head), bart (shared / *_embed_positions / self_attn / mlp),
+    and the pipelined stacked trees (same leaf names under
+    ``stacked_blocks``).  Unmatched leaves (norms, biases) fall to
+    ``mlp`` — a bucket must be total, and misfiling a layernorm scale
+    costs nothing the per-bucket ratio is watching for.
+    """
+    p = "/".join(
+        str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))) for k in path
+    ).lower()
+    if "lm_head" in p or "logits" in p:
+        return "head"
+    if "embed" in p or "shared" in p or "wte" in p or "wpe" in p:
+        return "embed"
+    if "attn" in p or "attention" in p:
+        return "attn"
+    return "mlp"
+
+
+def _bucket_sumsq(tree: Any) -> dict[str, jnp.ndarray]:
+    sums = {b: jnp.zeros((), jnp.float32) for b in HEALTH_BUCKETS}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        b = bucket_of_path(path)
+        sums[b] = sums[b] + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return sums
+
+
+def health_metrics(params: Any, grads: Any, updates: Any) -> dict[str, jnp.ndarray]:
+    """The in-graph numerics bundle: global param norm, non-finite grad
+    element count, and per-bucket update ratios ||Δw|| / ||w|| (the
+    step-size-relative-to-weights signal; healthy AdamW fine-tuning sits
+    around 1e-3, a bucket at 1e-1 is about to diverge)."""
+    p_sq = _bucket_sumsq(params)
+    u_sq = _bucket_sumsq(updates)
+    # integer accumulation per leaf: a float32 ``size - finite_count``
+    # rounds 1-4 NaNs in a 1e8-element leaf to exactly 0 (spacing 8 at
+    # that magnitude) — the one signal the tripwire must never lose
+    nonfinite = jnp.zeros((), jnp.float32)
+    for g in jax.tree.leaves(grads):
+        nonfinite = nonfinite + jnp.sum(~jnp.isfinite(g)).astype(jnp.float32)
+    out: dict[str, jnp.ndarray] = {
+        "param_norm": jnp.sqrt(sum(p_sq.values())),
+        "nonfinite_count": nonfinite,
+    }
+    for b in HEALTH_BUCKETS:
+        out[f"update_ratio_{b}"] = jnp.sqrt(u_sq[b]) / jnp.maximum(
+            jnp.sqrt(p_sq[b]), 1e-12
+        )
+    return out
+
+
 def create_train_state(params: Any, tx: optax.GradientTransformation) -> TrainState:
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
 
@@ -173,8 +252,15 @@ def make_train_step(
     donate: bool = True,
     is_seq2seq: bool = True,
     sequence_sharded: bool | None = None,
+    health: bool = False,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch[, rng]) → (state, metrics).
+
+    ``health=True`` additionally computes the in-graph numerics bundle
+    (``HEALTH_METRIC_KEYS``: param norm, non-finite grad count, per-bucket
+    update ratios) inside the compiled step — extra metrics entries, no
+    extra device syncs; the obs health watchdog reads them at the logging
+    cadence.
 
     The global batch (leading dim = global batch size) must be divisible by
     ``grad_accum_steps``; each microbatch stays sharded over (data, fsdp).
@@ -250,6 +336,8 @@ def make_train_step(
             "grad_norm": optax.global_norm(grads),
             "target_tokens": tokens,
         }
+        if health:
+            metrics.update(health_metrics(state.params, grads, updates))
         return new_state, metrics
 
     # shardings: state per rules; batch over (data, fsdp) with lengths over
@@ -258,8 +346,12 @@ def make_train_step(
     bsh = batch_sharding(mesh, sequence_sharded=seq_sharded)
     repl = NamedSharding(mesh, P())
 
+    metric_keys = ("loss", "learning_rate", "grad_norm", "target_tokens") + (
+        HEALTH_METRIC_KEYS if health else ()
+    )
+
     def jit_it(state_sh: Any) -> Callable:
-        metrics_sh = {k: repl for k in ("loss", "learning_rate", "grad_norm", "target_tokens")}
+        metrics_sh = {k: repl for k in metric_keys}
         in_shardings = (state_sh, {"input_ids": bsh, "attention_mask": bsh, "labels": bsh})
         if with_dropout:
             jitted = jax.jit(

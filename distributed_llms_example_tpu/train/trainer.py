@@ -276,6 +276,12 @@ class Trainer:
         )
 
         self.use_dropout = self.config.dropout_rate > 0.0
+        # training health: the in-graph numerics ride the compiled step
+        # itself (extra metrics entries, no extra syncs) when the
+        # watchdog will consume them
+        from distributed_llms_example_tpu.obs.health import health_enabled
+
+        self.health_on = health_enabled(cfg)
         build = make_train_step(
             self.model,
             self.config,
@@ -288,8 +294,14 @@ class Trainer:
             is_seq2seq=self.loaded.is_seq2seq,
             sequence_sharded=self.sequence_sharded,
             rules=self._rules,
+            health=self.health_on,
         )
         self.train_step, _ = build(self.state)
+        # test hook: inject a NaN into one parameter element right before
+        # dispatching this global step (None = never) — the cheapest way
+        # to fault a real run's numerics deterministically; the poison is
+        # a lazy device-side op, so even the injection adds no sync
+        self._poison_nan_at_step: int | None = None
 
         ckpt_dir = os.path.join(cfg.output_dir, "checkpoints")
         self.checkpointer = Checkpointer(
@@ -489,7 +501,9 @@ class Trainer:
         )
         return {"state": state, "stacked_layout": leaf}
 
-    def evaluate(self, epoch: int | None = None) -> dict[str, float]:
+    def evaluate(
+        self, epoch: int | None = None, step: int | None = None
+    ) -> dict[str, float]:
         if self.val_ds is None:
             return {}
         scores: dict[str, float] = {}
@@ -533,7 +547,11 @@ class Trainer:
             ))
         if epoch is not None:
             scores["epoch"] = float(epoch)
-        log_json({"event": "eval", **scores})
+        # eval events carry the global step under the SAME field name as
+        # the train cadence lines, so report-side timeline joins need no
+        # special-casing (val_loss lands at the step that produced it)
+        event = {"event": "eval", **({"step": step} if step is not None else {})}
+        log_json({**event, **scores})
         return scores
 
     def _pipelined_val_loss(self) -> float:
@@ -730,16 +748,34 @@ class Trainer:
         self._install_preemption_handler()
         try:
             return self._train_loop()
+        except Exception:
+            # a crashing step must still leave the post-mortem evidence:
+            # dump the flight recorder (ring → atomic bundle) and push
+            # the JSONL channel to disk before the traceback propagates
+            if self.obs.recorder is not None:
+                self.obs.recorder.dump(
+                    self.cfg.output_dir,
+                    reason="exception",
+                    step=int(getattr(self, "_last_step", self.start_step)),
+                )
+            from distributed_llms_example_tpu.obs import sink as sink_mod
+
+            sink_mod.flush(fsync=True)
+            raise
         finally:
             self._restore_signal_handlers()
 
     def _train_loop(self) -> dict[str, Any]:
+        from distributed_llms_example_tpu.obs.recorder import batch_fingerprint
+
         cfg = self.cfg
         obs = self.obs
         obs.set_start_step(self.start_step)
         logger = MetricLogger(every=cfg.log_every_steps)
         self._preempt_sync_every = max(1, cfg.log_every_steps)
         step = self.start_step
+        self._last_step = step
+        self._anomaly_action: str | None = None
         t0 = time.perf_counter()
         last_eval: dict[str, float] = {}
         last_metrics: dict[str, Any] | None = None
@@ -760,6 +796,24 @@ class Trainer:
             try:
                 for batch in obs.wrap_batches(epoch_batches):
                     obs.profiler.before_step(step + 1)
+                    if self._poison_nan_at_step == step + 1:
+                        # test hook: corrupt one param element (lazy
+                        # device op — the NaN surfaces in this step's
+                        # in-graph numerics, nowhere on the host)
+                        flat, treedef = jax.tree.flatten(self.state.params)
+                        flat[0] = flat[0].at[(0,) * flat[0].ndim].set(float("nan"))
+                        self.state = self.state.replace(
+                            params=jax.tree.unflatten(treedef, flat)
+                        )
+                    fingerprint = (
+                        batch_fingerprint(
+                            batch,
+                            epoch=epoch,
+                            epoch_step=step - epoch * steps_per_epoch,
+                        )
+                        if obs.recorder is not None
+                        else None
+                    )
                     with obs.step_span():
                         gb = put_batch(batch, self.mesh, sequence_sharded=self.sequence_sharded)
                         if self.use_dropout:
@@ -768,6 +822,7 @@ class Trainer:
                         else:
                             self.state, metrics = self.train_step(self.state, gb)
                     step += 1
+                    self._last_step = step
                     last_metrics = metrics
                     tokens = self._batch_tokens(batch) * jax.process_count()
                     # pass DEVICE scalars: converting here (float(...)) would
@@ -783,16 +838,23 @@ class Trainer:
                             epoch=epoch,
                         )
                     # per-step obs bookkeeping: step-time ring, profiler
-                    # stop, cadenced heartbeat + window summary — before
+                    # stop, flight-recorder append, cadenced heartbeat +
+                    # health check + window summary — before
                     # checkpoint/eval so their wall time rides their own
                     # spans, not this step's duration
-                    obs.on_step(step, epoch, metrics)
+                    action = obs.on_step(step, epoch, metrics, fingerprint)
+                    if action in ("halt", "checkpoint"):
+                        # agreed across hosts inside the health cadence
+                        # (same allgather discipline as preemption) — every
+                        # process takes this branch at the same step
+                        self._anomaly_action = action
+                        break
                     if self.checkpointer.should_save(step):
                         with obs.checkpoint_span():
                             self.checkpointer.save(step, self._with_layout(self.state))
                     if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
                         with obs.eval_span():
-                            last_eval = self.evaluate(epoch)
+                            last_eval = self.evaluate(epoch, step=step)
                     # re-anchor the step clock: checkpoint/eval time is on
                     # their own spans and must not inflate the NEXT step's
                     # ring-buffer duration (false straggler flags)
@@ -813,22 +875,49 @@ class Trainer:
             # safe; mid-epoch agreed breaks re-agree here (still true).
             if jax.process_count() > 1:
                 self._preempted = self._preemption_agreed()
-            if self._preempted:
+            if self._preempted or self._anomaly_action is not None:
                 break
             # epoch boundary: emit the partial metric window (the fix for
             # the lost-final-window cadence bug) before the eval resets
             # the wall clocks
             logger.flush(step, epoch=epoch)
             with obs.eval_span():
-                last_eval = self.evaluate(epoch)  # per-epoch eval, reference parity
+                # per-epoch eval, reference parity
+                last_eval = self.evaluate(epoch, step=step)
         logger.flush(step, epoch=epoch)
         # close any open trace window (flushed, not lost) and emit the
-        # final obs window
-        obs.finalize(
+        # final obs window (plus the final partial-window health check)
+        final_action = obs.finalize(
             step, epoch, sync_leaf=last_metrics["loss"] if last_metrics else None
         )
+        if self._anomaly_action is None and final_action in ("halt", "checkpoint"):
+            self._anomaly_action = final_action
+        if self._anomaly_action is not None:
+            wall = time.perf_counter() - t0
+            if self._anomaly_action == "checkpoint":
+                # a RESUMABLE checkpoint of the (possibly already
+                # poisoned) state: post-mortem work restores it next to
+                # the flight-recorder bundle — resuming a diverged run
+                # from here is the operator's explicit call
+                self.checkpointer.save(step, self._with_layout(self.state), force=True)
+                self.checkpointer.wait()
+            log_json({
+                "event": "anomaly_stop", "step": step,
+                "policy": self._anomaly_action, "wall_seconds": wall,
+            })
+            return {
+                "steps": step, "wall_seconds": wall, "final_eval": last_eval,
+                "anomaly": self._anomaly_action,
+            }
         if self._preempted:
-            # save where we stopped and get out; resume restarts from here
+            # the last steps' evidence first (the bundle is what a
+            # post-mortem of the preempted run reads)...
+            if obs.recorder is not None:
+                obs.recorder.dump(
+                    self.cfg.output_dir, reason="preemption", step=step
+                )
+            # ...then save where we stopped and get out; resume restarts
+            # from here
             self.checkpointer.save(step, self._with_layout(self.state), force=True)
             self.checkpointer.wait()
             wall = time.perf_counter() - t0

@@ -23,9 +23,20 @@ Three rules, all born from real regressions at TPU scale:
    from every process.  Emission belongs in ``obs/`` and
    ``utils/jsonlog.py``; everyone else calls ``log_json``.
 
+4. **No device→host conversions on step-cadence paths outside the
+   log-cadence window.**  ``float(...)`` / ``.item()`` /
+   ``jax.device_get`` on a value the step loop produced is a device sync
+   — one per step serializes async dispatch, the exact invariant the
+   health telemetry is designed around ("values ride the existing
+   log-cadence fetch").  The files whose code runs at step cadence are
+   enumerated in ``STEP_CADENCE_FILES`` with the functions that ARE the
+   cadence window (summary emission, health resolve, recorder dump,
+   build-time constructors) allowlisted by name; a conversion anywhere
+   else in those files fails here.
+
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
-into the fast test suite (tests/test_analysis.py, tests/test_obs.py) next
-to the analysis-CLI smoke run.
+into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
+tests/test_health.py) next to the analysis-CLI smoke run.
 """
 
 from __future__ import annotations
@@ -68,6 +79,37 @@ JSON_EMIT_ALLOW_FILES = {
     os.path.join(PACKAGE, "utils", "jsonlog.py"),
 }
 
+# Files whose code runs at STEP cadence: device→host conversions
+# (float(), .item(), jax.device_get) are forbidden outside the named
+# functions — which are exactly the log-cadence window (summary/health
+# resolve, dump paths) and build-time constructors.  Guards the
+# zero-extra-syncs invariant the in-graph health telemetry depends on.
+STEP_CADENCE_FILES: dict[str, frozenset] = {
+    # the step function itself is all device-side; make_loss_fn/
+    # make_train_step run once at build time (config floats)
+    os.path.join(PACKAGE, "train", "step.py"): frozenset(
+        {"make_loss_fn", "make_train_step"}
+    ),
+    # span() / step_complete() are per-step; summary() IS the cadence
+    os.path.join(PACKAGE, "obs", "spans.py"): frozenset(
+        {"__init__", "summary", "percentiles"}
+    ),
+    # record() is per-step; annotate()/dump() run at cadence / shutdown
+    os.path.join(PACKAGE, "obs", "recorder.py"): frozenset(
+        {"annotate", "dump", "_to_jsonable", "batch_fingerprint"}
+    ),
+    # the watchdog's one device_get lives in to_host (cadence only)
+    os.path.join(PACKAGE, "obs", "health.py"): frozenset(
+        {"__init__", "to_host", "_check_one", "_absorb", "check", "agree_and_emit"}
+    ),
+    # on_step appends pointers; everything that converts is cadenced
+    os.path.join(PACKAGE, "obs", "__init__.py"): frozenset(
+        {"__init__", "_health_cadence", "emit_window", "window_mfu",
+         "startup_gauges", "finalize"}
+    ),
+}
+CADENCE_SYNC_CALLS = (("jax", "device_get"),)
+
 
 def _is_json_dumps_call(node: ast.AST) -> bool:
     return (
@@ -90,6 +132,47 @@ def _spec_call_has_str_literal(node: ast.Call) -> bool:
     return any(holds_str(a) for a in node.args)
 
 
+def _cadence_violations(tree: ast.AST, rel: str, allowed: frozenset) -> list[str]:
+    """Rule 4: device→host conversions in a step-cadence file outside the
+    allowlisted log-cadence-window functions."""
+    violations: list[str] = []
+
+    def describe(node: ast.Call) -> str | None:
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "float":
+            return "float(...)"
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+            return ".item()"
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and (fn.value.id, fn.attr) in CADENCE_SYNC_CALLS
+        ):
+            return f"{fn.value.id}.{fn.attr}(...)"
+        return None
+
+    def visit(node: ast.AST, func: str | None) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        elif isinstance(node, ast.Call) and (func is None or func not in allowed):
+            what = describe(node)
+            if what is not None:
+                violations.append(
+                    f"{rel}:{node.lineno}: {what} on a step-cadence path "
+                    f"(outside the log-cadence window functions "
+                    f"{sorted(allowed)}) — a per-step device sync breaks "
+                    "the zero-extra-syncs health-telemetry invariant; "
+                    "convert only inside the cadenced window (or pin a "
+                    "new window function in scripts/repo_lint.py with a "
+                    "reason)"
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, func)
+
+    visit(tree, None)
+    return violations
+
+
 def lint_file(path: str, rel: str) -> list[str]:
     with open(path) as f:
         try:
@@ -103,6 +186,8 @@ def lint_file(path: str, rel: str) -> list[str]:
     json_emit_ok = rel in JSON_EMIT_ALLOW_FILES or any(
         rel.startswith(d + os.sep) for d in JSON_EMIT_ALLOW_DIRS
     )
+    if rel in STEP_CADENCE_FILES:
+        violations.extend(_cadence_violations(tree, rel, STEP_CADENCE_FILES[rel]))
 
     for node in ast.walk(tree):
         if (

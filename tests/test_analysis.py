@@ -480,11 +480,13 @@ def test_repo_lint_clean_and_catches_violations(tmp_path):
     # the repo itself is clean (this IS the CI check)
     assert repo_lint.main([]) == 0
 
-    # a hot-path sync is caught
+    # a hot-path sync is caught — device_get by BOTH rule 1 (hot-path
+    # sync) and rule 4 (step-cadence conversion; train/step.py is in
+    # STEP_CADENCE_FILES), block_until_ready by rule 1
     bad_step = tmp_path / "step.py"
     bad_step.write_text("import jax\nx = jax.device_get(y)\nz = y.block_until_ready()\n")
     rel = os.path.join("distributed_llms_example_tpu", "train", "step.py")
-    assert len(repo_lint.lint_file(str(bad_step), rel)) == 2
+    assert len(repo_lint.lint_file(str(bad_step), rel)) == 3
 
     # a bare axis-name spec outside parallel/ is caught, tuples included
     bad_spec = tmp_path / "rogue.py"

@@ -1,0 +1,804 @@
+"""Training-health telemetry (ISSUE 3).
+
+Acceptance pins: the in-graph numerics (param norm, per-bucket update
+ratios, non-finite counts) computed INSIDE the compiled step; the
+watchdog's detectors on synthetic windows (NaN tripwire, EWMA loss
+spike, grad explosion — each attributed to the exact step); the
+zero-extra-syncs invariant (device→host conversion pinned to the log
+cadence with a counting fake scalar); the flight recorder's bounded ring
+and atomic schema-stamped bundle; the injected-NaN end-to-end run
+(``--on-anomaly checkpoint`` → rank-attributed ``obs_anomaly`` at the
+poisoned step, a resumable checkpoint, a recorder bundle, and an ``obs
+report`` that reconstructs all of it); the JSONL schema round-trip for
+every event type; the kill-9 durability of the fsync'd sink; and the
+repo lint's step-cadence sync rule.
+
+The 2-process report/agreement leg rides the slow tier next to
+tests/test_multiprocess.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_example_tpu.core.config import (
+    CheckpointConfig,
+    MeshConfig,
+    TrainConfig,
+)
+from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.health import (
+    Anomaly,
+    HealthWatchdog,
+    agree_and_emit,
+    health_enabled,
+    to_host,
+)
+from distributed_llms_example_tpu.obs.recorder import FlightRecorder, batch_fingerprint
+from distributed_llms_example_tpu.obs.report import (
+    build_report,
+    load_jsonl,
+    merge_timeline,
+    render_markdown,
+    straggler_attribution,
+)
+from distributed_llms_example_tpu.train.step import (
+    HEALTH_BUCKETS,
+    HEALTH_METRIC_KEYS,
+    bucket_of_path,
+)
+
+
+@pytest.fixture(autouse=True)
+def _default_sink():
+    sink_mod.install_sink(sink_mod.build_sink("stdout", ""))
+    yield
+    sink_mod.install_sink(sink_mod.build_sink("stdout", ""))
+
+
+def _json_lines(text: str) -> list[dict]:
+    out = []
+    for line in text.splitlines():
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# in-graph numerics: buckets + the health-enabled compiled step
+# ---------------------------------------------------------------------------
+
+class _Key:
+    def __init__(self, key):
+        self.key = key
+
+
+def test_bucket_of_path_covers_model_families():
+    assert bucket_of_path((_Key("embed_tokens"), _Key("embedding"))) == "embed"
+    assert bucket_of_path((_Key("shared"), _Key("embedding"))) == "embed"
+    assert bucket_of_path((_Key("block_0"), _Key("self_attn"), _Key("q_proj"))) == "attn"
+    assert bucket_of_path((_Key("encoder"), _Key("cross_attn"), _Key("o_proj"))) == "attn"
+    assert bucket_of_path((_Key("block_1"), _Key("mlp"), _Key("wi"))) == "mlp"
+    assert bucket_of_path((_Key("lm_head"), _Key("kernel"))) == "head"
+    # norms/bias fall to mlp — the bucket map must be total
+    assert bucket_of_path((_Key("final_norm"), _Key("scale"))) == "mlp"
+    # stacked pipeline trees keep leaf names under stacked_blocks
+    assert bucket_of_path((_Key("stacked_blocks"), _Key("self_attn"), _Key("k_proj"))) == "attn"
+
+
+def test_health_metrics_ride_the_compiled_step(dp_mesh, tiny_llama4):
+    from distributed_llms_example_tpu.data.batching import LABEL_PAD
+    from distributed_llms_example_tpu.train.optim import make_optimizer
+    from distributed_llms_example_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+        put_batch,
+        state_shardings,
+    )
+
+    cfg, module, params = tiny_llama4
+    tx, schedule = make_optimizer(learning_rate=1e-3, warmup_steps=0, total_steps=100)
+    state = create_train_state(params, tx)
+    sh = state_shardings(state, dp_mesh)
+    state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+    build = make_train_step(
+        module, cfg, tx, schedule, dp_mesh, is_seq2seq=False, health=True, donate=False
+    )
+    step_fn, _ = build(state)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(2, cfg.vocab_size - 4, (8, 16)).astype(np.int32)
+    labels = ids.copy()
+    labels[:, :4] = LABEL_PAD
+    gb = put_batch(
+        {"input_ids": ids, "attention_mask": np.ones_like(ids), "labels": labels},
+        dp_mesh,
+    )
+    _, m = step_fn(state, gb)
+    assert set(HEALTH_METRIC_KEYS) <= set(m)
+    assert float(m["param_norm"]) > 0
+    assert float(m["nonfinite_count"]) == 0.0
+    for b in HEALTH_BUCKETS:
+        r = float(m[f"update_ratio_{b}"])
+        assert np.isfinite(r) and 0 < r < 1.0  # healthy AdamW step sizes
+    # inject one NaN parameter element: the tripwire numerics must see it
+    flat, treedef = jax.tree.flatten(state.params)
+    flat[0] = flat[0].at[(0,) * flat[0].ndim].set(jnp.nan)
+    _, m2 = step_fn(state.replace(params=jax.tree.unflatten(treedef, flat)), gb)
+    assert not np.isfinite(float(m2["loss"]))
+    assert float(m2["nonfinite_count"]) > 0
+    # health=False keeps the old metrics contract exactly
+    build0 = make_train_step(
+        module, cfg, tx, schedule, dp_mesh, is_seq2seq=False, donate=False
+    )
+    step0, _ = build0(state)
+    _, m0 = step0(state, gb)
+    assert set(m0) == {"loss", "learning_rate", "grad_norm", "target_tokens"}
+
+
+# ---------------------------------------------------------------------------
+# watchdog: detectors on synthetic windows, step attribution
+# ---------------------------------------------------------------------------
+
+def _entries(losses, grads=None, nonfinite=None, start=1):
+    out = []
+    for i, loss in enumerate(losses):
+        out.append((
+            start + i,
+            {
+                "loss": loss,
+                "grad_norm": grads[i] if grads else 1.0,
+                "nonfinite_count": nonfinite[i] if nonfinite else 0.0,
+            },
+        ))
+    return out
+
+
+def test_watchdog_nonfinite_tripwire_attributes_the_step():
+    wd = HealthWatchdog(warmup_steps=1000)  # detectors unarmed: tripwire only
+    anomalies = wd.check(_entries([2.0, 2.0, float("nan"), 2.0], start=7))
+    assert len(anomalies) == 1
+    assert anomalies[0].code == "nonfinite" and anomalies[0].step == 9
+    # nonfinite grad elements trip even with a finite loss
+    wd = HealthWatchdog(warmup_steps=1000)
+    anomalies = wd.check(_entries([2.0, 2.0], nonfinite=[0.0, 12.0], start=1))
+    assert anomalies[0].code == "nonfinite" and anomalies[0].step == 2
+    assert anomalies[0].value == 12.0
+
+
+def test_watchdog_loss_spike_ewma():
+    wd = HealthWatchdog(loss_spike_factor=4.0, warmup_steps=10)
+    noise = [2.0 + 0.05 * ((-1) ** i) for i in range(30)]
+    assert wd.check(_entries(noise, start=1)) == []
+    # a 4x-deviation spike at step 31 fires exactly there
+    anomalies = wd.check(_entries([8.0], start=31))
+    assert len(anomalies) == 1
+    assert anomalies[0].code == "loss_spike" and anomalies[0].step == 31
+    # a smoothly DECREASING loss never trips (the no-false-positive case)
+    wd = HealthWatchdog(loss_spike_factor=4.0, warmup_steps=10)
+    dec = [5.0 * (0.99 ** i) for i in range(100)]
+    assert wd.check(_entries(dec, start=1)) == []
+
+
+def test_watchdog_grad_explosion():
+    wd = HealthWatchdog(grad_norm_factor=10.0, warmup_steps=5)
+    grads = [1.0] * 10 + [50.0]
+    anomalies = wd.check(_entries([2.0] * 11, grads=grads, start=1))
+    assert len(anomalies) == 1
+    assert anomalies[0].code == "grad_explosion" and anomalies[0].step == 11
+    # absolute cap works before warmup
+    wd = HealthWatchdog(grad_norm_max=5.0, warmup_steps=1000)
+    anomalies = wd.check(_entries([2.0], grads=[7.0], start=3))
+    assert anomalies[0].code == "grad_explosion" and anomalies[0].step == 3
+    # flagged FINITE samples still re-baseline the EWMAs: a permanent
+    # level shift fires, then stops firing once the baseline catches up
+    # (no anomaly-spam-forever on a healthy new plateau)
+    wd = HealthWatchdog(grad_norm_factor=10.0, warmup_steps=5, ewma_alpha=0.2)
+    wd.check(_entries([2.0] * 10, grads=[1.0] * 10, start=1))
+    assert wd.check(_entries([2.0], grads=[100.0], start=11)) != []  # fires at the shift
+    assert wd.grad_ewma > 1.0  # the shift is being absorbed
+    fired = [
+        bool(wd.check(_entries([2.0], grads=[100.0], start=12 + i)))
+        for i in range(10)
+    ]
+    assert not fired[-1]  # the new plateau re-baselines; firing stops
+
+
+def test_agree_and_emit_single_process(capsys):
+    rec = agree_and_emit(
+        [Anomaly(step=9, code="nonfinite", value=float("nan"), detail="loss=nan")],
+        step=10,
+        policy="checkpoint",
+    )
+    assert rec is not None
+    assert rec["step"] == 9 and rec["detected_at_step"] == 10
+    assert rec["code"] == "nonfinite" and rec["ranks"] == [0]
+    assert rec["policy"] == "checkpoint" and rec["value"] == "nan"
+    lines = _json_lines(capsys.readouterr().out)
+    assert any(r.get("event") == "obs_anomaly" and r["step"] == 9 for r in lines)
+    # no anomalies anywhere → no event, no record
+    assert agree_and_emit([], step=10, policy="warn") is None
+
+
+def test_health_enabled_tristate():
+    assert health_enabled(TrainConfig(health="on", obs="stdout"))
+    assert not health_enabled(TrainConfig(health="off", obs="jsonl"))
+    assert health_enabled(TrainConfig(health="auto", obs="jsonl"))
+    assert not health_enabled(TrainConfig(health="auto", obs="stdout"))
+
+
+# ---------------------------------------------------------------------------
+# the zero-extra-syncs invariant: conversions pinned to the log cadence
+# ---------------------------------------------------------------------------
+
+class CountingScalar:
+    """Stands in for a 0-d device array: every host conversion counts."""
+
+    conversions = 0
+
+    def __init__(self, value: float):
+        self.value = value
+
+    def __float__(self) -> float:
+        CountingScalar.conversions += 1
+        return self.value
+
+
+def test_conversions_only_on_the_log_cadence(tmp_path):
+    from distributed_llms_example_tpu.obs import TrainerObs
+
+    cfg = TrainConfig(
+        output_dir=str(tmp_path), obs="jsonl", health="on",
+        log_every_steps=4, recorder_steps=16,
+    )
+    obs = TrainerObs(cfg, start_step=0)
+    assert obs.watchdog is not None and obs.recorder is not None
+    CountingScalar.conversions = 0
+    for step in (1, 2, 3):
+        with obs.step_span():
+            pass
+        action = obs.on_step(
+            step, 0,
+            {"loss": CountingScalar(2.0), "grad_norm": CountingScalar(1.0),
+             "nonfinite_count": CountingScalar(0.0)},
+        )
+        assert action == "ok"
+        # OFF-cadence steps: recorder append + pending append, ZERO
+        # device→host conversions (the async-dispatch invariant)
+        assert CountingScalar.conversions == 0
+    with obs.step_span():
+        pass
+    obs.on_step(
+        4, 0,
+        {"loss": CountingScalar(2.0), "grad_norm": CountingScalar(1.0),
+         "nonfinite_count": CountingScalar(0.0)},
+    )
+    # the cadence step converts the whole window (4 steps × 3 scalars)
+    assert CountingScalar.conversions == 12
+    sink_mod.current_sink().close()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bounded ring, annotate, atomic schema-stamped dump
+# ---------------------------------------------------------------------------
+
+def test_recorder_ring_and_atomic_dump(tmp_path, capsys):
+    rec = FlightRecorder(capacity=4)
+    for step in range(1, 11):
+        rec.record(step, 0, {"loss": float(step)}, {"epoch": 0, "epoch_step": step})
+    assert len(rec) == 4
+    rec.annotate(10, {"loss": 10.0, "grad_norm": 3.0})
+    path = rec.dump(
+        str(tmp_path), reason="anomaly:nonfinite", step=10,
+        anomalies=[Anomaly(step=9, code="nonfinite", value=1.0, detail="d")],
+    )
+    assert path is not None and os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")  # atomic: no torn temp left
+    bundle = json.load(open(path))
+    assert bundle["schema_version"] == 1
+    assert bundle["reason"] == "anomaly:nonfinite" and bundle["step"] == 10
+    assert [e["step"] for e in bundle["entries"]] == [7, 8, 9, 10]
+    assert bundle["entries"][-1]["metrics"]["grad_norm"] == 3.0
+    assert bundle["anomalies"][0]["code"] == "nonfinite"
+    # non-finite metric values serialize as strings, not bare NaN literals
+    rec.record(11, 0, {"loss": float("nan")})
+    p2 = rec.dump(str(tmp_path), reason="exception", step=11)
+    assert json.load(open(p2))["entries"][-1]["metrics"]["loss"] == "nan"
+    lines = _json_lines(capsys.readouterr().out)
+    assert any(r.get("event") == "recorder_dump" for r in lines)
+
+
+def test_batch_fingerprint_identity():
+    b = {
+        "input_ids": np.arange(12, dtype=np.int32).reshape(3, 4),
+        "attention_mask": np.ones((3, 4), np.int32),
+        "labels": np.arange(6, dtype=np.int32).reshape(3, 2),
+    }
+    fp = batch_fingerprint(b, epoch=1, epoch_step=5)
+    assert fp["shapes"]["input_ids"] == [3, 4]
+    assert fp["epoch"] == 1 and fp["epoch_step"] == 5
+    # deterministic, content-sensitive
+    assert fp == batch_fingerprint(b, epoch=1, epoch_step=5)
+    b2 = {k: v.copy() for k, v in b.items()}
+    b2["input_ids"][0, 0] += 1
+    assert batch_fingerprint(b2, epoch=1, epoch_step=5)["input_ids_crc32"] != fp["input_ids_crc32"]
+
+
+# ---------------------------------------------------------------------------
+# TrainerObs policy actions (hand-driven; the real loop is the e2e below)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("policy,expected", [
+    ("warn", "warn"), ("halt", "halt"), ("checkpoint", "checkpoint"),
+])
+def test_anomaly_policy_actions(tmp_path, policy, expected):
+    from distributed_llms_example_tpu.obs import TrainerObs
+
+    cfg = TrainConfig(
+        output_dir=str(tmp_path / policy), obs="jsonl", health="on",
+        on_anomaly=policy, log_every_steps=2, recorder_steps=8,
+    )
+    obs = TrainerObs(cfg, start_step=0)
+    with obs.step_span():
+        pass
+    assert obs.on_step(1, 0, {"loss": 2.0, "grad_norm": 1.0, "nonfinite_count": 0.0}) == "ok"
+    with obs.step_span():
+        pass
+    action = obs.on_step(
+        2, 0, {"loss": float("nan"), "grad_norm": 1.0, "nonfinite_count": 5.0}
+    )
+    assert action == expected
+    # any anomaly (whatever the policy) dumps the flight recorder
+    bundle_path = obs.recorder.bundle_path(cfg.output_dir)
+    assert os.path.exists(bundle_path)
+    bundle = json.load(open(bundle_path))
+    assert bundle["reason"] == "anomaly:nonfinite"
+    assert bundle["anomalies"][0]["step"] == 2
+    sink_mod.current_sink().close()
+
+
+# ---------------------------------------------------------------------------
+# the injected-NaN end-to-end acceptance run
+# ---------------------------------------------------------------------------
+
+def test_trainer_injected_nan_checkpoint_and_report(tmp_path):
+    """The acceptance criterion end to end: a NaN injected at step 3 of a
+    real --obs jsonl run trips ``obs_anomaly`` with the correct step and
+    rank, ``--on-anomaly checkpoint`` stops the run with a resumable
+    checkpoint + flight-recorder bundle, and ``obs report`` over the
+    output dir reconstructs the timeline with the anomaly on it."""
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    rng = np.random.RandomState(0)
+    recs = [
+        {
+            "dialogue": " ".join(f"w{rng.randint(40)}" for _ in range(12)),
+            "summary": f"w{rng.randint(40)}",
+        }
+        for _ in range(16)
+    ]
+    cfg = TrainConfig(
+        model_ckpt="t5-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        num_epochs=3,
+        warmup_steps=1,
+        evaluation_steps=0,
+        max_source_length=32,
+        max_target_length=16,
+        pad_to_multiple=32,
+        log_every_steps=2,
+        num_beams=1,
+        tokenizer="byte",
+        mesh=MeshConfig(data=-1),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
+        obs="jsonl",
+        obs_gauges="off",  # gauge compile not under test here
+        health="on",
+        on_anomaly="checkpoint",
+        recorder_steps=8,
+    )
+    trainer = Trainer(cfg, train_records=recs)
+    trainer.save_final = lambda: None
+    trainer._poison_nan_at_step = 3  # the injected-NaN test hook
+    result = trainer.train()
+
+    # the run stopped at the detecting cadence step with the policy action
+    assert result.get("anomaly") == "checkpoint"
+    assert result["steps"] == 4  # cadence 2: NaN at 3 detected at 4
+
+    # obs_anomaly carries the poisoned step and the detecting rank
+    path = os.path.join(str(tmp_path), "obs", "metrics-p000.jsonl")
+    records = [json.loads(line) for line in open(path)]
+    anomaly = next(r for r in records if r.get("event") == "obs_anomaly")
+    assert anomaly["step"] == 3 and anomaly["detected_at_step"] == 4
+    assert anomaly["code"] == "nonfinite" and anomaly["ranks"] == [0]
+    assert anomaly["policy"] == "checkpoint"
+
+    # a RESUMABLE checkpoint was force-saved at the stop step
+    assert trainer.checkpointer.latest_step() == 4
+
+    # the flight-recorder bundle holds the poisoned step's evidence
+    bundle_path = os.path.join(str(tmp_path), "obs", "flight-recorder-p000.json")
+    bundle = json.load(open(bundle_path))
+    assert bundle["reason"] == "anomaly:nonfinite"
+    by_step = {e["step"]: e for e in bundle["entries"]}
+    assert by_step[3]["metrics"]["loss"] == "nan"
+    assert float(by_step[3]["metrics"]["nonfinite_count"]) > 0
+    assert float(by_step[2]["metrics"]["nonfinite_count"]) == 0
+    assert by_step[3]["fingerprint"]["shapes"]["input_ids"][0] == 8
+    assert "input_ids_crc32" in by_step[3]["fingerprint"]
+
+    # obs report reconstructs the run: anomaly on the timeline, recorder
+    # named, schema clean
+    report = build_report(str(tmp_path))
+    assert report["schema_errors"] == []
+    assert report["anomalies"][0]["step"] == 3
+    row3 = next(r for r in report["timeline"] if r["step"] == 3)
+    assert row3["anomalies"][0]["code"] == "nonfinite"
+    assert report["recorders"]["0"]["reason"] == "anomaly:nonfinite"
+    md = render_markdown(report)
+    assert "nonfinite" in md and "flight recorder p0" in md
+
+
+# ---------------------------------------------------------------------------
+# satellite: eval events carry the global step like train events
+# ---------------------------------------------------------------------------
+
+def test_eval_event_carries_step_field(capsys):
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    t = object.__new__(Trainer)  # evaluate() only touches these attrs
+    t.val_ds = [1]
+    t.pipelined = False
+    t.evaluator = None
+    t._pipeline_rouge_ok = False
+    t.cfg = TrainConfig()
+    scores = Trainer.evaluate(t, epoch=2, step=37)
+    lines = _json_lines(capsys.readouterr().out)
+    ev = next(r for r in lines if r.get("event") == "eval")
+    assert ev["step"] == 37 and ev["epoch"] == 2.0
+    assert scores["epoch"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# satellite: JSONL schema round-trip for every event type
+# ---------------------------------------------------------------------------
+
+def test_schema_round_trip_every_event_type(tmp_path, capsys):
+    """Every event type the telemetry stack emits parses back through
+    obs/report.py's loader with schema_version checked — spans windows,
+    gauges, heartbeat, health, recorder, profiler, plus the plain metric
+    lines."""
+    from distributed_llms_example_tpu.obs import TrainerObs
+    from distributed_llms_example_tpu.obs.gauges import collective_traffic
+    from distributed_llms_example_tpu.obs.heartbeat import Heartbeat
+    from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+    cfg = TrainConfig(
+        output_dir=str(tmp_path), obs="jsonl", health="on",
+        log_every_steps=1, recorder_steps=4, obs_heartbeat_steps=1,
+    )
+    obs = TrainerObs(cfg, start_step=0)
+    obs.flops_per_step = 1e9
+    # spans window + heartbeat + (clean) health on step 1
+    with obs.step_span():
+        pass
+    obs.on_step(1, 0, {"loss": 1.0, "grad_norm": 1.0, "nonfinite_count": 0.0})
+    # health anomaly (+ recorder dump) on step 2
+    with obs.step_span():
+        pass
+    obs.on_step(2, 0, {"loss": float("nan"), "grad_norm": 1.0, "nonfinite_count": 1.0})
+    # gauges record (the account computed from a hand HLO — no compile)
+    acct = collective_traffic(
+        "  %ar = f32[8]{0} all-reduce(f32[8]{0} %x), replica_groups={{0,1}}, to_apply=%add\n",
+        [8], 2,
+    )
+    sink_mod.emit({"event": "obs_gauges", "flops_per_step": 1e9,
+                   "flops_source": "test", "mesh": {"data": 2}, "comm": acct})
+    Heartbeat(every_steps=1).beat(2)
+    # profiler event shape (emitted without a real trace)
+    sink_mod.emit({"event": "profile_trace", "dir": str(tmp_path)}, all_processes=True)
+    # the plain metric line + eval line
+    log_json({"step": 2, "loss": 1.0, "learning_rate": 1e-4})
+    log_json({"event": "eval", "step": 2, "val_loss": 1.5})
+    sink_mod.current_sink().close()
+
+    path = os.path.join(str(tmp_path), "obs", "metrics-p000.jsonl")
+    records, errors = load_jsonl(path)
+    assert errors == []
+    events = {r.get("event", "metric") for r in records}
+    assert {
+        "obs_window", "obs_anomaly", "recorder_dump", "obs_gauges",
+        "heartbeat", "profile_trace", "eval", "metric",
+    } <= events
+    assert all(r["schema_version"] == 1 for r in records)
+    # and the report consumes the lot without complaint
+    report = build_report(str(tmp_path))
+    assert report["schema_errors"] == []
+    assert report["comm"] is not None
+    render_markdown(report)
+
+    # the loader REJECTS schema drift and torn lines, per line
+    bad = tmp_path / "obs" / "metrics-p001.jsonl"
+    with open(bad, "w") as f:
+        f.write(json.dumps({"schema_version": 99, "event": "x"}) + "\n")
+        f.write(json.dumps({"event": "no_stamp"}) + "\n")
+        f.write('{"torn": ')  # kill mid-write
+    recs, errs = load_jsonl(str(bad))
+    assert recs == [] and len(errs) == 3
+
+
+# ---------------------------------------------------------------------------
+# report: merged timeline + straggler attribution from hand-built streams
+# ---------------------------------------------------------------------------
+
+def _stamp(rec: dict) -> dict:
+    return {"schema_version": 1, **rec}
+
+
+def test_report_merges_cross_host_timeline(tmp_path):
+    obs_dir = tmp_path / "obs"
+    os.makedirs(obs_dir)
+    p0 = [
+        _stamp({"step": 2, "loss": 2.5, "learning_rate": 1e-4, "tokens_per_sec": 100.0}),
+        _stamp({"event": "obs_window", "step": 2, "step_ms_p50": 10.0,
+                "step_ms_p95": 12.0, "step_ms_max": 12.0, "straggler": False}),
+        _stamp({"event": "heartbeat", "step": 2, "process_count": 2,
+                "skew_steps": 0, "arrival_spread_s": 6.0, "laggards": [1]}),
+        _stamp({"event": "eval", "step": 2, "val_loss": 2.1}),
+        _stamp({"event": "obs_anomaly", "step": 3, "detected_at_step": 4,
+                "code": "loss_spike", "ranks": [1], "policy": "warn"}),
+        _stamp({"step": 4, "loss": 9.0}),
+    ]
+    p1 = [
+        _stamp({"event": "obs_window", "step": 2, "step_ms_p50": 16.0,
+                "step_ms_p95": 19.0, "step_ms_max": 25.0, "straggler": True}),
+    ]
+    for idx, recs in ((0, p0), (1, p1)):
+        with open(obs_dir / f"metrics-p{idx:03d}.jsonl", "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+    processes = {0: p0, 1: p1}
+    timeline = merge_timeline(processes)
+    row2 = next(r for r in timeline if r["step"] == 2)
+    assert row2["loss"] == 2.5 and row2["eval"]["val_loss"] == 2.1
+    # BOTH ranks' windows land on the same step row
+    assert row2["windows"][0]["p50"] == 10.0
+    assert row2["windows"][1]["p50"] == 16.0 and row2["windows"][1]["straggler"]
+    assert row2["heartbeat"]["laggards"] == [1]
+    row3 = next(r for r in timeline if r["step"] == 3)
+    assert row3["anomalies"][0]["ranks"] == [1]
+    # straggler attribution: rank 1 named by the heartbeat AND slowest p95
+    s = straggler_attribution(processes)
+    assert s["heartbeat_laggard_counts"] == {"1": 1}
+    assert s["max_arrival_spread_s"] == 6.0
+    assert s["mean_step_ms_p95_by_rank"] == {"0": 12.0, "1": 19.0}
+    assert s["straggler_windows_by_rank"] == {"0": 0, "1": 1}
+    # the full report + markdown over the same dir
+    report = build_report(str(tmp_path))
+    assert report["processes"] == [0, 1]
+    md = render_markdown(report)
+    assert "rank 1: named laggard in 1 heartbeat(s)" in md
+    assert "loss_spike@ranks[1]" in md
+
+
+def test_report_cli_main(tmp_path, capsys):
+    from distributed_llms_example_tpu.obs import report as report_mod
+
+    obs_dir = tmp_path / "obs"
+    os.makedirs(obs_dir)
+    with open(obs_dir / "metrics-p000.jsonl", "w") as f:
+        f.write(json.dumps(_stamp({"step": 1, "loss": 1.0})) + "\n")
+        f.write(json.dumps({"event": "drifted"}) + "\n")  # no stamp
+    assert report_mod.main([str(tmp_path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["records"] == 1 and len(out["schema_errors"]) == 1
+    # --strict turns schema drift into a nonzero exit
+    assert report_mod.main([str(tmp_path), "--strict"]) == 1
+    capsys.readouterr()
+    # no obs dir at all → usage error
+    assert report_mod.main([str(tmp_path / "nowhere")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: the fsync'd sink + recorder bundle survive a kill -9
+# ---------------------------------------------------------------------------
+
+def test_sink_and_recorder_survive_kill9(tmp_path):
+    """A subprocess writes JSONL telemetry + a recorder bundle, flushes
+    with fsync (the anomaly-path durability contract), then SIGKILLs
+    itself mid-run.  Everything flushed before the kill must parse."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = """
+import json, os, signal
+from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.recorder import FlightRecorder
+
+out = os.environ["K9_OUT"]
+sink_mod.install_sink(sink_mod.build_sink("jsonl", out))
+for step in range(1, 6):
+    sink_mod.emit({"event": "obs_window", "step": step, "step_ms_p50": 1.0}, local=True)
+rec = FlightRecorder(capacity=4)
+for step in range(1, 6):
+    rec.record(step, 0, {"loss": float(step)})
+rec.dump(out, reason="anomaly:test", step=5)   # atomic + fsync'd
+sink_mod.flush(fsync=True)                     # the anomaly-path flush
+os.kill(os.getpid(), signal.SIGKILL)           # kill -9, no cleanup runs
+"""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        "K9_OUT": str(tmp_path),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == -9, proc.stderr[-2000:]  # really SIGKILLed
+    records, errors = load_jsonl(str(tmp_path / "obs" / "metrics-p000.jsonl"))
+    assert errors == []
+    windows = [r for r in records if r.get("event") == "obs_window"]
+    assert [r["step"] for r in windows] == [1, 2, 3, 4, 5]
+    assert any(r.get("event") == "recorder_dump" for r in records)
+    bundle = json.load(open(tmp_path / "obs" / "flight-recorder-p000.json"))
+    assert bundle["reason"] == "anomaly:test"
+    assert [e["step"] for e in bundle["entries"]] == [2, 3, 4, 5]
+    assert not os.path.exists(str(tmp_path / "obs" / "flight-recorder-p000.json.tmp"))
+
+
+# ---------------------------------------------------------------------------
+# CI/tooling: the repo lint's step-cadence sync rule
+# ---------------------------------------------------------------------------
+
+def _load_repo_lint():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_lint_step_cadence_sync_rule(tmp_path):
+    repo_lint = _load_repo_lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "class R:\n"
+        "    def record(self, m):\n"
+        "        self.v = float(m['loss'])\n"        # per-step conversion
+        "    def step_hook(self, m):\n"
+        "        x = m['loss'].item()\n"             # per-step .item()
+        "        y = jax.device_get(m['loss'])\n"    # per-step device_get
+        "        return x, y\n"
+        "    def dump(self, m):\n"
+        "        return float(m['loss'])\n"          # allowed window func
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "obs", "recorder.py")
+    violations = repo_lint.lint_file(str(bad), rel)
+    assert len(violations) == 3
+    assert all("step-cadence" in v for v in violations)
+    # same code outside a step-cadence file: no rule-4 findings
+    rel = os.path.join("distributed_llms_example_tpu", "obs", "gauges.py")
+    assert repo_lint.lint_file(str(bad), rel) == []
+    # and the repo itself is clean under the new rule
+    assert repo_lint.main([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# 2-process leg: per-process JSONL streams, rank-attributed agreement, and
+# the merged report (the acceptance's cross-host reconstruction)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_two_process_report_and_agreement(tmp_path):
+    """Two real OS processes share one output dir: each writes its OWN
+    obs_window stream (rank 1 runs slow steps), the heartbeat names rank
+    1 a laggard, and a rank-1-only anomaly is agreed — then ``obs
+    report`` over the shared dir reconstructs the merged per-step
+    timeline with straggler attribution."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = """
+import json, os, sys, time
+import jax
+from distributed_llms_example_tpu.core.mesh import initialize_distributed
+initialize_distributed(os.environ["HR_COORD"], 2, int(os.environ["HR_RANK"]))
+from distributed_llms_example_tpu.core.config import TrainConfig
+from distributed_llms_example_tpu.obs import TrainerObs
+from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.health import Anomaly, agree_and_emit
+
+rank = jax.process_index()
+cfg = TrainConfig(
+    output_dir=os.environ["HR_OUT"], obs="jsonl", health="off",
+    log_every_steps=2, obs_heartbeat_steps=2, recorder_steps=0,
+)
+obs = TrainerObs(cfg, start_step=0, manage_sink=True)
+obs.heartbeat.laggard_threshold_s = 1.0  # the 1.5 s sleep must register
+for step in (1, 2, 3, 4):
+    with obs.step_span():
+        time.sleep(0.01 if rank == 0 else 0.05)  # rank 1 is slow
+    if rank == 1 and step == 2:
+        time.sleep(1.5)  # heartbeat laggard at the step-2 beat
+    obs.on_step(step, 0, {})
+# rank-1-only anomaly, agreed over the heartbeat channel at step 4
+local = [] if rank == 0 else [Anomaly(step=3, code="loss_spike", value=9.0, detail="test")]
+rec = agree_and_emit(local, step=4, policy="warn")
+assert rec is not None and rec["ranks"] == [1], rec  # BOTH ranks agree
+assert rec["step"] == 3 and rec["code"] == "loss_spike"
+sink_mod.current_sink().close()
+print("AGREED " + json.dumps(rec))
+"""
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+            "HR_COORD": f"127.0.0.1:{port}",
+            "HR_RANK": str(rank),
+            "HR_OUT": str(tmp_path),
+        })
+        for k in ("VH_MASTER_IP", "VH_WORLD_SIZE", "VH_RANK"):
+            env.pop(k, None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = [p.communicate(timeout=300) for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs[0][1][-2000:] + outs[1][1][-2000:]
+    # both ranks saw the same agreed record
+    assert any(ln.startswith("AGREED ") for ln in outs[0][0].splitlines())
+
+    # every process wrote its own stream; the report merges them
+    for idx in (0, 1):
+        recs, errs = load_jsonl(str(tmp_path / "obs" / f"metrics-p{idx:03d}.jsonl"))
+        assert errs == []
+        assert any(r.get("event") == "obs_window" for r in recs)
+    report = build_report(str(tmp_path))
+    assert report["processes"] == [0, 1]
+    assert report["schema_errors"] == []
+    # merged timeline: both ranks' windows on the cadence steps
+    row = next(r for r in report["timeline"] if r["step"] == 2)
+    assert set(row["windows"]) == {0, 1}
+    # rank 1's steps are measurably slower on its own stream
+    assert row["windows"][1]["p50"] > row["windows"][0]["p50"]
+    # straggler attribution: the heartbeat (p0's stream) named rank 1.
+    # NOTE self-timed p95s CANNOT distinguish the ranks here — the
+    # heartbeat gather is a barrier, so rank 0's wait for sleeping rank 1
+    # lands in rank 0's own next step duration; that equalization is
+    # exactly why attribution comes from the heartbeat's arrival spread
+    s = report["stragglers"]
+    assert s["heartbeat_laggard_counts"].get("1", 0) >= 1
+    assert s["max_arrival_spread_s"] >= 1.0
+    assert set(s["mean_step_ms_p95_by_rank"]) == {"0", "1"}
+    # the agreed anomaly (emitted by p0) rides the merged timeline
+    row3 = next(r for r in report["timeline"] if r["step"] == 3)
+    assert row3["anomalies"][0]["code"] == "loss_spike"
+    assert row3["anomalies"][0]["ranks"] == [1]
+    md = render_markdown(report)
+    assert "named laggard" in md

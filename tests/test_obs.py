@@ -380,6 +380,80 @@ def test_trainer_obs_jsonl_stream(tmp_path, compiled_t5_fsdp):
 
 
 # ---------------------------------------------------------------------------
+# satellite (ISSUE 3): the ROADMAP reduce-scatter smell as a pure predicate
+# over the gradient-byte account, pinned on a real compiled FSDP step
+# ---------------------------------------------------------------------------
+
+def test_reduce_scatter_smell_pure_predicate():
+    from distributed_llms_example_tpu.analysis.ir_lint import (
+        account_gradient_bytes_by_op,
+        reduce_scatter_smell,
+    )
+
+    fsdp = {"fsdp": 8, "data": 1}
+    # healthy: gradients ride reduce-scatter → no finding
+    assert reduce_scatter_smell(
+        {"reduce-scatter": 64 << 20, "all-reduce": 4}, fsdp
+    ) is None
+    # the 2× smell: the same bytes all-REDUCED instead
+    f = reduce_scatter_smell({"all-reduce": 64 << 20, "reduce-scatter": 0}, fsdp)
+    assert f is not None and f.code == "gradient-all-reduce-not-reduce-scatter"
+    assert f.context["all_reduce_gradient_bytes"] == 64 << 20
+    # async -start forms fold into their base op
+    assert reduce_scatter_smell({"all-reduce-start": 64 << 20}, fsdp) is not None
+    # not an fsdp mesh → gradients are SUPPOSED to all-reduce (pure DP)
+    assert reduce_scatter_smell({"all-reduce": 64 << 20}, {"data": 8}) is None
+    # below the noise floor → quiet
+    assert reduce_scatter_smell({"all-reduce": 1024}, fsdp) is None
+    # the obs runtime account (per-op dicts) feeds the SAME predicate
+    acct = {
+        "all-reduce": {"count": 2, "gradient_bytes": 64 << 20, "activation_bytes": 4},
+        "reduce-scatter": {"count": 0, "gradient_bytes": 0, "activation_bytes": 0},
+        "total_bytes": (64 << 20) + 4,
+        "gradient_bytes": 64 << 20,
+        "activation_bytes": 4,
+    }
+    by_op = account_gradient_bytes_by_op(acct)
+    assert by_op == {"all-reduce": 64 << 20, "reduce-scatter": 0}
+    assert reduce_scatter_smell(by_op, fsdp) is not None
+
+
+def test_reduce_scatter_smell_pinned_on_compiled_fsdp_step(compiled_t5_fsdp):
+    """The predicate over the REAL compiled FSDP step.  Pinned behavior on
+    this backend: the CPU SPMD partitioner lowers the fsdp gradient
+    reduction as all-reduce (+ dynamic-slice), NOT reduce-scatter — i.e.
+    the compiled step genuinely exhibits the 2× gradient-traffic pattern
+    the smell hunts, so with the noise floor dropped the predicate MUST
+    fire, and it must fire identically over the IR census and the obs
+    runtime account (same parser, same classification)."""
+    from distributed_llms_example_tpu.analysis.ir_lint import (
+        account_gradient_bytes_by_op,
+        reduce_scatter_smell,
+        scan_hlo_text,
+    )
+
+    text, elems, mesh = compiled_t5_fsdp
+    census = next(
+        f
+        for f in scan_hlo_text(
+            text, mesh_axes=dict(mesh.shape), param_element_counts=elems
+        )
+        if f.code == "collective-census"
+    )
+    grad_by_op = census.context["gradient_bytes_by_op"]
+    assert grad_by_op.get("all-reduce", 0) > 0  # the pattern is really there
+    f = reduce_scatter_smell(grad_by_op, dict(mesh.shape), min_bytes=0)
+    assert f is not None and f.code == "gradient-all-reduce-not-reduce-scatter"
+    # the same verdict from the runtime account of the same program
+    acct = collective_traffic(text, elems, mesh.size)
+    f2 = reduce_scatter_smell(
+        account_gradient_bytes_by_op(acct), dict(mesh.shape), min_bytes=0
+    )
+    assert f2 is not None
+    assert f2.context == f.context
+
+
+# ---------------------------------------------------------------------------
 # satellite: MetricLogger cadence fix + flush
 # ---------------------------------------------------------------------------
 

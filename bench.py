@@ -386,6 +386,11 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
             # overrides so vs_synthetic compares identically-built programs
             remat=remat,
             attention_impl=attention or "",
+            # pin the baseline stream: with the new "auto" defaults a TPU
+            # trainer would silently start on rbg+fused and the rbg A/B
+            # pass below would compare like against like
+            prng_impl="threefry",
+            dropout_impl="xla",
             mesh=MeshConfig(data=-1),
             checkpoint=CheckpointConfig(save_every_steps=0, resume=False, async_save=False),
         )
@@ -426,6 +431,8 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
             dt = timed_pass()
             out["tokens_per_sec_chip_rbg"] = round(tokens / dt / n_chips, 1)
         out["steps"] = steps
+        out["prng_impl"] = trainer.prng_impl  # resolved (not the "auto" alias)
+        out["dropout_impl"] = trainer.cfg.dropout_impl
         return out
 
 
@@ -924,6 +931,12 @@ def main() -> None:
     }
     if comm_bytes is not None:
         result["comm_bytes_per_step"] = comm_bytes
+    # the synthetic passes below drive their own keys: headline has no
+    # dropout; the with-dropout pass feeds threefry keys, the rbg add-on
+    # hardware-RNG keys, and the fused add-on flips --dropout-impl —
+    # stamp both knobs so BENCH_*.json rows stay comparable across rounds
+    result["dropout_impl"] = "xla"
+    result["prng_impl"] = "threefry"
     # Emit the record NOW and again after each add-on lands: if an add-on
     # overruns the supervisor's kill (budget gates check only at add-on
     # START), the supervisor salvages the newest line from the dead
@@ -974,6 +987,17 @@ def main() -> None:
     tps_chip_dropout = None
     if os.environ.get("BENCH_DROPOUT", "1") != "0" and not over_budget("dropout step"):
         try:
+            # pin the BASELINE to the xla impl: on TPU the process default
+            # ("auto") resolves to fused, and the fused-vs-xla A/B below
+            # would silently compare fused against fused (the rbg add-on
+            # retraces this step for the typed key, so the pin must hold
+            # through it — restored by the fused A/B block / the reset
+            # before the trainer loop)
+            from distributed_llms_example_tpu.ops.fused_dropout import (
+                set_default_impl as _set_dropout_impl,
+            )
+
+            _set_dropout_impl("xla")
             build_d = make_train_step(lm.module, lm.config, tx, schedule, mesh, with_dropout=True)
             step_d, _ = build_d(state)
             key = jax.random.PRNGKey(0)
@@ -1021,6 +1045,87 @@ def main() -> None:
             print(json.dumps(result), flush=True)
         except Exception as e:
             print(f"bench: rbg dropout-step bench failed ({e})", file=sys.stderr)
+
+    # fused-dropout A/B: the SAME with-dropout step rebuilt with
+    # --dropout-impl fused (ops/fused_dropout.py — in-kernel RNG, no mask
+    # in HBM, seed-recompute backward), same session, same shapes, same
+    # threefry key stream (the fused path folds the key to ONE scalar, so
+    # host-PRNG choice no longer matters — that is the point).  The
+    # acceptance bar is fused ≥ 1.10× the xla with-dropout number.
+    if (
+        tps_chip_dropout is not None
+        and os.environ.get("BENCH_DROPOUT_FUSED", "1") != "0"
+        and not over_budget("fused dropout step")
+    ):
+        from distributed_llms_example_tpu.ops.fused_dropout import (
+            set_default_impl,
+        )
+
+        try:
+            set_default_impl("fused")
+            build_f = make_train_step(lm.module, lm.config, tx, schedule, mesh, with_dropout=True)
+            step_f, _ = build_f(state)
+            key = jax.random.PRNGKey(0)
+            for _ in range(2):
+                key, sub = jax.random.split(key)
+                state, metrics = step_f(state, gb, sub)
+            sync(state, metrics)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                key, sub = jax.random.split(key)
+                state, metrics = step_f(state, gb, sub)
+            sync(state, metrics)
+            dtf = time.perf_counter() - t0
+            tps_chip_dropout_fused = round(tokens_per_step * steps / dtf / n_chips, 1)
+            result["with_dropout_fused_tokens_per_sec_chip"] = tps_chip_dropout_fused
+            result["fused_vs_xla_dropout"] = round(tps_chip_dropout_fused / tps_chip_dropout, 3)
+            # mask-absence assertion: scan the compiled fused step for any
+            # operand shaped like a (B_local·H·S·S) attention-probs mask —
+            # the fused path must never materialize one (the headline
+            # families run attn_dropout_rate 0, so any hit is a bug)
+            try:
+                from distributed_llms_example_tpu.analysis.ir_lint import (
+                    parse_hlo_instructions,
+                )
+
+                with activation_mesh(step_f.mesh):
+                    txt = step_f.jitted.lower(state, gb, sub).compile().as_text()
+                heads = int(getattr(
+                    lm.config, "encoder_attention_heads",
+                    getattr(lm.config, "num_heads",
+                            getattr(lm.config, "num_attention_heads", 0)),
+                ) or 0)
+                b_local = max(1, batch // n_chips)
+                probs_elems = {
+                    b_local * heads * ql * kl
+                    for ql in (src_len, tgt_len) for kl in (src_len, tgt_len)
+                } if heads else set()
+                hits = [
+                    i.name for i in parse_hlo_instructions(txt).values()
+                    if i.elems in probs_elems
+                ]
+                result["attn_probs_mask_operands"] = len(hits)
+                if hits:
+                    print(
+                        f"bench: {len(hits)} (B·H·S·S)-sized operand(s) in the "
+                        f"fused step (e.g. %{hits[0]}) — probs-mask smell",
+                        file=sys.stderr,
+                    )
+            except Exception as e:
+                print(f"bench: fused-step HLO scan unavailable ({e})", file=sys.stderr)
+            print(json.dumps(result), flush=True)
+        except Exception as e:
+            print(f"bench: fused dropout-step bench failed ({e})", file=sys.stderr)
+
+    # restore the process default ("auto") after the pinned A/B passes —
+    # the trainer-loop bench pins its own cfg, but a leaked pin would
+    # still surprise anything imported after us
+    try:
+        from distributed_llms_example_tpu.ops.fused_dropout import set_default_impl
+
+        set_default_impl("auto")
+    except Exception:
+        pass
 
     # the full Trainer loop (bucketed batching + prefetch + logging on the
     # critical path): validating within ~5% of the with-dropout synthetic

@@ -21,6 +21,10 @@ utils/memory_audit.py) — and the post-optimization HLO text is scanned:
   singletons (or a self-loop collective-permute) — traffic over an axis
   the config says is size 1; usually a spec naming an axis the mesh
   doesn't actually split.
+- ``host-transfer-in-step``: infeed/outfeed, ``is_host_transfer=true``
+  send/recv/copy, or host-offloading custom-calls inside the step body —
+  a host round-trip per step serializes async dispatch (error; the
+  compiled-IR twin of scripts/repo_lint.py rule 4).
 
 The text scanner is pure (string in, findings out) so tests can seed
 violations deterministically; the compile driver wraps it.
@@ -69,6 +73,17 @@ _COLLECTIVE_OPS = (
     "reduce-scatter", "all-to-all", "collective-permute",
     "collective-permute-start",
 )
+
+# Ops that ALWAYS mean host traffic; send/recv/copy additionally carry an
+# ``is_host_transfer=true`` attribute when they cross to the host (plain
+# send/recv pairs can be legitimate device-to-device channel traffic on
+# some backends, so only the attributed forms are flagged).
+_HOST_TRANSFER_OPS = ("infeed", "outfeed")
+_HOST_ATTRIBUTED_OPS = ("send", "send-done", "recv", "recv-done",
+                        "copy-start", "copy-done")
+# GSPMD/XLA host-offloading custom-call targets
+_HOST_CUSTOM_CALLS = ("MoveToHost", "MoveToDevice", "PinToHost",
+                      "annotate_device_placement")
 
 
 def _bytes_of(dtype: str, dims: str) -> int:
@@ -221,6 +236,26 @@ def reduce_scatter_smell(
     )
 
 
+def host_transfer_instructions(instrs: Mapping[str, HloInstr]) -> list[str]:
+    """Names of instructions that move data between host and device —
+    the ROADMAP "host-transfer ops inside the step body" smell.  Pure
+    predicate over parsed instructions (shared by the IR pass and tests):
+    infeed/outfeed always; send/recv/copy only when the instruction is
+    attributed ``is_host_transfer=true``; host-offloading custom-calls
+    (MoveToHost / MoveToDevice / annotate_device_placement)."""
+    out: list[str] = []
+    for name, instr in instrs.items():
+        if instr.op in _HOST_TRANSFER_OPS:
+            out.append(name)
+        elif instr.op in _HOST_ATTRIBUTED_OPS and "is_host_transfer=true" in instr.line:
+            out.append(name)
+        elif instr.op == "custom-call" and any(
+            t in instr.line for t in _HOST_CUSTOM_CALLS
+        ):
+            out.append(name)
+    return out
+
+
 def scan_hlo_text(
     hlo_text: str,
     *,
@@ -316,6 +351,24 @@ def scan_hlo_text(
                 ),
                 context={"count": len(bad_dots), "instructions": bad_dots[:8]},
             ))
+
+    # ---- host transfers inside the step body ---------------------------
+    host_xfers = host_transfer_instructions(instrs)
+    if host_xfers:
+        findings.append(Finding(
+            severity="error",
+            pass_name="ir",
+            code="host-transfer-in-step",
+            message=(
+                f"{len(host_xfers)} host-transfer op(s) inside the compiled "
+                f"train step (e.g. %{host_xfers[0]}) — a host round-trip on "
+                "the step body serializes async dispatch every single step; "
+                "device→host conversions belong at the log cadence "
+                "(the invariant scripts/repo_lint.py rule 4 guards on the "
+                "Python side)"
+            ),
+            context={"count": len(host_xfers), "instructions": host_xfers[:8]},
+        ))
 
     # ---- degenerate collectives ----------------------------------------
     degenerate: list[str] = []

@@ -137,11 +137,21 @@ class TrainConfig:
     # (tokens, vocab) fp32 logits in HBM — ops/blockwise_ce.py).  Meant
     # for data/fsdp meshes; under tensor parallelism keep it off.
     fused_ce: bool = False
-    # PRNG implementation for the in-step dropout stream: "threefry"
-    # (default — counter-based, bit-reproducible across backends) or "rbg"
-    # (TPU hardware RNG; much cheaper mask generation when dropout sits on
-    # the critical path, different — still deterministic — bit stream)
-    prng_impl: str = "threefry"
+    # PRNG implementation for the in-step dropout stream: "auto" (default
+    # — resolves to "rbg" on TPU backends and "threefry" elsewhere at
+    # trainer startup; trainer.set_prng_impl owns the resolution and the
+    # resolved value is logged + stamped into BENCH json so runs stay
+    # comparable), "threefry" (counter-based, bit-reproducible across
+    # backends) or "rbg" (TPU hardware RNG; much cheaper mask generation
+    # when dropout sits on the critical path, different — still
+    # deterministic — bit stream)
+    prng_impl: str = "auto"
+    # dropout implementation (ops/fused_dropout.py): "auto" (default —
+    # fused Pallas kernel with in-kernel RNG + seed-recompute backward on
+    # TPU, XLA bernoulli elsewhere), "fused" or "xla" to force.  "fused"
+    # trades bit-reproducibility with the XLA mask stream for the removal
+    # of threefry mask generation AND the mask's HBM round-trips
+    dropout_impl: str = "auto"
     remat: bool = False  # jax.checkpoint the transformer blocks
     remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
     # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
@@ -290,8 +300,17 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument(
         "--prng-impl", type=str, default=_D.prng_impl,
-        choices=("threefry", "rbg"),
-        help="dropout PRNG: threefry (bit-reproducible) or rbg (TPU hardware RNG, faster)",
+        choices=("auto", "threefry", "rbg"),
+        help="dropout PRNG: auto (rbg on TPU, threefry elsewhere — the "
+             "resolved impl is logged), threefry (bit-reproducible) or rbg "
+             "(TPU hardware RNG, faster)",
+    )
+    p.add_argument(
+        "--dropout-impl", type=str, default=_D.dropout_impl,
+        choices=("auto", "fused", "xla"),
+        help="dropout path: auto (fused Pallas kernel on TPU — in-kernel "
+             "RNG, no mask in HBM, seed-recompute backward; XLA elsewhere), "
+             "fused or xla to force",
     )
     p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)

@@ -18,6 +18,13 @@ Observability: ``--obs jsonl`` tees every metric line into
 multi-host liveness probe; ``--profile-steps 100:105`` captures a
 jax.profiler trace for that step window (see README "Observability").
 
+Dropout & RNG: ``--dropout-impl auto|fused|xla`` picks the dropout
+execution path (auto = the fused Pallas kernel on TPU — in-kernel RNG,
+no mask in HBM, seed-recompute backward; see README "Dropout & RNG
+performance") and ``--prng-impl auto|threefry|rbg`` the key stream
+(auto = TPU hardware RNG on TPU, bit-reproducible threefry elsewhere);
+the resolved pair is logged as an ``rng_config`` event at startup.
+
 Training health: ``--health`` (auto under ``--obs jsonl``) makes the
 compiled step return in-graph numerics (param norm, per-bucket update
 ratios, non-finite counts — zero extra device syncs) and arms the

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llms_example_tpu.ops.attention import mask_to_bias
+from distributed_llms_example_tpu.ops.fused_dropout import Dropout
 from distributed_llms_example_tpu.utils.remat import remat_block
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.norms import LayerNorm
@@ -37,6 +38,9 @@ class BartConfig:
     decoder_ffn_dim: int = 4096
     max_position_embeddings: int = 1024
     dropout_rate: float = 0.1
+    # HF ``attention_dropout`` (probs dropout; bart-large ships 0.0).
+    # Rides the flash kernels' in-kernel mask stream when > 0.
+    attn_dropout_rate: float = 0.0
     scale_embedding: bool = False
     pad_token_id: int = 1
     bos_token_id: int = 0
@@ -71,20 +75,22 @@ class BartEncoderLayer(nn.Module):
             use_bias=True,
             dtype=self.dtype,
             attention_impl=cfg.attention_impl,
+            probs_dropout_rate=cfg.attn_dropout_rate,
             name="self_attn",
         )
         self.self_attn_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="self_attn_layer_norm")
         self.mlp = BartMLP(cfg.encoder_ffn_dim, cfg.d_model, cfg.dropout_rate, self.dtype, name="mlp")
         self.final_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="final_layer_norm")
-        self.dropout = nn.Dropout(cfg.dropout_rate)
+        self.dropout = Dropout(cfg.dropout_rate)
 
     def __call__(self, hidden, bias, deterministic: bool = True):
         residual = hidden
-        h = self.self_attn(hidden, bias=bias)
-        hidden = self.self_attn_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        h = self.self_attn(hidden, bias=bias, deterministic=deterministic)
+        # the residual add rides the dropout kernel (one fused pass on TPU)
+        hidden = self.self_attn_layer_norm(self.dropout(h, deterministic, residual=residual))
         residual = hidden
         h = self.mlp(hidden, deterministic=deterministic)
-        hidden = self.final_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        hidden = self.final_layer_norm(self.dropout(h, deterministic, residual=residual))
         return hidden
 
 
@@ -97,7 +103,7 @@ class BartMLP(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         h = nn.gelu(nn.Dense(self.ffn_dim, dtype=self.dtype, name="fc1")(x), approximate=False)
-        h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
+        h = Dropout(self.dropout_rate)(h, deterministic)
         return nn.Dense(self.model_dim, dtype=self.dtype, name="fc2")(h)
 
 
@@ -115,6 +121,7 @@ class BartDecoderLayer(nn.Module):
             causal=causal,
             dtype=self.dtype,
             attention_impl=cfg.attention_impl,
+            probs_dropout_rate=cfg.attn_dropout_rate,
             name=name,
         )
         self.self_attn = mk_attn(True, "self_attn")
@@ -123,7 +130,7 @@ class BartDecoderLayer(nn.Module):
         self.cross_attn_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="cross_attn_layer_norm")
         self.mlp = BartMLP(cfg.decoder_ffn_dim, cfg.d_model, cfg.dropout_rate, self.dtype, name="mlp")
         self.final_layer_norm = LayerNorm(cfg.layer_norm_epsilon, self.dtype, name="final_layer_norm")
-        self.dropout = nn.Dropout(cfg.dropout_rate)
+        self.dropout = Dropout(cfg.dropout_rate)
 
     def __call__(
         self,
@@ -136,16 +143,19 @@ class BartDecoderLayer(nn.Module):
         cross_kv=None,
     ):
         residual = hidden
-        h = self.self_attn(hidden, bias=self_bias, use_cache=use_cache)
-        hidden = self.self_attn_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        h = self.self_attn(
+            hidden, bias=self_bias, use_cache=use_cache, deterministic=deterministic
+        )
+        hidden = self.self_attn_layer_norm(self.dropout(h, deterministic, residual=residual))
         residual = hidden
         h = self.cross_attn(
-            hidden, kv_hidden=encoder_hidden, bias=cross_bias, cross_kv=cross_kv
+            hidden, kv_hidden=encoder_hidden, bias=cross_bias, cross_kv=cross_kv,
+            deterministic=deterministic,
         )
-        hidden = self.cross_attn_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        hidden = self.cross_attn_layer_norm(self.dropout(h, deterministic, residual=residual))
         residual = hidden
         h = self.mlp(hidden, deterministic=deterministic)
-        hidden = self.final_layer_norm(residual + self.dropout(h, deterministic=deterministic))
+        hidden = self.final_layer_norm(self.dropout(h, deterministic, residual=residual))
         return hidden
 
 
@@ -183,7 +193,7 @@ class BartForConditionalGeneration(nn.Module):
         self.final_logits_bias = self.param(
             "final_logits_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32
         )
-        self.dropout = nn.Dropout(cfg.dropout_rate)
+        self.dropout = Dropout(cfg.dropout_rate)
 
     def encode(self, input_ids, attention_mask=None, *, deterministic: bool = True):
         cfg = self.config

@@ -270,6 +270,7 @@ def hf_config_dict(family: str, cfg: Any) -> dict:
             "decoder_ffn_dim": cfg.decoder_ffn_dim,
             "max_position_embeddings": cfg.max_position_embeddings,
             "dropout": cfg.dropout_rate,
+            "attention_dropout": cfg.attn_dropout_rate,
             "scale_embedding": cfg.scale_embedding,
             "pad_token_id": cfg.pad_token_id,
             "bos_token_id": cfg.bos_token_id,
@@ -290,6 +291,7 @@ def hf_config_dict(family: str, cfg: Any) -> dict:
             "num_attention_heads": cfg.num_attention_heads,
             "num_key_value_heads": cfg.num_key_value_heads or cfg.num_attention_heads,
             "max_position_embeddings": cfg.max_position_embeddings,
+            "attention_dropout": cfg.attn_dropout_rate,
             "rms_norm_eps": cfg.rms_norm_eps,
             "rope_theta": cfg.rope_theta,
             "tie_word_embeddings": False,

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llms_example_tpu.ops.attention import mask_to_bias
+from distributed_llms_example_tpu.ops.fused_dropout import Dropout
 from distributed_llms_example_tpu.ops.mha import MultiHeadAttention
 from distributed_llms_example_tpu.ops.moe import MoEMLP
 from distributed_llms_example_tpu.ops.norms import RMSNorm
@@ -49,6 +50,12 @@ class LlamaConfig:
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.0  # load-balance loss weight (0 disables)
+    # LLaMA pretrains dropout-free (HF ships no dropout knobs); these
+    # default to 0 for checkpoint fidelity, but the plumbing routes
+    # through the shared fused helper so a fine-tuning recipe CAN enable
+    # residual/probs dropout without touching model code
+    dropout_rate: float = 0.0
+    attn_dropout_rate: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -58,10 +65,6 @@ class LlamaConfig:
     @property
     def decoder_start_token_id(self) -> int:
         return self.bos_token_id
-
-    @property
-    def dropout_rate(self) -> float:
-        return 0.0
 
 
 class LlamaMLP(nn.Module):
@@ -96,6 +99,7 @@ class LlamaBlock(nn.Module):
             rope_theta=cfg.rope_theta,
             dtype=self.dtype,
             attention_impl=cfg.attention_impl,
+            probs_dropout_rate=cfg.attn_dropout_rate,
             name="self_attn",
         )
         self.mlp_norm = RMSNorm(cfg.rms_norm_eps, self.dtype, name="mlp_norm")
@@ -110,19 +114,30 @@ class LlamaBlock(nn.Module):
             )
         else:
             self.mlp = LlamaMLP(cfg, dtype=self.dtype, name="mlp")
+        self.dropout = Dropout(self.config.dropout_rate)
 
     def __call__(
         self, hidden, bias=None, deterministic: bool = True, use_cache: bool = False, positions=None
     ):
-        hidden = hidden + self.self_attn(
-            self.attn_norm(hidden), bias=bias, use_cache=use_cache, positions=positions
+        h = self.self_attn(
+            self.attn_norm(hidden), bias=bias, use_cache=use_cache,
+            positions=positions, deterministic=deterministic,
         )
+        # rate defaults to 0 (checkpoint-faithful): the helper is then a
+        # plain residual add; a recipe that turns dropout on gets the
+        # fused kernel with zero model changes
+        hidden = self.dropout(h, deterministic, residual=hidden)
         if self.config.num_experts > 0:
             # cached decode/prefill = inference: size expert capacity so no
             # token drops (exact HF-checkpoint behavior); training keeps the
             # capacity-factor trade
-            return hidden + self.mlp(self.mlp_norm(hidden), no_drop=use_cache)
-        return hidden + self.mlp(self.mlp_norm(hidden))
+            return self.dropout(
+                self.mlp(self.mlp_norm(hidden), no_drop=use_cache),
+                deterministic, residual=hidden,
+            )
+        return self.dropout(
+            self.mlp(self.mlp_norm(hidden)), deterministic, residual=hidden
+        )
 
 
 def _seq_shift_labels(labels: jnp.ndarray, axis_name: str, n: int) -> jnp.ndarray:

@@ -199,6 +199,9 @@ def _bart_from_hf_config(cfg: dict) -> BartConfig:
         decoder_ffn_dim=cfg["decoder_ffn_dim"],
         max_position_embeddings=cfg.get("max_position_embeddings", 1024),
         dropout_rate=cfg.get("dropout", 0.1),
+        # HF probs dropout (bart-large ships 0.0); rides the flash
+        # kernels' in-kernel mask stream when a checkpoint sets it
+        attn_dropout_rate=cfg.get("attention_dropout", 0.0),
         scale_embedding=cfg.get("scale_embedding", False),
         pad_token_id=cfg.get("pad_token_id", 1),
         bos_token_id=cfg.get("bos_token_id", 0),
@@ -218,6 +221,7 @@ def _llama_from_hf_config(cfg: dict) -> LlamaConfig:
         num_attention_heads=cfg["num_attention_heads"],
         num_key_value_heads=cfg.get("num_key_value_heads"),
         max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+        attn_dropout_rate=cfg.get("attention_dropout", 0.0),
         rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
         rope_theta=cfg.get("rope_theta", 10000.0),
         pad_token_id=cfg.get("pad_token_id") or 0,

@@ -34,6 +34,7 @@ from distributed_llms_example_tpu.ops.attention import (
     mask_to_bias,
 )
 from distributed_llms_example_tpu.ops.flash_attention import flash_attention
+from distributed_llms_example_tpu.ops.fused_dropout import Dropout
 from distributed_llms_example_tpu.ops.norms import RMSNorm
 from distributed_llms_example_tpu.utils.remat import remat_block
 from distributed_llms_example_tpu.parallel.activation import constrain_hidden, constrain_logits
@@ -51,6 +52,13 @@ class T5Config:
     relative_attention_num_buckets: int = 32
     relative_attention_max_distance: int = 128
     dropout_rate: float = 0.1
+    # attention-PROBS dropout.  HF T5 trains with this equal to
+    # dropout_rate; this port has historically run it at 0 (activations
+    # only) and keeps that default so trajectories stay comparable — set
+    # it explicitly to recover the HF recipe.  On the flash path the mask
+    # is drawn in-kernel (never materialized); the XLA path uses the
+    # bernoulli reference (ops/attention.py).
+    attn_dropout_rate: float = 0.0
     layer_norm_epsilon: float = 1e-6
     feed_forward_proj: str = "relu"  # or "gated-gelu"
     tie_word_embeddings: bool = True
@@ -163,6 +171,7 @@ class T5Attention(nn.Module):
         use_cache: bool = False,
         learned_bias: jnp.ndarray | None = None,
         cross_kv: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+        deterministic: bool = True,
     ) -> jnp.ndarray:
         """``bias``: constant (mask-like) additive bias.  ``learned_bias``:
         the (1, H, Q, K) relative-position bias, kept SEPARATE so the flash
@@ -170,7 +179,9 @@ class T5Attention(nn.Module):
         bias's gradient in its dbias kernel.  When the caller pre-combines
         everything into ``bias`` (cache decode, the pipeline adapter), the
         XLA path reproduces round-2 behavior exactly.  ``cross_kv``:
-        precomputed ``project_kv`` output — skips the k/v projections."""
+        precomputed ``project_kv`` output — skips the k/v projections.
+        ``deterministic`` gates ``config.attn_dropout_rate`` (probs
+        dropout; in-kernel mask on the flash path)."""
         q = self._split(self.q_proj(hidden))
         if cross_kv is not None:
             k, v = cross_kv
@@ -199,10 +210,14 @@ class T5Attention(nn.Module):
             step_bias = jnp.where(valid & causal, 0.0, NEG_INF)
             bias = step_bias if bias is None else bias + step_bias
             causal_in_bias = True
-        out = self._attend(q, k, v, bias, learned_bias, use_cache, causal_in_bias)
+        out = self._attend(
+            q, k, v, bias, learned_bias, use_cache, causal_in_bias,
+            deterministic,
+        )
         return self.o_proj(self._merge(out))
 
-    def _attend(self, q, k, v, bias, learned_bias, use_cache, causal_in_bias):
+    def _attend(self, q, k, v, bias, learned_bias, use_cache, causal_in_bias,
+                deterministic=True):
         """T5 attention is UNSCALED (scale=1.0).  Selection mirrors
         MultiHeadAttention: ring on sequence meshes (cross-attention /
         mask-only biases), Pallas flash on TPU where tileable, XLA
@@ -242,11 +257,26 @@ class T5Attention(nn.Module):
             has_learned_bias=learned_bias is not None,
         )
         _log_impl_once(f"t5:{impl}", reason)
+        probs_dropout = (
+            float(self.config.attn_dropout_rate) if not deterministic else 0.0
+        )
         if impl == "ring":
+            if probs_dropout > 0.0:
+                raise ValueError(
+                    "attn_dropout_rate > 0 is not supported on the ring "
+                    "attention path; use attention_impl 'flash'/'xla'"
+                )
             return ring_attention_sharded(
                 q, k, v, bias, mesh=mesh, causal=causal_here, scale=1.0, dtype=self.dtype
             )
         if impl == "flash":
+            seed = None
+            if probs_dropout > 0.0:
+                from distributed_llms_example_tpu.ops.fused_dropout import (
+                    seed_from_key,
+                )
+
+                seed = seed_from_key(self.make_rng("dropout"))
             if learned_bias is not None:
                 if mesh is not None and math.prod(mesh.devices.shape) > 1:
                     return flash_attention_lbias_sharded(
@@ -254,20 +284,27 @@ class T5Attention(nn.Module):
                         batch_axes=tuple(a for a in BATCH_AXES if a in mesh.shape),
                         head_axis="tensor" if "tensor" in mesh.shape else None,
                         causal=causal_here, scale=1.0, dtype=self.dtype,
+                        dropout_rate=probs_dropout, dropout_seed=seed,
                     )
                 return flash_attention(
                     q, k, v, bias, learned_bias=learned_bias,
                     causal=causal_here, scale=1.0, dtype=self.dtype,
+                    dropout_rate=probs_dropout, dropout_seed=seed,
                 )
             return flash_run(
-                q, k, v, bias, causal=causal_here, mesh=mesh, dtype=self.dtype, scale=1.0
+                q, k, v, bias, causal=causal_here, mesh=mesh, dtype=self.dtype,
+                scale=1.0, dropout_rate=probs_dropout, dropout_seed=seed,
             )
         if causal_here:
             step = make_causal_bias(q.shape[2], k.shape[2])
             bias = step if bias is None else bias + step
         if learned_bias is not None:
             bias = learned_bias if bias is None else bias + learned_bias
-        return dot_product_attention(q, k, v, bias, scale=1.0, dtype=self.dtype)
+        return dot_product_attention(
+            q, k, v, bias, scale=1.0, dtype=self.dtype,
+            dropout_rate=probs_dropout,
+            dropout_rng=self.make_rng("dropout") if probs_dropout > 0.0 else None,
+        )
 
 
 class T5MLP(nn.Module):
@@ -283,7 +320,7 @@ class T5MLP(nn.Module):
             h = nn.gelu(gate, approximate=True) * lin
         else:
             h = nn.relu(nn.Dense(cfg.d_ff, use_bias=False, dtype=self.dtype, name="wi")(x))
-        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        h = Dropout(cfg.dropout_rate)(h, deterministic)
         return nn.Dense(cfg.d_model, use_bias=False, dtype=self.dtype, name="wo")(h)
 
 
@@ -303,7 +340,7 @@ class T5Block(nn.Module):
             self.cross_attn = T5Attention(cfg, causal=False, dtype=self.dtype, name="cross_attn")
         self.mlp_norm = RMSNorm(epsilon=eps, dtype=self.dtype, name="mlp_norm")
         self.mlp = T5MLP(cfg, dtype=self.dtype, name="mlp")
-        self.dropout = nn.Dropout(cfg.dropout_rate)
+        self.dropout = Dropout(cfg.dropout_rate)
 
     def __call__(
         self,
@@ -322,17 +359,18 @@ class T5Block(nn.Module):
         # self_bias so the flash kernel can compute its gradient
         h = self.self_attn(
             self.self_attn_norm(hidden), bias=self_bias, use_cache=use_cache,
-            learned_bias=pos_bias,
+            learned_bias=pos_bias, deterministic=deterministic,
         )
-        hidden = hidden + self.dropout(h, deterministic=deterministic)
+        # residual rides the dropout kernel (one fused pass on TPU)
+        hidden = self.dropout(h, deterministic, residual=hidden)
         if self.has_cross:
             h = self.cross_attn(
                 self.cross_attn_norm(hidden), kv_hidden=encoder_hidden,
-                bias=cross_bias, cross_kv=cross_kv,
+                bias=cross_bias, cross_kv=cross_kv, deterministic=deterministic,
             )
-            hidden = hidden + self.dropout(h, deterministic=deterministic)
+            hidden = self.dropout(h, deterministic, residual=hidden)
         h = self.mlp(self.mlp_norm(hidden), deterministic=deterministic)
-        return hidden + self.dropout(h, deterministic=deterministic)
+        return self.dropout(h, deterministic, residual=hidden)
 
 
 class T5Stack(nn.Module):
@@ -359,7 +397,7 @@ class T5Stack(nn.Module):
             for i in range(n)
         ]
         self.final_norm = RMSNorm(epsilon=cfg.layer_norm_epsilon, dtype=self.dtype, name="final_norm")
-        self.dropout = nn.Dropout(cfg.dropout_rate)
+        self.dropout = Dropout(cfg.dropout_rate)
 
     def position_bias(self, q_len: int, kv_len: int, offset: int | jnp.ndarray = 0) -> jnp.ndarray:
         """(1, heads, q_len, kv_len) additive relative-position bias."""
@@ -623,7 +661,12 @@ class PipelinedT5:
             # dropout) + (tied-scaled) logits projection
             h = self._norm.apply({"params": pp["final_norm"]}, y["dec"])
             if key is not None:
-                h = self._dropout(h, jax.random.fold_in(key, 555))
+                # post_loss runs INSIDE the pipeline shard_map: clear the
+                # ambient mesh (like the block fns) so the shared dropout
+                # helper takes its no-mesh XLA path instead of nesting a
+                # shard_map in the manual region
+                with activation_mesh(None):
+                    h = self._dropout(h, jax.random.fold_in(key, 555))
             if cfg.tie_word_embeddings:
                 h = h * (cfg.d_model**-0.5)
                 logits = h @ pp["shared"]["embedding"].astype(self.dtype).T
@@ -633,9 +676,12 @@ class PipelinedT5:
 
         def seam(sp, h, key):
             # encoder tail between the pipelines: final_norm + dropout
+            # (runs inside the pipeline shard_map — same ambient-mesh
+            # reset as post_loss/the block fns)
             h = self._norm.apply({"params": sp["final_norm"]}, h)
             if key is not None:
-                h = self._dropout(h, key)
+                with activation_mesh(None):
+                    h = self._dropout(h, key)
             return h
 
         def enc_fn(lp, h, ex, key=None):

@@ -28,12 +28,20 @@ def dot_product_attention(
     *,
     scale: float | None = None,
     dtype: jnp.dtype | None = None,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
 ) -> jnp.ndarray:
     """Plain softmax attention.
 
     ``scale=None`` means 1/sqrt(head_dim); pass ``scale=1.0`` for T5, which
     folds the scale into initialization and does NOT scale scores.
     Softmax runs in float32 regardless of compute dtype.
+
+    ``dropout_rate`` > 0 (with a ``dropout_rng`` key) applies inverted
+    dropout to the attention probs — the XLA reference semantics for the
+    flash kernel's in-kernel probs dropout.  This path DOES materialize
+    the (B, H, Q, K) mask (that is exactly the cost the fused kernel
+    removes); it exists for parity and for shapes the kernel rejects.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -44,6 +52,12 @@ def dot_product_attention(
         scores = scores + bias.astype(jnp.float32)
     probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        import jax
+
+        keep_prob = 1.0 - dropout_rate
+        keep = jax.random.bernoulli(dropout_rng, keep_prob, probs.shape)
+        probs = jnp.where(keep, probs / keep_prob, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
 
 

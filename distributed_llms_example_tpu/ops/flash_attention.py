@@ -50,9 +50,35 @@ from jax.experimental.pallas import tpu as pltpu
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 from distributed_llms_example_tpu.parallel.activation import compat_shard_map
+from distributed_llms_example_tpu.ops.fused_dropout import tile_keep
 
 LANES = 128  # TPU vector lane count: last-dim unit for scratch/statistics
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+# --------------------------------------------------------- probs dropout
+#
+# Attention-probs dropout rides INSIDE the kernels: the keep-mask for a
+# (block_q, block_k) tile is drawn in-kernel from (seed, b, h, tile
+# offsets) via ops.fused_dropout.tile_keep (TPU hardware PRNG compiled,
+# counter hash in interpret mode), so the (B, H, S, S) mask never exists
+# in HBM and the backward kernels recompute the identical mask from the
+# same seed instead of saving it.  Math: with p-tilde the unnormalized
+# softmax numerator and l its row sum, the forward accumulates
+# pv from m·p-tilde/keep while l stays un-dropped — o = acc/l is then
+# exactly dropout(softmax(s)) @ v.  Backward: with dp = do·vT,
+# ds = p · (m·dp/keep − delta) and dv sums (m·p/keep)T·do, where
+# delta = rowsum(do∘o) already equals Σ_j pd_j dp_j.
+#
+# Dropout seeding is per-(b, h, q-tile, k-tile), so forward and all three
+# backward kernels agree as long as they tile identically — they share
+# block_q/block_k by construction.
+
+
+def _tile_dropout_keep(seed_ref, b, h, qi, ki, shape, *, rate: float,
+                       block_q: int, block_k: int, hw_rng: bool):
+    return tile_keep(
+        seed_ref[0], b, h, qi * block_q, ki * block_k, shape, rate, hw_rng
+    )
 
 
 def _default_interpret() -> bool:
@@ -92,13 +118,18 @@ def _causal_mask(s, qi, ki, block_q: int, block_k: int):
 
 def _fwd_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
-    has_bias: bool, has_lbias: bool,
+    has_bias: bool, has_lbias: bool, dropout_rate: float = 0.0,
+    hw_rng: bool = False,
 ):
     it = iter(refs)
+    seed_ref = next(it) if dropout_rate > 0.0 else None
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     bias_ref = next(it) if has_bias else None
     lbias_ref = next(it) if has_lbias else None
     o_ref, lse_ref, m_scr, l_scr, acc_scr = it
+    # grid ids at kernel TOP LEVEL: the interpret-mode lowering only
+    # rewrites program_id in the outer kernel jaxpr, not inside pl.when
+    bi, hi = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -139,6 +170,15 @@ def _fwd_kernel(
         l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[:] = jax.lax.broadcast_in_dim(m_next[:, 0], m_scr.shape, (0,))
         l_scr[:] = jax.lax.broadcast_in_dim(l_next[:, 0], l_scr.shape, (0,))
+        if seed_ref is not None:
+            # drop AFTER l accumulates: l normalizes the un-dropped
+            # softmax, the dropped numerator rides only the value product
+            keep = _tile_dropout_keep(
+                seed_ref, bi, hi, qi, ki,
+                p.shape, rate=dropout_rate, block_q=block_q,
+                block_k=block_k, hw_rng=hw_rng,
+            )
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -154,7 +194,16 @@ def _fwd_kernel(
         lse_ref[0, 0] = jnp.where(l_scr[:] == 0.0, MASK_VALUE, lse)
 
 
-def _fwd(q, k, v, bias, lbias, *, scale, causal, block_q, block_k, interpret):
+def _seed_arg(dropout_seed):
+    """(args, specs) prefix carrying the dropout seed into a kernel."""
+    if dropout_seed is None:
+        return [], []
+    seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    return [seed], [pl.BlockSpec(memory_space=pltpu.SMEM)]
+
+
+def _fwd(q, k, v, bias, lbias, *, scale, causal, block_q, block_k, interpret,
+         dropout_rate=0.0, dropout_seed=None, hw_rng=False):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     nq, nk = q_len // block_q, kv_len // block_k
@@ -166,7 +215,8 @@ def _fwd(q, k, v, bias, lbias, *, scale, causal, block_q, block_k, interpret):
     def kv_map(b, h, qi, ki):
         return (b, h, ki, 0)
 
-    in_specs = [
+    seed_args, in_specs = _seed_arg(dropout_seed if dropout_rate > 0.0 else None)
+    in_specs += [
         pl.BlockSpec((1, 1, block_q, d), q_map),
         pl.BlockSpec((1, 1, block_k, d), kv_map),
         pl.BlockSpec((1, 1, block_k, d), kv_map),
@@ -187,6 +237,7 @@ def _fwd(q, k, v, bias, lbias, *, scale, causal, block_q, block_k, interpret):
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk,
         has_bias=bias is not None, has_lbias=lbias is not None,
+        dropout_rate=dropout_rate, hw_rng=hw_rng,
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -203,7 +254,7 @@ def _fwd(q, k, v, bias, lbias, *, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(*[x for x in (q, k, v, bias, lbias) if x is not None])
+    )(*seed_args, *[x for x in (q, k, v, bias, lbias) if x is not None])
     return o, lse
 
 
@@ -212,13 +263,16 @@ def _fwd(q, k, v, bias, lbias, *, scale, causal, block_q, block_k, interpret):
 
 def _bwd_dq_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
-    has_bias: bool, has_lbias: bool,
+    has_bias: bool, has_lbias: bool, dropout_rate: float = 0.0,
+    hw_rng: bool = False,
 ):
     it = iter(refs)
+    seed_ref = next(it) if dropout_rate > 0.0 else None
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     bias_ref = next(it) if has_bias else None
     lbias_ref = next(it) if has_lbias else None
     do_ref, lse_ref, delta_ref, dq_ref, dq_scr = it
+    bi, hi = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -251,6 +305,15 @@ def _bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if seed_ref is not None:
+            # recompute the forward's keep-mask from the seed: the dropped
+            # entries' dp never reaches ds (d(dropout)/d(p) = m/keep)
+            keep = _tile_dropout_keep(
+                seed_ref, bi, hi, qi, ki,
+                p.shape, rate=dropout_rate, block_q=block_q,
+                block_k=block_k, hw_rng=hw_rng,
+            )
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
@@ -264,13 +327,16 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int, nq: int,
-    has_bias: bool, has_lbias: bool,
+    has_bias: bool, has_lbias: bool, dropout_rate: float = 0.0,
+    hw_rng: bool = False,
 ):
     it = iter(refs)
+    seed_ref = next(it) if dropout_rate > 0.0 else None
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     bias_ref = next(it) if has_bias else None
     lbias_ref = next(it) if has_lbias else None
     do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = it
+    bi, hi = pl.program_id(0), pl.program_id(1)
     ki, qi = pl.program_id(2), pl.program_id(3)
 
     @pl.when(qi == 0)
@@ -298,13 +364,26 @@ def _bwd_dkv_kernel(
         p = jnp.exp(s - lse)
         # zero fully-masked rows (lse == MASK_VALUE sentinel) — see dq kernel
         p = jnp.where(lse <= MASK_VALUE / 2, 0.0, p)
+        keep = None
+        if seed_ref is not None:
+            keep = _tile_dropout_keep(
+                seed_ref, bi, hi, qi, ki,
+                p.shape, rate=dropout_rate, block_q=block_q,
+                block_k=block_k, hw_rng=hw_rng,
+            )
+        # dv sums the DROPPED probs (only kept entries fed the forward pv)
+        pd = p if keep is None else jnp.where(
+            keep, p * (1.0 / (1.0 - dropout_rate)), 0.0
+        )
         dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if keep is not None:
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -319,7 +398,7 @@ def _bwd_dkv_kernel(
 
 def _bwd_dlbias_kernel(
     *refs, scale: float, causal: bool, block_q: int, block_k: int, nb: int,
-    has_bias: bool,
+    has_bias: bool, dropout_rate: float = 0.0, hw_rng: bool = False,
 ):
     """Gradient of the LEARNED (1, H, Q, K) bias: dbias = Σ_batch p·(dp−δ).
 
@@ -329,9 +408,11 @@ def _bwd_dlbias_kernel(
     never exists in HBM.  Recomputes s/p per tile from the residuals (same
     trade the dq/dkv kernels make)."""
     it = iter(refs)
+    seed_ref = next(it) if dropout_rate > 0.0 else None
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     bias_ref = next(it) if has_bias else None
     lbias_ref, do_ref, lse_ref, delta_ref, dlb_ref, dlb_scr = it
+    hi = pl.program_id(0)
     qi, ki, bi = pl.program_id(1), pl.program_id(2), pl.program_id(3)
 
     @pl.when(bi == 0)
@@ -362,6 +443,14 @@ def _bwd_dlbias_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if seed_ref is not None:
+            # grid here is (heads, q, k, batch): tags stay (b, h)
+            keep = _tile_dropout_keep(
+                seed_ref, bi, hi, qi, ki,
+                p.shape, rate=dropout_rate, block_q=block_q,
+                block_k=block_k, hw_rng=hw_rng,
+            )
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         # ∂s/∂lbias = 1 (no scale factor — scale multiplies only q·k)
         dlb_scr[:] += p * (dp - delta_ref[0, 0][:, :1])
 
@@ -371,7 +460,8 @@ def _bwd_dlbias_kernel(
 
 
 def _bwd_dlbias(q, k, v, bias, lbias, lse, delta, do, *, scale, causal,
-                block_q, block_k, interpret):
+                block_q, block_k, interpret,
+                dropout_rate=0.0, dropout_seed=None, hw_rng=False):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     nq, nk = q_len // block_q, kv_len // block_k
@@ -394,7 +484,8 @@ def _bwd_dlbias(q, k, v, bias, lbias, lse, delta, do, *, scale, causal,
             return inner.index_map(b, h, qi, ki)
 
         bias_spec = pl.BlockSpec(inner.block_shape, reordered)
-    in_specs = [
+    seed_args, in_specs = _seed_arg(dropout_seed if dropout_rate > 0.0 else None)
+    in_specs += [
         spec
         for spec in (
             pl.BlockSpec((1, 1, block_q, d), q_map),
@@ -408,11 +499,14 @@ def _bwd_dlbias(q, k, v, bias, lbias, lse, delta, do, *, scale, causal,
         )
         if spec is not None
     ]
-    args = [x for x in (q, k, v, bias, lbias, do, lse, delta) if x is not None]
+    args = seed_args + [
+        x for x in (q, k, v, bias, lbias, do, lse, delta) if x is not None
+    ]
     return pl.pallas_call(
         functools.partial(
             _bwd_dlbias_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, nb=batch, has_bias=bias is not None,
+            dropout_rate=dropout_rate, hw_rng=hw_rng,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -426,7 +520,8 @@ def _bwd_dlbias(q, k, v, bias, lbias, lse, delta, do, *, scale, causal,
     )(*args)
 
 
-def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, interpret):
+def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k,
+         interpret, dropout_rate=0.0, dropout_seed=None, hw_rng=False):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     nq, nk = q_len // block_q, kv_len // block_k
@@ -445,7 +540,8 @@ def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, i
 
     bias_spec = _bias_spec(bias.shape, block_q, block_k) if bias is not None else None
     lbias_spec = _bias_spec(lbias.shape, block_q, block_k) if lbias is not None else None
-    common_in = [
+    seed_args, seed_specs = _seed_arg(dropout_seed if dropout_rate > 0.0 else None)
+    common_in = seed_specs + [
         spec
         for spec in (
             pl.BlockSpec((1, 1, block_q, d), q_map),
@@ -459,13 +555,16 @@ def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, i
         )
         if spec is not None
     ]
-    args = [x for x in (q, k, v, bias, lbias, do, lse, delta) if x is not None]
+    args = seed_args + [
+        x for x in (q, k, v, bias, lbias, do, lse, delta) if x is not None
+    ]
 
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, nk=nk,
             has_bias=bias is not None, has_lbias=lbias is not None,
+            dropout_rate=dropout_rate, hw_rng=hw_rng,
         ),
         grid=(batch, heads, nq, nk),
         in_specs=common_in,
@@ -495,7 +594,7 @@ def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, i
 
         return pl.BlockSpec(inner.block_shape, swapped)
 
-    dkv_in = [
+    dkv_in = seed_specs + [
         spec
         for spec in (
             pl.BlockSpec((1, 1, block_q, d), q_map_kv),
@@ -514,6 +613,7 @@ def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, i
             _bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, nq=nq,
             has_bias=bias is not None, has_lbias=lbias is not None,
+            dropout_rate=dropout_rate, hw_rng=hw_rng,
         ),
         grid=(batch, heads, nk, nq),
         in_specs=dkv_in,
@@ -539,7 +639,8 @@ def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, i
         dlbias = _bwd_dlbias(
             q, k, v, bias, lbias, lse, delta, do,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
+            interpret=interpret, dropout_rate=dropout_rate,
+            dropout_seed=dropout_seed, hw_rng=hw_rng,
         )
     return dq, dk, dv, dlbias
 
@@ -548,37 +649,45 @@ def _bwd(q, k, v, bias, lbias, o, lse, do, *, scale, causal, block_q, block_k, i
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12)
 )
-def _flash(q, k, v, bias, lbias, scale, causal, block_q, block_k, interpret):
+def _flash(q, k, v, bias, lbias, dropout_seed,
+           scale, causal, block_q, block_k, interpret, dropout_rate, hw_rng):
     o, _ = _fwd(
         q, k, v, bias, lbias, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed, hw_rng=hw_rng,
     )
     return o
 
 
-def _flash_fwd(q, k, v, bias, lbias, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, lbias, dropout_seed,
+               scale, causal, block_q, block_k, interpret, dropout_rate, hw_rng):
     o, lse = _fwd(
         q, k, v, bias, lbias, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed, hw_rng=hw_rng,
     )
     # the kernel replicates lse across all 128 lanes — keep one lane as the
-    # residual so HBM between fwd and bwd holds (B,H,S,1), not (B,H,S,128)
-    return o, (q, k, v, bias, lbias, o, lse[..., :1])
+    # residual so HBM between fwd and bwd holds (B,H,S,1), not (B,H,S,128).
+    # The dropout mask is NOT a residual: the backward kernels redraw it
+    # from the seed — zero extra bytes for probs dropout.
+    return o, (q, k, v, bias, lbias, dropout_seed, o, lse[..., :1])
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, bias, lbias, o, lse_lane = res
+def _flash_bwd(scale, causal, block_q, block_k, interpret, dropout_rate,
+               hw_rng, res, do):
+    q, k, v, bias, lbias, dropout_seed, o, lse_lane = res
     lse = jax.lax.broadcast_in_dim(
         lse_lane[..., 0], (*lse_lane.shape[:-1], LANES), (0, 1, 2)
     )
     dq, dk, dv, dlbias = _bwd(
         q, k, v, bias, lbias, o, lse, do, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed, hw_rng=hw_rng,
     )
     dbias = None if bias is None else jnp.zeros_like(bias)  # bias is a mask
-    return dq, dk, dv, dbias, dlbias
+    return dq, dk, dv, dbias, dlbias, None  # seed: int, no cotangent
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -655,6 +764,9 @@ def flash_attention(
     block_k: int | None = None,
     interpret: bool | None = None,
     dtype: jnp.dtype | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed: jax.Array | None = None,
+    hw_rng: bool | None = None,
 ) -> jnp.ndarray:
     """Blockwise-softmax attention; drop-in for ``dot_product_attention``.
 
@@ -680,6 +792,13 @@ def flash_attention(
       aligned (q_pos >= k_pos with no kv offset), which is only meaningful
       for square self-attention; decode-style bottom-right alignment with
       cached keys is the KV-cache path's job, not this kernel's.
+    - ``dropout_rate`` > 0 applies attention-PROBS dropout inside the
+      kernel: the keep-mask is drawn in-kernel from ``dropout_seed`` (an
+      int32 scalar, e.g. ``ops.fused_dropout.seed_from_key``) — the
+      (B, H, Q, K) mask never materializes in HBM and the backward
+      recomputes it from the same seed instead of saving it.  ``hw_rng``
+      picks the TPU hardware PRNG (default on compiled TPU) vs the
+      portable counter hash (interpret mode / tests).
     """
     if causal and q.shape[2] != k.shape[2]:
         raise ValueError(
@@ -719,8 +838,20 @@ def flash_attention(
             )
     if interpret is None:
         interpret = _default_interpret()
-    out = _flash(q, k, v, bias, learned_bias, float(scale), bool(causal),
-                 int(block_q), int(block_k), bool(interpret))
+    if hw_rng is None:
+        hw_rng = not interpret
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0:
+        if not dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires a dropout_seed scalar")
+        dropout_seed = jnp.asarray(dropout_seed, jnp.int32).reshape(())
+    else:
+        dropout_seed = None
+    out = _flash(q, k, v, bias, learned_bias, dropout_seed,
+                 float(scale), bool(causal), int(block_q), int(block_k),
+                 bool(interpret), dropout_rate, bool(hw_rng))
     return out if dtype is None else out.astype(dtype)
 
 
@@ -763,6 +894,8 @@ def make_flash_lbias_sharded(
     interpret: bool,
     has_bias: bool,
     out_dtype,
+    dropout_rate: float = 0.0,
+    hw_rng: bool = False,
 ):
     """Multi-device flash attention WITH a differentiable (1, H, Q, K)
     learned bias: per-shard Pallas kernels under ``shard_map`` (batch over
@@ -774,11 +907,20 @@ def make_flash_lbias_sharded(
     reduction is explicit, so T5's relative-position bias trains correctly
     on any mesh, not just a single chip.
 
-    Returns ``f(q, k, v[, bias], lbias) -> o``.  ``bias`` (present iff
-    ``has_bias``) is a constant (b|1, 1, 1, K) mask; ``lbias`` is heads-
-    sharded over ``head_axis`` and replicated across the batch shards.
+    Returns ``f(q, k, v[, bias], lbias[, seed]) -> o``.  ``bias`` (present
+    iff ``has_bias``) is a constant (b|1, 1, 1, K) mask; ``lbias`` is
+    heads-sharded over ``head_axis`` and replicated across the batch
+    shards.  ``seed`` (present iff ``dropout_rate > 0``) is the replicated
+    int32 probs-dropout seed — each shard folds its axis indices in, so
+    batch/head shards draw independent masks, and the per-shard backward
+    redraws the identical mask from the same folded seed.
     """
     from jax.sharding import PartitionSpec as P
+
+    from distributed_llms_example_tpu.ops.fused_dropout import _shard_seed
+
+    has_dropout = dropout_rate > 0.0
+    fold_axes = batch_axes + ((head_axis,) if head_axis else ())
 
     qkv_spec = P(batch_axes or None, head_axis, None, None)
     lb_spec = P(None, head_axis, None, None)
@@ -794,24 +936,35 @@ def make_flash_lbias_sharded(
               interpret=interpret)
 
     def split(args):
-        """(q, k, v[, bias], lbias) → (q, k, v, bias|None, lbias)."""
+        """(q, k, v[, bias], lbias[, seed]) → (q, k, v, bias|None, lbias,
+        seed|None)."""
+        args, seed = (args[:-1], args[-1]) if has_dropout else (args, None)
         if has_bias:
             q, k, v, bias, lbias = args
         else:
             (q, k, v, lbias), bias = args, None
-        return q, k, v, bias, lbias
+        return q, k, v, bias, lbias, seed
+
+    def drop_kw(seed):
+        if seed is None:
+            return {}
+        return dict(
+            dropout_rate=dropout_rate, hw_rng=hw_rng,
+            dropout_seed=_shard_seed(seed, fold_axes) if fold_axes else seed,
+        )
 
     def fwd_in_specs(bias):
         return tuple(
             s for s in (
                 qkv_spec, qkv_spec, qkv_spec,
                 mask_spec(bias) if has_bias else None, lb_spec,
+                P() if has_dropout else None,
             ) if s is not None
         )
 
     def fwd_shard(*sargs):
-        sq, sk, sv, sbias, slb = split(sargs)
-        o, lse = _fwd(sq, sk, sv, sbias, slb, **kw)
+        sq, sk, sv, sbias, slb, sseed = split(sargs)
+        o, lse = _fwd(sq, sk, sv, sbias, slb, **kw, **drop_kw(sseed))
         return o, lse[..., :1]
 
     def run_fwd(args, bias):
@@ -822,18 +975,19 @@ def make_flash_lbias_sharded(
 
     @jax.custom_vjp
     def f(*args):
-        _, _, _, bias, _ = split(args)
+        _, _, _, bias, _, _ = split(args)
         return run_fwd(args, bias)[0]
 
     def f_fwd(*args):
-        q, k, v, bias, lbias = split(args)
+        q, k, v, bias, lbias, seed = split(args)
         o, lse1 = run_fwd(args, bias)
-        return o, (q, k, v, bias, lbias, o, lse1)
+        return o, (q, k, v, bias, lbias, seed, o, lse1)
 
     def f_bwd(res, do):
-        q, k, v, bias, lbias, o, lse1 = res
+        q, k, v, bias, lbias, seed, o, lse1 = res
 
         def bwd_shard(*sargs):
+            sargs, sseed = (sargs[:-1], sargs[-1]) if has_dropout else (sargs, None)
             if has_bias:
                 sq, sk, sv, sbias, slb, so, slse1, sdo = sargs
             else:
@@ -841,22 +995,35 @@ def make_flash_lbias_sharded(
             lse = jax.lax.broadcast_in_dim(
                 slse1[..., 0], (*slse1.shape[:-1], LANES), (0, 1, 2)
             )
-            dq, dk, dv, dlb = _bwd(sq, sk, sv, sbias, slb, so, lse, sdo, **kw)
+            dq, dk, dv, dlb = _bwd(
+                sq, sk, sv, sbias, slb, so, lse, sdo, **kw, **drop_kw(sseed)
+            )
             # each batch shard computed dbias for ITS rows only: the
             # explicit cross-shard reduction autodiff can't insert here
             if batch_axes:
                 dlb = jax.lax.psum(dlb, batch_axes)
             return dq, dk, dv, dlb
 
-        in_specs = (*fwd_in_specs(bias), qkv_spec, lse_spec, qkv_spec)
-        args = tuple(x for x in (q, k, v, bias, lbias, o, lse1, do) if x is not None)
+        base = fwd_in_specs(bias)
+        if has_dropout:
+            base = base[:-1]  # seed spec moves to the end (matches args)
+        in_specs = (*base, qkv_spec, lse_spec, qkv_spec) + (
+            (P(),) if has_dropout else ()
+        )
+        args = tuple(
+            x for x in (q, k, v, bias, lbias, o, lse1, do) if x is not None
+        ) + ((seed,) if has_dropout else ())
         dq, dk, dv, dlb = compat_shard_map(
             bwd_shard, mesh=mesh, in_specs=in_specs,
             out_specs=(qkv_spec, qkv_spec, qkv_spec, lb_spec), check_vma=False,
         )(*args)
+        out = (dq, dk, dv)
         if has_bias:
-            return dq, dk, dv, jnp.zeros_like(bias), dlb
-        return dq, dk, dv, dlb
+            out = (*out, jnp.zeros_like(bias))
+        out = (*out, dlb)
+        if has_dropout:
+            out = (*out, None)  # seed: int, no cotangent
+        return out
 
     f.defvjp(f_fwd, f_bwd)
     return lambda *args: f(*args).astype(out_dtype)
@@ -868,6 +1035,7 @@ def flash_attention_lbias_sharded(
     causal: bool = False, scale: float | None = None,
     block_q: int | None = None, block_k: int | None = None,
     interpret: bool | None = None, dtype=None,
+    dropout_rate: float = 0.0, dropout_seed=None, hw_rng: bool | None = None,
 ):
     """Front door for the multi-device learned-bias path (see
     ``make_flash_lbias_sharded``).  Same shape/validation contract as
@@ -910,11 +1078,22 @@ def flash_attention_lbias_sharded(
         raise ValueError(f"learned_bias shape {tuple(learned_bias.shape)} != {want}")
     if interpret is None:
         interpret = _default_interpret()
+    if hw_rng is None:
+        hw_rng = not interpret
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0:
+        if not dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires a dropout_seed scalar")
     f = make_flash_lbias_sharded(
         mesh, batch_axes=batch_axes, head_axis=head_axis, causal=bool(causal),
         scale=float(scale), block_q=int(block_q), block_k=int(block_k),
         interpret=bool(interpret), has_bias=bias is not None,
         out_dtype=dtype or q.dtype,
+        dropout_rate=dropout_rate, hw_rng=bool(hw_rng),
     )
     args = (q, k, v, bias, learned_bias) if bias is not None else (q, k, v, learned_bias)
+    if dropout_rate > 0.0:
+        args = (*args, jnp.asarray(dropout_seed, jnp.int32).reshape(()))
     return f(*args)

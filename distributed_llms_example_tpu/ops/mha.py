@@ -202,6 +202,12 @@ class MultiHeadAttention(nn.Module):
     # this module (natively by the flash/ring kernels), so callers pass
     # only padding/cross-attention biases.
     attention_impl: str = "auto"
+    # attention-PROBS dropout (HF ``attention_dropout``); active only with
+    # ``deterministic=False`` and a "dropout" rng.  On the flash path the
+    # keep-mask is drawn in-kernel from a folded seed — the (B, H, S, S)
+    # mask never materializes in HBM (ops/flash_attention.py); the XLA
+    # path applies the reference bernoulli mask to the probs.
+    probs_dropout_rate: float = 0.0
 
     @property
     def kv_heads(self) -> int:
@@ -257,12 +263,15 @@ class MultiHeadAttention(nn.Module):
         use_cache: bool = False,
         positions: jnp.ndarray | None = None,
         cross_kv: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+        deterministic: bool = True,
     ) -> jnp.ndarray:
         """``positions``: optional (batch, q_len) absolute positions for RoPE
         — needed when cache slots don't equal sequence positions (right-
         padded prompts).  Defaults to cache-index/arange positions.
         ``cross_kv``: precomputed ``project_kv`` output — skips the k/v
-        projections entirely (cross-attention decode)."""
+        projections entirely (cross-attention decode).  ``deterministic``
+        gates ``probs_dropout_rate`` (training passes False + a "dropout"
+        rng, like every other dropout)."""
         q = self._split(self.q_proj(hidden), self.num_heads)
         if cross_kv is not None:
             k, v = cross_kv
@@ -394,17 +403,43 @@ class MultiHeadAttention(nn.Module):
             bias_kv_only=None if bias is None else (bias.shape[1] == 1 and bias.shape[2] == 1),
         )
         _log_impl_once(impl, reason)
+        probs_dropout = (
+            float(self.probs_dropout_rate) if not deterministic else 0.0
+        )
         if impl == "ring":
+            if probs_dropout > 0.0:
+                raise ValueError(
+                    "probs_dropout_rate > 0 is not supported on the ring "
+                    "attention path (the rotating kv blocks would need a "
+                    "ring-aware mask stream); train with attention_impl "
+                    "'flash'/'xla' or probs dropout off"
+                )
             out = ring_attention_sharded(
                 q, k, v, bias, mesh=mesh, causal=causal_here, dtype=self.dtype
             )
         elif impl == "flash":
-            out = self._flash_run(q, k, v, bias, causal_here, mesh)
+            seed = None
+            if probs_dropout > 0.0:
+                from distributed_llms_example_tpu.ops.fused_dropout import (
+                    seed_from_key,
+                )
+
+                seed = seed_from_key(self.make_rng("dropout"))
+            out = self._flash_run(
+                q, k, v, bias, causal_here, mesh,
+                dropout_rate=probs_dropout, dropout_seed=seed,
+            )
         else:
             if causal_here:
                 step = make_causal_bias(q.shape[2], k.shape[2])
                 bias = step if bias is None else bias + step
-            out = dot_product_attention(q, k, v, bias, dtype=self.dtype)
+            out = dot_product_attention(
+                q, k, v, bias, dtype=self.dtype,
+                dropout_rate=probs_dropout,
+                dropout_rng=(
+                    self.make_rng("dropout") if probs_dropout > 0.0 else None
+                ),
+            )
         b, h, s, d = out.shape
         return self.o_proj(out.transpose(0, 2, 1, 3).reshape(b, s, h * d))
 
@@ -416,8 +451,13 @@ class MultiHeadAttention(nn.Module):
         bias: jnp.ndarray | None,
         causal: bool,
         mesh: Mesh | None,
+        dropout_rate: float = 0.0,
+        dropout_seed=None,
     ) -> jnp.ndarray:
-        return flash_run(q, k, v, bias, causal=causal, mesh=mesh, dtype=self.dtype)
+        return flash_run(
+            q, k, v, bias, causal=causal, mesh=mesh, dtype=self.dtype,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        )
 
 
 def flash_run(
@@ -430,6 +470,8 @@ def flash_run(
     mesh: Mesh | None,
     dtype: jnp.dtype,
     scale: float | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ) -> jnp.ndarray:
     """Run the Pallas kernel — directly on one device, per-shard under
     ``shard_map`` on a mesh (batch over data×fsdp×expert, heads over
@@ -438,16 +480,32 @@ def flash_run(
     runs with check_vma=False, under which a learned bias's gradient would
     silently miss its cross-shard psum — learned biases use
     ops/flash_attention.flash_attention_lbias_sharded, whose hand-written
-    vjp performs that psum explicitly."""
+    vjp performs that psum explicitly.
+
+    ``dropout_rate`` > 0 (with an int32 ``dropout_seed``) turns on the
+    in-kernel attention-probs dropout; each shard folds its axis indices
+    into the seed so shards draw independent masks."""
     if mesh is None or math.prod(mesh.devices.shape) == 1:
-        return flash_attention(q, k, v, bias, causal=causal, dtype=dtype, scale=scale)
+        return flash_attention(
+            q, k, v, bias, causal=causal, dtype=dtype, scale=scale,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        )
     batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
     head_axis = "tensor" if "tensor" in mesh.shape else None
     qkv_spec = P(batch_axes or None, head_axis, None, None)
+    has_dropout = dropout_rate > 0.0 and dropout_seed is not None
+    fold_axes = batch_axes + ((head_axis,) if head_axis else ())
 
     def run(q, k, v, *rest):
+        rest = list(rest)
+        seed = rest.pop() if has_dropout else None
+        if seed is not None and fold_axes:
+            from distributed_llms_example_tpu.ops.fused_dropout import _shard_seed
+
+            seed = _shard_seed(seed, fold_axes)
         return flash_attention(
-            q, k, v, rest[0] if rest else None, causal=causal, dtype=dtype, scale=scale
+            q, k, v, rest[0] if rest else None, causal=causal, dtype=dtype,
+            scale=scale, dropout_rate=dropout_rate, dropout_seed=seed,
         )
 
     args = (q, k, v)
@@ -461,6 +519,9 @@ def flash_run(
         )
         args = (*args, bias)
         in_specs = (*in_specs, bias_spec)
+    if has_dropout:
+        args = (*args, jnp.asarray(dropout_seed, jnp.int32).reshape(()))
+        in_specs = (*in_specs, P())
     return compat_shard_map(
         run, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
     )(*args)

@@ -247,10 +247,15 @@ def _seq_specs(seq_axis: str, hidden_ndim: int, *dim_trees) -> tuple:
 def dropout(x: jnp.ndarray, key: jnp.ndarray, rate: float) -> jnp.ndarray:
     """Inverted dropout for the pipeline adapters' out-of-loop layers
     (embeddings, final norms) — in-loop dropout goes through each block's
-    own flax ``nn.Dropout`` with a per-layer folded key."""
-    keep = 1.0 - rate
-    mask = jax.random.bernoulli(key, keep, x.shape)
-    return jnp.where(mask, x / keep, jnp.zeros_like(x)).astype(x.dtype)
+    own ``ops.fused_dropout.Dropout`` with a per-layer folded key.  Routed
+    through the shared helper so the fused Pallas path applies here too
+    (these calls run OUTSIDE the pipeline's manual region, under plain
+    GSPMD, where the helper's shard_map dispatch is legal)."""
+    from distributed_llms_example_tpu.ops.fused_dropout import (
+        dropout as shared_dropout,
+    )
+
+    return shared_dropout(x, key, rate).astype(x.dtype)
 
 
 def _vary(tree, axes):

@@ -275,7 +275,22 @@ class Trainer:
             ),
         )
 
-        self.use_dropout = self.config.dropout_rate > 0.0
+        # attn_dropout_rate alone (e.g. an HF checkpoint with
+        # attention_dropout > 0 but dropout 0, or a llama recipe enabling
+        # probs dropout on the dropout-free architecture) must also thread
+        # the rng — otherwise the configured dropout silently never fires
+        self.use_dropout = (
+            self.config.dropout_rate > 0.0
+            or float(getattr(self.config, "attn_dropout_rate", 0.0) or 0.0) > 0.0
+        )
+        # dropout path (--dropout-impl): the process default the shared
+        # helper (ops/fused_dropout.py) reads at trace time — "auto" =
+        # fused Pallas kernel on TPU, XLA bernoulli elsewhere
+        from distributed_llms_example_tpu.ops.fused_dropout import (
+            set_default_impl,
+        )
+
+        set_default_impl(cfg.dropout_impl)
         # training health: the in-graph numerics ride the compiled step
         # itself (extra metrics entries, no extra syncs) when the
         # watchdog will consume them
@@ -462,11 +477,22 @@ class Trainer:
             if self.val_ds
             else None
         )
-        # dropout stream: legacy uint32 threefry keys by default (bit-
-        # reproducible across backends); --prng-impl rbg swaps in the TPU
-        # hardware RNG — mask generation is then nearly free, where
-        # threefry's counter math can cost ~20% of a dropout-on step
+        # dropout stream: --prng-impl auto resolves to the TPU hardware
+        # RNG on TPU backends (threefry's counter math can cost ~20% of a
+        # dropout-on step) and bit-reproducible threefry elsewhere
         self.set_prng_impl(cfg.prng_impl)
+        if self.use_dropout:
+            from distributed_llms_example_tpu.ops.fused_dropout import (
+                resolve_impl,
+            )
+
+            log_json({
+                "event": "rng_config",
+                "prng_impl": self.prng_impl,
+                # RESOLVED value ("fused"/"xla", never "auto") — the whole
+                # point of the event is telling post-hoc which path ran
+                "dropout_impl": resolve_impl(cfg.dropout_impl),
+            })
         # telemetry bundle (obs/): span recorder, profiler controller,
         # heartbeat, and — under --obs jsonl / --obs-gauges on — the
         # startup AOT gauge compile (MFU FLOPs numerator + the static
@@ -483,8 +509,14 @@ class Trainer:
 
     def set_prng_impl(self, impl: str) -> None:
         """(Re)seed the dropout stream with the given PRNG implementation
-        ("threefry" / "rbg") — the ONE home for the key wiring, used by
-        __init__ and by bench A/B passes, so the two cannot drift."""
+        ("auto" / "threefry" / "rbg") — the ONE home for the key wiring
+        AND the auto resolution (rbg on TPU backends, threefry elsewhere),
+        used by __init__ and by bench A/B passes, so the two cannot drift.
+        The resolved impl lands in ``self.prng_impl`` so bench/obs can
+        stamp it into their records."""
+        if impl == "auto":
+            impl = "rbg" if jax.default_backend() == "tpu" else "threefry"
+        self.prng_impl = impl
         self._rng = (
             jax.random.PRNGKey(self.cfg.shuffle_seed)
             if impl == "threefry"

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Three rules, all born from real regressions at TPU scale:
+Five rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -33,6 +33,16 @@ Three rules, all born from real regressions at TPU scale:
    cadence window (summary emission, health resolve, recorder dump,
    build-time constructors) allowlisted by name; a conversion anywhere
    else in those files fails here.
+
+5. **No raw dropout primitives in models/ and train/.**  ``nn.Dropout``
+   or ``jax.random.bernoulli`` in a model or train file bypasses the
+   shared dropout helper (``ops/fused_dropout.py``) — the call site would
+   silently miss the fused Pallas path (``--dropout-impl``), its mask
+   would be threefry-generated and HBM-materialized again, and the
+   fused-vs-xla A/B in bench.py would no longer cover it.  Dropout goes
+   through ``ops.fused_dropout.Dropout`` / ``dropout``; raw primitives
+   are allowed only inside ``ops/`` (the helper and the attention
+   reference path are the implementation).
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -110,6 +120,14 @@ STEP_CADENCE_FILES: dict[str, frozenset] = {
 }
 CADENCE_SYNC_CALLS = (("jax", "device_get"),)
 
+# Rule 5: directories whose dropout must route through the shared helper
+# (ops/fused_dropout.py).  ops/ itself is the implementation layer and
+# parallel/ hosts the pipeline shim that delegates to the helper.
+DROPOUT_RULE_DIRS = (
+    os.path.join(PACKAGE, "models"),
+    os.path.join(PACKAGE, "train"),
+)
+
 
 def _is_json_dumps_call(node: ast.AST) -> bool:
     return (
@@ -181,6 +199,7 @@ def lint_file(path: str, rel: str) -> list[str]:
             return [f"{rel}: syntax error: {e}"]
     violations: list[str] = []
     hot = rel in HOT_PATH_FILES
+    dropout_ruled = any(rel.startswith(d + os.sep) for d in DROPOUT_RULE_DIRS)
     in_spec_layer = any(rel.startswith(d + os.sep) for d in SPEC_LAYER_DIRS)
     allowed_spec = rel in SPEC_LITERAL_ALLOWLIST
     json_emit_ok = rel in JSON_EMIT_ALLOW_FILES or any(
@@ -188,6 +207,14 @@ def lint_file(path: str, rel: str) -> list[str]:
     )
     if rel in STEP_CADENCE_FILES:
         violations.extend(_cadence_violations(tree, rel, STEP_CADENCE_FILES[rel]))
+    # rule 5: does this file import Dropout from the shared helper?
+    helper_dropout_import = any(
+        isinstance(n, ast.ImportFrom)
+        and n.module
+        and n.module.endswith("ops.fused_dropout")
+        and any(a.name == "Dropout" for a in n.names)
+        for n in ast.walk(tree)
+    )
 
     for node in ast.walk(tree):
         if (
@@ -203,6 +230,38 @@ def lint_file(path: str, rel: str) -> list[str]:
                 "schema_version, no process gate, invisible to --obs "
                 "jsonl) — emit through utils.jsonlog.log_json"
             )
+        if dropout_ruled and isinstance(node, ast.Call):
+            fn = node.func
+            # match the ATTRIBUTE NAME regardless of qualifier so aliased
+            # imports (linen.Dropout, flax.linen.Dropout, random.bernoulli)
+            # can't slip past; a bare `Dropout(...)` is fine only when the
+            # file imports it from the shared helper (helper_dropout_import)
+            if isinstance(fn, ast.Attribute) and fn.attr == "Dropout":
+                violations.append(
+                    f"{rel}:{node.lineno}: raw {ast.unparse(fn)}(...) in "
+                    "models//train/ bypasses the shared fused-dropout helper "
+                    "— use ops.fused_dropout.Dropout (same contract, routes "
+                    "through --dropout-impl)"
+                )
+            if (
+                isinstance(fn, ast.Name)
+                and fn.id == "Dropout"
+                and not helper_dropout_import
+            ):
+                violations.append(
+                    f"{rel}:{node.lineno}: Dropout(...) in models//train/ "
+                    "without importing it from ops.fused_dropout — only the "
+                    "shared helper's Dropout routes through --dropout-impl"
+                )
+            if (isinstance(fn, ast.Attribute) and fn.attr == "bernoulli") or (
+                isinstance(fn, ast.Name) and fn.id == "bernoulli"
+            ):
+                violations.append(
+                    f"{rel}:{node.lineno}: bernoulli(...) in models//train/ "
+                    "hand-rolls a dropout mask outside the shared helper — "
+                    "use ops.fused_dropout.dropout (the fused path never "
+                    "materializes the mask)"
+                )
         if hot and isinstance(node, ast.Attribute) and node.attr in FORBIDDEN_SYNC_ATTRS:
             violations.append(
                 f"{rel}:{node.lineno}: .{node.attr}() in the train-step hot "

@@ -299,6 +299,40 @@ def test_ir_scanner_parses_async_tuple_collectives():
     }
 
 
+_HOST_XFER_HLO = """\
+HloModule leaky
+
+ENTRY %main {
+  %p1 = f32[64,64]{1,0} parameter(0)
+  %send.1 = (f32[64,64]{1,0}, u32[], token[]) send(f32[64,64]{1,0} %p1, token[] %tok), channel_id=1, is_host_transfer=true
+  %send.2 = (f32[64,64]{1,0}, u32[], token[]) send(f32[64,64]{1,0} %p1, token[] %tok), channel_id=2
+  %out.1 = token[] outfeed(f32[64,64]{1,0} %p1, token[] %tok)
+  %cc.1 = f32[64,64]{1,0} custom-call(f32[64,64]{1,0} %p1), custom_call_target="MoveToHost"
+  ROOT %t.1 = f32[64,64]{1,0} tuple(%p1)
+}
+"""
+
+
+def test_ir_scanner_host_transfer_in_step():
+    """The ROADMAP 'host-transfer ops inside the step body' smell: outfeed,
+    is_host_transfer-attributed send, and MoveToHost custom-calls are
+    errors; an UN-attributed send (device-to-device channel traffic) is
+    not flagged."""
+    findings = scan_hlo_text(_HOST_XFER_HLO, mesh_axes={"data": 8})
+    host = [f for f in findings if f.code == "host-transfer-in-step"]
+    assert host and host[0].severity == "error"
+    flagged = host[0].context["instructions"]
+    assert "send.1" in flagged and "out.1" in flagged and "cc.1" in flagged
+    assert "send.2" not in flagged
+
+
+def test_ir_scanner_host_transfer_clean_on_synth_and_real_step():
+    # the synthetic collective program carries no host traffic
+    assert "host-transfer-in-step" not in _codes(
+        scan_hlo_text(_SYNTH_HLO, mesh_axes={"data": 8})
+    )
+
+
 def test_policy_promotion_smell():
     from distributed_llms_example_tpu.core.precision import Policy, parse_dtype
 
@@ -498,3 +532,32 @@ def test_repo_lint_clean_and_catches_violations(tmp_path):
     # ...but the same spec inside parallel/ is the sharding layer's job
     rel = os.path.join("distributed_llms_example_tpu", "parallel", "rogue.py")
     assert repo_lint.lint_file(str(bad_spec), rel) == []
+
+    # rule 5: raw dropout primitives in models//train/ bypass the shared
+    # fused helper (ops/fused_dropout.py) — aliased spellings included
+    bad_drop = tmp_path / "dropmodel.py"
+    bad_drop.write_text(
+        "import flax.linen as nn\nimport jax\n"
+        "from flax import linen\nfrom jax import random\n"
+        "d = nn.Dropout(0.1)\n"
+        "d2 = linen.Dropout(0.1)\n"
+        "d3 = Dropout(0.1)\n"  # bare name NOT from the helper
+        "m = jax.random.bernoulli(key, 0.9, (4, 4))\n"
+        "m2 = random.bernoulli(key, 0.9, (4, 4))\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "models", "dropmodel.py")
+    assert len(repo_lint.lint_file(str(bad_drop), rel)) == 5
+    rel = os.path.join("distributed_llms_example_tpu", "train", "dropmodel.py")
+    assert len(repo_lint.lint_file(str(bad_drop), rel)) == 5
+    # ...the ops/ layer IS the implementation (helper + attention reference)
+    rel = os.path.join("distributed_llms_example_tpu", "ops", "dropmodel.py")
+    assert repo_lint.lint_file(str(bad_drop), rel) == []
+    # the helper's OWN class, imported from ops.fused_dropout, is the
+    # sanctioned spelling
+    ok_drop = tmp_path / "okmodel.py"
+    ok_drop.write_text(
+        "from distributed_llms_example_tpu.ops.fused_dropout import Dropout\n"
+        "d = Dropout(0.1)\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "models", "okmodel.py")
+    assert repo_lint.lint_file(str(ok_drop), rel) == []

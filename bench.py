@@ -5,8 +5,9 @@ Output contract: the LAST result line on stdout is the benchmark record —
 The supervisor entry point (`python bench.py`) prints exactly one.  A
 direct child run (`_DLLM_BENCH_CHILD=1 python bench.py`) re-prints the
 record as each add-on measurement lands (headline first, then enriched
-with dropout/rbg/trainer fields) so a kill at any point loses only the
-not-yet-measured fields — always take the last line.
+with grad-accum/dropout/rbg/trainer fields) so a kill at any point loses
+only the not-yet-measured fields — always take the last line.  Add-ons
+that the adaptive time budget skips are named in ``skipped_passes``.
 
 Workload: the reference's headline recipe — bart-large-cnn-class seq2seq
 fine-tuning, source 1024 / target 128 (reference train-accelerator.py:115-127),
@@ -328,7 +329,7 @@ def _flagship():
 
 def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
                         attention: str | None,
-                        rbg_ok: Callable[[], bool] = lambda: True) -> dict:
+                        rbg_ok: Callable[[float], bool] = lambda est: True) -> dict:
     """Measure the REAL Trainer loop (bucketed batching + prefetch +
     logging cadence + put_batch on the critical path), not just the jitted
     step — the round-2 bench only timed synthetic fixed batches, so input-
@@ -400,6 +401,22 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
         trainer.save_final = lambda: None
         tokens = sum(trainer._batch_tokens(b) for b in trainer.batches.epoch(0))
 
+        # capture the span windows each pass emits (data_wait /
+        # step_dispatch / device_sync) — BENCH_r05 showed prefetch2 ≈
+        # prefetch0 with no way to tell WHY from the artifact; the span
+        # totals are the answer (device-bound loop: data_wait ≪
+        # step_dispatch at depth 0 already)
+        captured_windows: list[dict] = []
+        orig_summary = trainer.obs.spans.summary
+
+        def capturing_summary():
+            s = orig_summary()
+            if s is not None:
+                captured_windows.append(s)
+            return s
+
+        trainer.obs.spans.summary = capturing_summary
+
         def timed_pass() -> float:
             t0 = time.perf_counter()
             trainer.train()
@@ -409,7 +426,19 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
             _ = jax.device_get(jax.tree.leaves(trainer.state.params)[0].ravel()[0])
             return time.perf_counter() - t0
 
-        timed_pass()  # compile + warmup
+        def pass_spans() -> dict:
+            """Aggregate this pass's captured windows into per-span totals."""
+            agg: dict[str, float] = {}
+            n_steps = 0
+            for w in captured_windows:
+                n_steps += int(w.get("window_steps", 0))
+                for name, slot in w.get("spans", {}).items():
+                    agg[name] = agg.get(name, 0.0) + float(slot["total_ms"])
+            captured_windows.clear()
+            return {"steps": n_steps, **{f"{k}_ms": round(v, 1) for k, v in sorted(agg.items())}}
+
+        dt_first = timed_pass()  # compile + warmup
+        captured_windows.clear()
         out = {}
         for prefetch in (2, 0):
             trainer.cfg = cfg.replace(prefetch_batches=prefetch)
@@ -420,7 +449,12 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
             trainer.train_ds.clear_cache()
             dt = timed_pass()
             out[f"tokens_per_sec_chip_prefetch{prefetch}"] = round(tokens / dt / n_chips, 1)
-        if trainer.use_dropout and os.environ.get("BENCH_TRAINER_RBG", "1") != "0" and rbg_ok():
+            out[f"spans_prefetch{prefetch}"] = pass_spans()
+        # adaptive cost estimate for the rbg pass: one warm pass (includes
+        # the typed-key retrace — bounded by the compile-inclusive first
+        # pass) plus one timed pass
+        rbg_est = dt_first + dt + 30.0
+        if trainer.use_dropout and os.environ.get("BENCH_TRAINER_RBG", "1") != "0" and rbg_ok(rbg_est):
             # the --prng-impl rbg trainer path: hardware-RNG dropout masks.
             # Swap the key impl via the Trainer's own knob and warm once
             # (the step retraces for the typed-key argument) before timing.
@@ -483,6 +517,7 @@ def _llama_depth_main() -> None:
 
     fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
     step_ms = {}
+    accum_report = None
     for L in depths:
         cfg = dataclasses.replace(base, num_hidden_layers=L, fused_ce=fused_ce)
         module = LlamaForCausalLM(cfg, dtype=jax.numpy.bfloat16, remat=True, remat_policy=policy)
@@ -520,6 +555,120 @@ def _llama_depth_main() -> None:
             _ = float(jax.device_get(metrics["loss"]))
             times.append(time.perf_counter() - t0)
         step_ms[L] = sorted(times)[len(times) // 2] * 1e3
+
+        # In-step grad-accumulation sweep at the deepest measured config:
+        # effective batch = microbatch(=BENCH_BATCH_7B) × N at the SAME
+        # peak activation memory as the batch-4 step (the scan holds one
+        # microbatch's activations + the param-sharded fp32 accumulators)
+        # — this is how batch 8+ becomes reachable on one v5e chip after
+        # the round-5 batch8_oom.  Ideal linear scaling is N × the accum=1
+        # step time; the per-microbatch overhead fraction is the cost of
+        # the scan + the (amortized-away) once-per-step tail.
+        if L == max(depths) and os.environ.get("BENCH_ACCUM_7B", "1") != "0":
+            from distributed_llms_example_tpu.obs.gauges import hbm_stats
+
+            def peak_gib():
+                # peak_bytes_in_use is the allocator's PROCESS-LIFETIME
+                # high-water mark (never reset), so every field derived
+                # from it is named *_cumulative and each accumN entry also
+                # reports the delta vs its own pre-pass baseline: delta 0
+                # proves the pass stayed under the historical peak (the
+                # memory-flatness claim), delta > 0 is the new high water
+                # this pass alone set
+                h = hbm_stats()
+                if not h:
+                    return None
+                return round(max(d["peak_bytes_in_use"] for d in h) / 1024**3, 2)
+
+            accum_list = [
+                int(x)
+                for x in os.environ.get("BENCH_ACCUM_7B_STEPS", "4,16").split(",")
+            ]
+            accum_report = {
+                "note": (
+                    f"measured at depth {L} (full-width layers, the same "
+                    "truncated-depth methodology as the headline): in-step "
+                    "scan accumulation, microbatch "
+                    f"{batch * n_chips}, one optimizer apply per step"
+                ),
+                "microbatch": batch * n_chips,
+                "accum1_step_ms": round(step_ms[L], 1),
+            }
+            p = peak_gib()
+            if p is not None:
+                accum_report["accum1_peak_hbm_gib_cumulative"] = p
+            for N in accum_list:
+                base_peak = peak_gib()
+                rows = batch * n_chips * N
+                idsN = rng.randint(2, base.vocab_size, (rows, seq)).astype(np.int32)
+                labelsN = idsN.copy()
+                labelsN[:, : seq // 4] = LABEL_PAD
+                bN = {
+                    "input_ids": idsN,
+                    "attention_mask": np.ones_like(idsN),
+                    "labels": labelsN,
+                }
+                try:
+                    buildN = make_train_step(
+                        module, cfg, tx, schedule, mesh,
+                        is_seq2seq=False, grad_accum_steps=N,
+                    )
+                    stepN, _ = buildN(state)
+                    gbN = put_batch(bN, mesh)
+                    state, mN = stepN(state, gbN)  # compile + warmup
+                    _ = float(jax.device_get(mN["loss"]))
+                    tN = []
+                    for _ in range(steps):
+                        t0 = time.perf_counter()
+                        state, mN = stepN(state, gbN)
+                        _ = float(jax.device_get(mN["loss"]))
+                        tN.append(time.perf_counter() - t0)
+                    tN_ms = sorted(tN)[len(tN) // 2] * 1e3
+                    ideal = N * step_ms[L]
+                    entry = {
+                        "effective_batch": rows,
+                        "step_ms": round(tN_ms, 1),
+                        "per_microbatch_ms": round(tN_ms / N, 2),
+                        # tokens/sec/chip ratio vs accum=1 at equal token
+                        # throughput accounting == ideal/actual; the
+                        # acceptance bar is >= 0.95 at accum=4
+                        "tokens_per_sec_vs_accum1": round(ideal / tN_ms, 3),
+                        "overhead_frac_vs_ideal_linear": round(tN_ms / ideal - 1.0, 4),
+                    }
+                    if N == 4:
+                        entry["ok_95pct"] = bool(ideal / tN_ms >= 0.95)
+                    p = peak_gib()
+                    if p is not None:
+                        entry["peak_hbm_gib_cumulative"] = p
+                        if base_peak is not None:
+                            # 0.0 == this pass stayed under the lifetime
+                            # peak: the constant-memory acceptance signal
+                            entry["peak_hbm_new_high_water_gib"] = round(
+                                p - base_peak, 2
+                            )
+                    accum_report[f"accum{N}"] = entry
+                    del gbN, mN
+                except Exception as e:
+                    accum_report[f"accum{N}"] = {"error": str(e)[:300]}
+                    # a failure mid-step may have consumed the donated
+                    # state; rebuild it so the next N measures (or OOMs)
+                    # on its own terms instead of 'Array has been deleted'.
+                    # Drop the dead tree and this N's batch FIRST — on an
+                    # OOM before donation, old + replacement living at
+                    # once would OOM the rebuild too
+                    state = None
+                    gbN = None
+                    state = jax.tree.map(
+                        lambda x, s: jax.device_put(x, s),
+                        create_train_state(
+                            jax.jit(
+                                init_params,
+                                out_shardings=infer_param_shardings(shapes, mesh),
+                            )(),
+                            tx,
+                        ),
+                        sh,
+                    )
         del state, params, gb, metrics  # free ~11 GB before the next depth
 
     l_lo, l_hi = min(depths), max(depths)
@@ -558,6 +707,10 @@ def _llama_depth_main() -> None:
                 "measured_step_ms": {str(k): round(v, 1) for k, v in step_ms.items()},
                 "chips": n_chips,
                 "backend": jax.default_backend(),
+                # stamped even when the sweep is disabled/failed, so the
+                # record always says which accumulation config it measured
+                "grad_accum_steps": 1,
+                **({"grad_accum": accum_report} if accum_report else {}),
             }
         )
     )
@@ -753,16 +906,21 @@ def _generate_main() -> None:
 
 
 def main() -> None:
-    # Child-side wall-clock budget: the add-on measurements (dropout,
-    # rbg-dropout, trainer loop, trainer-rbg) each compile their own
-    # program, and on a cold cache the full menu runs ~25 min — past the
-    # supervisor's per-attempt timeout, which would lose the already-
-    # measured HEADLINE number.  Gate each add-on on time remaining so the
-    # JSON line always prints with whatever was measured.  The default
-    # derives from the attempt timeout the supervisor actually applied
-    # (BENCH_CHILD_TIMEOUT, set per-attempt by _supervise) so tightening
-    # the supervisor tightens the gate with it; the margin must absorb one
-    # whole add-on that STARTS just under budget, hence 0.6.  A DIRECT run
+    # Child-side wall-clock budget: the add-on measurements (grad-accum,
+    # dropout, rbg-dropout, trainer loop, trainer-rbg) each compile their
+    # own program, and on a cold cache the full menu runs ~25 min — past
+    # the supervisor's per-attempt timeout, which would lose the already-
+    # measured HEADLINE number.  The gate is ADAPTIVE: each add-on states
+    # its estimated cost (scaled from the measured cost of the comparable
+    # pass — compile time and measure window are both known after the
+    # headline), and runs iff estimate fits the time remaining before the
+    # deadline (0.9 × the attempt timeout the supervisor actually applied,
+    # BENCH_CHILD_TIMEOUT; the 10% margin only has to cover the final
+    # print+flush, not a whole add-on — the round-5 flat 0.6 gate skipped
+    # the trainer rbg pass with 360 s genuinely left).  Every skip is
+    # logged to stderr AND stamped into the result JSON
+    # (``skipped_passes``) — a silently missing field reads as "measured,
+    # nothing to report", which is exactly wrong.  A DIRECT run
     # (`_DLLM_BENCH_CHILD=1 python bench.py`, no supervisor → no
     # BENCH_CHILD_TIMEOUT) has nothing racing to kill it, so it measures
     # the full menu unless BENCH_CHILD_BUDGET caps it explicitly.
@@ -772,13 +930,20 @@ def main() -> None:
     if _budget_env:
         _child_budget = float(_budget_env)
     elif _timeout_env:
-        _child_budget = 0.6 * float(_timeout_env)
+        _child_budget = 0.9 * float(_timeout_env)
     else:
         _child_budget = float("inf")
+    skipped_passes: list[str] = []
 
-    def over_budget(what: str) -> bool:
-        if time.monotonic() - _t0 > _child_budget:
-            print(f"bench: {what} skipped (child budget {_child_budget:.0f}s)", file=sys.stderr)
+    def over_budget(what: str, est: float = 0.0) -> bool:
+        elapsed = time.monotonic() - _t0
+        if elapsed + est > _child_budget:
+            msg = (
+                f"{what} skipped (elapsed {elapsed:.0f}s + estimated "
+                f"{est:.0f}s > child budget {_child_budget:.0f}s)"
+            )
+            print(f"bench: {msg}", file=sys.stderr)
+            skipped_passes.append(msg)
             return True
         return False
 
@@ -877,10 +1042,14 @@ def main() -> None:
         except Exception as e:
             print(f"bench: collective-traffic account unavailable ({e})", file=sys.stderr)
 
-    # warmup/compile
+    # warmup/compile — timed: the compile cost is the dominant unknown in
+    # every add-on's budget estimate below (cache hits make it small,
+    # cold compiles make it the whole story)
+    t0 = time.perf_counter()
     for _ in range(2):
         state, metrics = step_fn(state, gb)
     sync(state, metrics)
+    compile_s = time.perf_counter() - t0
 
     # throughput: one sync at the end so async dispatch can overlap steps —
     # the same pipelining the trainer gets (a per-step readback here would
@@ -891,6 +1060,10 @@ def main() -> None:
     loss = sync(state, metrics)
     dt = time.perf_counter() - t0
     assert loss == loss, "non-finite loss"
+
+    # one compile + warm + timed window, the shape of every synthetic
+    # add-on pass below — the adaptive budget gate scales from it
+    est_step_pass = compile_s + 2.5 * dt
 
     # step-time distribution: a separate pass with a readback per step
     # (sync-inclusive — upper bounds on single-step latency, not 1/throughput)
@@ -937,12 +1110,77 @@ def main() -> None:
     # stamp both knobs so BENCH_*.json rows stay comparable across rounds
     result["dropout_impl"] = "xla"
     result["prng_impl"] = "threefry"
+    result["grad_accum_steps"] = 1  # the headline step; the A/B below adds accum>1
+
     # Emit the record NOW and again after each add-on lands: if an add-on
     # overruns the supervisor's kill (budget gates check only at add-on
     # START), the supervisor salvages the newest line from the dead
     # child's stdout — so every field measured before the kill survives.
     # Consumers take the LAST result line (module docstring contract).
-    print(json.dumps(result), flush=True)
+    # Every emit carries the skip log (the no-silent-caps rule: a missing
+    # field must say WHY it is missing).
+    def emit_result() -> None:
+        if skipped_passes:
+            result["skipped_passes"] = list(skipped_passes)
+        print(json.dumps(result), flush=True)
+
+    emit_result()
+
+    # grad-accumulation A/B: the SAME effective batch cut into 4 in-step
+    # microbatches (lax.scan, fp32 accumulators sharded like the params,
+    # one optimizer apply per step).  tokens/sec at the same effective
+    # batch compares directly; the ratio is the accumulation overhead vs
+    # ideal linear scaling (acceptance bar: >= 0.95 at accum=4).
+    accum_n = int(os.environ.get("BENCH_ACCUM", "4"))
+    if accum_n > 1 and batch % accum_n:
+        # a config skip is still a skip (no-silent-caps): a missing
+        # grad_accum field must not read as "measured, nothing to report"
+        msg = (
+            f"grad-accum step skipped (batch {batch} not divisible by "
+            f"BENCH_ACCUM={accum_n})"
+        )
+        print(f"bench: {msg}", file=sys.stderr)
+        skipped_passes.append(msg)
+    elif accum_n > 1 and not over_budget("grad-accum step", est_step_pass):
+        try:
+            build_a = make_train_step(
+                lm.module, lm.config, tx, schedule, mesh, grad_accum_steps=accum_n
+            )
+            step_a, _ = build_a(state)
+            for _ in range(2):
+                state, metrics = step_a(state, gb)
+            sync(state, metrics)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step_a(state, gb)
+            sync(state, metrics)
+            dta = time.perf_counter() - t0
+            tps_chip_accum = round(tokens_per_step * steps / dta / n_chips, 1)
+            result["grad_accum"] = {
+                "steps": accum_n,
+                "tokens_per_sec_chip": tps_chip_accum,
+                # tokens/sec ratio at equal effective batch == ideal-linear-
+                # scaling fraction; 1 - ratio is the per-step scan overhead
+                "vs_accum1": round(tps_chip_accum / tps_chip, 3),
+                "overhead_frac": round(1.0 - tps_chip_accum / tps_chip, 4),
+                "overhead_ok": bool(tps_chip_accum / tps_chip >= 0.95),
+            }
+            emit_result()
+        except Exception as e:
+            print(f"bench: grad-accum bench failed ({e})", file=sys.stderr)
+            # a failed accum step may have consumed (donated) the state
+            # buffers mid-execution — rebuild so the health/dropout/rbg
+            # add-ons below don't all die on 'Array has been deleted'.
+            # Drop the dead tree FIRST: if the failure was an OOM before
+            # donation, old + replacement living at once would OOM the
+            # rebuild itself and lose every already-measured field
+            state = None
+            p_re = lm.params if lm.params is not None else jax.device_get(lm.init_params(0))
+            state = jax.tree.map(
+                lambda x, s: jax.device_put(x, s),
+                create_train_state(shard_params(p_re, mesh), tx),
+                sh,
+            )
 
     # health-telemetry overhead: the SAME step compiled with the in-graph
     # numerics (param norm, per-bucket update ratios, non-finite counts —
@@ -950,7 +1188,7 @@ def main() -> None:
     # step: a handful of elementwise reductions must stay invisible next
     # to the matmuls, or --health on costs real throughput at scale.
     max_overhead = float(os.environ.get("BENCH_HEALTH_MAX_OVERHEAD", "0.02"))
-    if os.environ.get("BENCH_HEALTH", "1") != "0" and not over_budget("health step"):
+    if os.environ.get("BENCH_HEALTH", "1") != "0" and not over_budget("health step", est_step_pass):
         try:
             build_h = make_train_step(lm.module, lm.config, tx, schedule, mesh, health=True)
             step_h, _ = build_h(state)
@@ -974,7 +1212,7 @@ def main() -> None:
                     "on the critical path",
                     file=sys.stderr,
                 )
-            print(json.dumps(result), flush=True)
+            emit_result()
         except Exception as e:
             print(f"bench: health-step bench failed ({e})", file=sys.stderr)
 
@@ -985,7 +1223,7 @@ def main() -> None:
     # apples-to-apples (trainer ≈ this number ⇒ the input pipeline is off
     # the critical path; trainer ≈ headline would be impossible).
     tps_chip_dropout = None
-    if os.environ.get("BENCH_DROPOUT", "1") != "0" and not over_budget("dropout step"):
+    if os.environ.get("BENCH_DROPOUT", "1") != "0" and not over_budget("dropout step", est_step_pass):
         try:
             # pin the BASELINE to the xla impl: on TPU the process default
             # ("auto") resolves to fused, and the fused-vs-xla A/B below
@@ -1013,7 +1251,7 @@ def main() -> None:
             dtd = time.perf_counter() - t0
             tps_chip_dropout = round(tokens_per_step * steps / dtd / n_chips, 1)
             result["with_dropout_tokens_per_sec_chip"] = tps_chip_dropout
-            print(json.dumps(result), flush=True)
+            emit_result()
         except Exception as e:
             print(f"bench: dropout-step bench failed ({e})", file=sys.stderr)
 
@@ -1026,7 +1264,7 @@ def main() -> None:
     if (
         tps_chip_dropout is not None
         and os.environ.get("BENCH_DROPOUT_RBG", "1") != "0"
-        and not over_budget("rbg dropout step")
+        and not over_budget("rbg dropout step", est_step_pass)
     ):
         try:
             key = jax.random.key(0, impl="rbg")
@@ -1042,7 +1280,7 @@ def main() -> None:
             dtr = time.perf_counter() - t0
             tps_chip_dropout_rbg = round(tokens_per_step * steps / dtr / n_chips, 1)
             result["with_dropout_rbg_tokens_per_sec_chip"] = tps_chip_dropout_rbg
-            print(json.dumps(result), flush=True)
+            emit_result()
         except Exception as e:
             print(f"bench: rbg dropout-step bench failed ({e})", file=sys.stderr)
 
@@ -1055,7 +1293,7 @@ def main() -> None:
     if (
         tps_chip_dropout is not None
         and os.environ.get("BENCH_DROPOUT_FUSED", "1") != "0"
-        and not over_budget("fused dropout step")
+        and not over_budget("fused dropout step", est_step_pass)
     ):
         from distributed_llms_example_tpu.ops.fused_dropout import (
             set_default_impl,
@@ -1113,7 +1351,7 @@ def main() -> None:
                     )
             except Exception as e:
                 print(f"bench: fused-step HLO scan unavailable ({e})", file=sys.stderr)
-            print(json.dumps(result), flush=True)
+            emit_result()
         except Exception as e:
             print(f"bench: fused dropout-step bench failed ({e})", file=sys.stderr)
 
@@ -1131,7 +1369,9 @@ def main() -> None:
     # critical path): validating within ~5% of the with-dropout synthetic
     # number proves the input pipeline stays off the device's back
     trainer_loop = None
-    if os.environ.get("BENCH_TRAINER", "1") != "0" and not over_budget("trainer loop"):
+    if os.environ.get("BENCH_TRAINER", "1") != "0" and not over_budget(
+        "trainer loop", 2 * est_step_pass + 2 * dt
+    ):
         # free the synthetic run's device state first: params + AdamW
         # moments are ~5 GB for the 406M flagship, and the Trainer builds
         # its own copy — both living at once exhausts a 16 GB chip
@@ -1140,7 +1380,7 @@ def main() -> None:
             trainer_loop = _trainer_loop_bench(
                 name, n_chips, remat=remat,
                 attention=os.environ.get("BENCH_ATTENTION", "") or None,
-                rbg_ok=lambda: not over_budget("trainer rbg pass"),
+                rbg_ok=lambda est: not over_budget("trainer rbg pass", est),
             )
             tl = trainer_loop.get("tokens_per_sec_chip_prefetch2")
             if tl:
@@ -1155,7 +1395,7 @@ def main() -> None:
 
     if trainer_loop is not None:
         result["trainer_loop"] = trainer_loop
-    print(json.dumps(result))
+    emit_result()
 
 
 if __name__ == "__main__":

@@ -23,7 +23,8 @@ Matching model: a combo row fires when ALL of its conditions hold —
 Known flags: ``pipelined`` (a stage>1 pipeline adapter is in play),
 ``seq2seq``/``causal`` (family shape), ``moe`` (config has routed
 experts), ``fused_ce`` (--fused-ce), ``ring`` (--attention-impl ring),
-``forced_dense_attention`` (--attention-impl xla/flash).
+``forced_dense_attention`` (--attention-impl xla/flash), ``grad_accum``
+(--grad-accum-steps > 1 — the in-step scan accumulation).
 """
 
 from __future__ import annotations
@@ -88,6 +89,19 @@ class GoodCombo:
 # Ordering matters: ``validate_composition`` raises the FIRST matching
 # row's reason, so more specific rows go first.
 KNOWN_BAD: tuple[BadCombo, ...] = (
+    BadCombo(
+        id="grad-accum-pipelined",
+        flags=("grad_accum", "pipelined"),
+        reason=(
+            "--grad-accum-steps > 1 does not compose with stage>1 "
+            "pipelines: the pipeline executors already microbatch inside "
+            "their schedules (--pipeline-microbatches) — stacking the "
+            "in-step accumulation scan on top double-accumulates the same "
+            "memory trade for pure scan overhead; raise "
+            "--pipeline-microbatches instead (the step owns accumulation "
+            "on GSPMD meshes, the pipeline owns it under stage>1)"
+        ),
+    ),
     BadCombo(
         id="seq2seq-1f1b-fsdp",
         schedules=("1f1b",),
@@ -227,6 +241,7 @@ def config_flags(
     fused_ce: bool = False,
     attention_impl: str = "",
     num_experts: int = 0,
+    grad_accum_steps: int = 1,
 ) -> set[str]:
     """Derive the composition-matrix flags from run configuration — the
     ONE mapping from config knobs to table flags, shared by the Trainer's
@@ -239,6 +254,8 @@ def config_flags(
         flags.add("fused_ce")
     if num_experts > 0:
         flags.add("moe")
+    if grad_accum_steps > 1:
+        flags.add("grad_accum")
     if attention_impl == "ring":
         flags.add("ring")
     elif attention_impl in ("xla", "flash"):

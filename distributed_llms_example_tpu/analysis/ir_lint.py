@@ -236,6 +236,236 @@ def reduce_scatter_smell(
     )
 
 
+# --------------------------------------------------------------------------
+# Computation-level HLO structure (the once-per-step placement pass needs to
+# know WHICH loop body an instruction lives in, which the flat parse above
+# deliberately ignores).
+# --------------------------------------------------------------------------
+
+# `%name (params...) -> result {` — computation header (ENTRY optional).
+_COMP_HEAD_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*\{\s*$")
+# references to other computations from inside an instruction line
+_CALLED_RE = re.compile(
+    r"(?:to_apply|body|condition|calls|branch_computations)="
+    r"(?:\{([^}]*)\}|%?([\w.\-]+))"
+)
+_WHILE_BODY_RE = re.compile(r"\bwhile\(.*?body=%?([\w.\-]+)")
+_SOURCE_LINE_RE = re.compile(r'source_file="(?P<file>[^"]+)"\s+source_line=(?P<line>\d+)')
+
+
+def split_computations(hlo_text: str) -> dict[str, list[str]]:
+    """HLO text → {computation name: its instruction lines}."""
+    out: dict[str, list[str]] = {}
+    current: list[str] | None = None
+    for line in hlo_text.splitlines():
+        m = _COMP_HEAD_RE.match(line)
+        if m is not None and line.rstrip().endswith("{"):
+            current = out.setdefault(m.group("name"), [])
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
+        if current is not None:
+            current.append(line)
+    return out
+
+
+def _called_names(lines: Iterable[str]) -> set[str]:
+    names: set[str] = set()
+    for line in lines:
+        for grouped, single in _CALLED_RE.findall(line):
+            if single:
+                names.add(single)
+            else:
+                names.update(n.strip().lstrip("%") for n in grouped.split(",") if n.strip())
+    return names
+
+
+def loop_body_computations(hlo_text: str) -> set[str]:
+    """Names of every computation reachable from a ``while`` body — i.e.
+    code that executes ONCE PER LOOP ITERATION.  The grad-accumulation
+    scan lowers to a while; so do unrelated loops (gather/sort helpers),
+    which is fine: the once-per-step contract is that the optimizer tail
+    sits inside NO loop at all."""
+    comps = split_computations(hlo_text)
+    roots: set[str] = set()
+    for lines in comps.values():
+        for line in lines:
+            m = _WHILE_BODY_RE.search(line)
+            if m:
+                roots.add(m.group(1))
+    reachable: set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in reachable or name not in comps:
+            continue
+        reachable.add(name)
+        frontier.extend(_called_names(comps[name]))
+    return reachable
+
+
+def once_per_step_placement(
+    hlo_text: str, spans: Iterable[tuple[str, int, int]]
+) -> dict[str, Any]:
+    """Census of the optimizer/clip/health block's placement in the
+    compiled program, from instruction source metadata.
+
+    ``spans`` is ``train.step.once_per_step_source_spans()`` — the
+    ``(file, first_line, last_line)`` ranges of the code that must run
+    exactly once per optimizer step.  jax stamps each HLO instruction
+    with its originating source line, so counting span-attributed
+    instructions inside loop-body computations proves (or refutes) that
+    the optimizer apply stayed OUT of the grad-accumulation scan — on
+    the real compiled program, regardless of ``grad_accum_steps``.
+
+    Returns ``{"total": N, "in_loop": M, "in_loop_examples": [...]}``;
+    a healthy step has ``total > 0`` (the block exists) and
+    ``in_loop == 0`` (none of it slid into a loop body)."""
+    span_list = [(str(f), int(a), int(b)) for f, a, b in spans]
+
+    def in_spans(fname: str, line: int) -> bool:
+        return any(fname.endswith(f) or f.endswith(fname) or fname == f for f, a, b in span_list if a <= line <= b)
+
+    comps = split_computations(hlo_text)
+    loop_comps = loop_body_computations(hlo_text)
+    total = 0
+    in_loop = 0
+    examples: list[str] = []
+    for cname, lines in comps.items():
+        for line in lines:
+            m = _SOURCE_LINE_RE.search(line)
+            if not m or not in_spans(m.group("file"), int(m.group("line"))):
+                continue
+            total += 1
+            if cname in loop_comps:
+                in_loop += 1
+                if len(examples) < 8:
+                    d = _DEF_RE.match(line)
+                    examples.append(
+                        f"{cname}:%{d.group('name')}" if d else cname
+                    )
+    return {"total": total, "in_loop": in_loop, "in_loop_examples": examples}
+
+
+def once_per_step_finding(
+    hlo_text: str, spans: Iterable[tuple[str, int, int]]
+) -> Finding | None:
+    """The placement census as a finding: error when any optimizer-block
+    instruction landed inside a loop body (it would re-run every
+    microbatch — the non-layer overhead grad accumulation exists to
+    amortize), or when NO instruction carries the block's source spans
+    (the metadata went missing and the census proves nothing)."""
+    census = once_per_step_placement(hlo_text, spans)
+    if census["in_loop"]:
+        return Finding(
+            severity="error",
+            pass_name="ir",
+            code="optimizer-in-scan-body",
+            message=(
+                f"{census['in_loop']} optimizer/health instruction(s) were "
+                "scheduled inside a loop body (e.g. "
+                f"{census['in_loop_examples'][:3]}) — clip/AdamW/health must "
+                "run once per optimizer step, after the grad-accumulation "
+                "scan, not once per microbatch"
+            ),
+            context=census,
+        )
+    if census["total"] == 0:
+        return Finding(
+            severity="warning",
+            pass_name="ir",
+            code="optimizer-census-empty",
+            message=(
+                "no instruction carries the optimizer-apply-block source "
+                "spans — source metadata is missing from this HLO text, so "
+                "the once-per-step placement cannot be proven"
+            ),
+            context=census,
+        )
+    return None
+
+
+def collective_permute_chain_depth(instrs: Mapping[str, HloInstr]) -> int:
+    """Longest dependency chain of collective-permutes in the parsed
+    instruction graph: the number of permutes on the longest operand path
+    ending at (and including) each permute.  Data moved around a
+    pipeline's stage ring needs at most one hop per ring edge; a chain
+    longer than the ring means some tensor was permuted around more than
+    once — a resharded pipeline hop."""
+    permute_ops = ("collective-permute", "collective-permute-start")
+    depth: dict[str, int] = {}
+
+    # iterative post-order: a real compiled step's operand chains run far
+    # past Python's recursion limit (one frame per instruction would
+    # RecursionError on any 7B program), so expand-then-combine on an
+    # explicit stack.  ``on_path`` guards cycles (HLO is a DAG, but a
+    # malformed text must not hang the lint): a back-edge operand scores 0.
+    for root in instrs:
+        if root in depth:
+            continue
+        stack: list[tuple[str, bool]] = [(root, False)]
+        on_path: set[str] = set()
+        while stack:
+            name, expanded = stack.pop()
+            if expanded:
+                on_path.discard(name)
+                instr = instrs[name]
+                child = max((depth.get(o, 0) for o in instr.operands), default=0)
+                depth[name] = child + (1 if instr.op in permute_ops else 0)
+                continue
+            if name in depth or name not in instrs or name in on_path:
+                continue
+            on_path.add(name)
+            stack.append((name, True))
+            for o in instrs[name].operands:
+                if o not in depth and o in instrs and o not in on_path:
+                    stack.append((o, False))
+    return max(depth.values(), default=0)
+
+
+def ppermute_chain_smell(
+    instrs: Mapping[str, HloInstr], mesh_axes: Mapping[str, int]
+) -> Finding | None:
+    """The ROADMAP smell: a collective-permute chain longer than the
+    stage ring.  A pipeline with S stages moves activations/gradients at
+    most S hops around the ring per pass; a longer chain means a tensor
+    was resharded through extra permute hops (usually a spec mismatch
+    between stages making GSPMD route data the long way around).
+
+    Gated to meshes where the stage ring is the ONLY permute ring: with
+    sequence/context parallelism in play, ring attention and halo
+    exchanges legitimately chain one permute per layer (depth ≫ stage)
+    and HLO text does not say which axis a permute's pairs ride — the
+    stage-ring bound would fire on every deep network."""
+    stage = int(mesh_axes.get("stage", 1) or 1)
+    if stage <= 1:
+        return None
+    if int(mesh_axes.get("sequence", 1) or 1) > 1:
+        return None
+    if not any(
+        i.op in ("collective-permute", "collective-permute-start")
+        for i in instrs.values()
+    ):
+        return None
+    longest = collective_permute_chain_depth(instrs)
+    if longest <= stage:
+        return None
+    return Finding(
+        severity="warning",
+        pass_name="ir",
+        code="ppermute-chain-exceeds-stage-ring",
+        message=(
+            f"a collective-permute dependency chain of length {longest} "
+            f"exceeds the stage ring (stage={stage}) — data is being "
+            "permuted around the pipeline more than one full pass, i.e. a "
+            "resharded pipeline hop (a spec mismatch between stages makes "
+            "GSPMD route tensors the long way around the ring)"
+        ),
+        context={"chain_length": longest, "stage": stage},
+    )
+
+
 def host_transfer_instructions(instrs: Mapping[str, HloInstr]) -> list[str]:
     """Names of instructions that move data between host and device —
     the ROADMAP "host-transfer ops inside the step body" smell.  Pure
@@ -370,6 +600,11 @@ def scan_hlo_text(
             context={"count": len(host_xfers), "instructions": host_xfers[:8]},
         ))
 
+    # ---- collective-permute chains vs the stage ring -------------------
+    chain = ppermute_chain_smell(instrs, mesh_axes)
+    if chain is not None:
+        findings.append(chain)
+
     # ---- degenerate collectives ----------------------------------------
     degenerate: list[str] = []
     for line in lines:
@@ -482,7 +717,7 @@ def lint_train_step(
         default=0,
     )
     policy = Policy(compute_dtype=parse_dtype(dtype))
-    return scan_hlo_text(
+    findings = scan_hlo_text(
         text,
         mesh_axes=dict(mesh.shape),
         promotion_smell=policy.matmul_promotion_smell(),
@@ -490,6 +725,17 @@ def lint_train_step(
         gather_bytes_threshold=gather_bytes_threshold,
         param_element_counts=[int(math.prod(x.shape)) for x in leaves],
     )
+    if grad_accum_steps > 1:
+        # grad accumulation adds its own compiled-program contract: the
+        # clip/AdamW/health tail must sit OUTSIDE the microbatch scan
+        from distributed_llms_example_tpu.train.step import (
+            once_per_step_source_spans,
+        )
+
+        placement = once_per_step_finding(text, once_per_step_source_spans())
+        if placement is not None:
+            findings.append(placement)
+    return findings
 
 
 def skipped(reason: str) -> list[Finding]:

@@ -78,6 +78,7 @@ def run_passes(
     tgt_len: int = 128,
     dtype: str = "bfloat16",
     remat: bool = False,
+    grad_accum_steps: int = 1,
 ) -> list[Finding]:
     """The three passes over one (model, mesh, config) triple."""
     import jax
@@ -100,6 +101,11 @@ def run_passes(
         a_params,
         replicated_bytes_threshold=replicated_bytes_threshold,
     )
+    # the grad-accumulation layout contract: fp32 accumulators mirror the
+    # param specs leaf for leaf (train/step.py accumulator_shardings)
+    findings += spec_lint.lint_accumulator_mirror(
+        a_params, rules if rules is not None else default_rules()
+    )
 
     # Pass 3 — composition matrix (cheap; run before the compile pass so a
     # known-crash combo is reported even when the compile would die)
@@ -113,6 +119,7 @@ def run_passes(
             fused_ce=fused_ce,
             attention_impl=attention_impl,
             num_experts=int(getattr(lm.config, "num_experts", 0) or 0),
+            grad_accum_steps=grad_accum_steps,
         ),
     )
 
@@ -148,6 +155,7 @@ def run_passes(
                 tgt_len=tgt_len,
                 dtype=dtype,
                 remat=remat,
+                grad_accum_steps=grad_accum_steps,
             )
     return findings
 
@@ -164,6 +172,7 @@ def startup_lint(cfg: Any) -> list[Finding]:
         run_ir=False,
         dtype=cfg.compute_dtype,
         remat=cfg.remat,
+        grad_accum_steps=cfg.grad_accum_steps,
     )
 
 
@@ -190,6 +199,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tgt-len", type=int, default=128)
     p.add_argument("--dtype", type=str, default="bfloat16")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--grad-accum-steps", type=int, default=1,
+                   help="lint the in-step grad-accumulation config: the "
+                        "composition row (accum x stage>1) and, with the IR "
+                        "pass, the once-per-step optimizer placement census")
     p.add_argument("--no-ir", action="store_true",
                    help="skip the lowered-program pass (no AOT compile)")
     p.add_argument("--strict", action="store_true",
@@ -228,6 +241,7 @@ def main(argv: list[str] | None = None) -> int:
             tgt_len=args.tgt_len,
             dtype=args.dtype,
             remat=args.remat,
+            grad_accum_steps=args.grad_accum_steps,
         )
     emit(findings, as_json=args.json)
     counts = count_by_severity(findings)

@@ -52,6 +52,74 @@ def _leaf_bytes(leaf: Any) -> int:
     return int(math.prod(shape)) * itemsize
 
 
+def lint_accumulator_mirror(params: Any, rules: Any = None) -> list[Finding]:
+    """The grad-accumulation layout contract: the in-step fp32 gradient
+    accumulators must be sharded EXACTLY like the parameters, leaf for
+    leaf (``train/step.py accumulator_shardings`` — the weight-update-
+    sharding recipe of arXiv:2004.13336).  This pass feeds the live
+    function a tree of the params' resolved PartitionSpecs and errors on
+    any leaf it fails to mirror — so an edit that replicates the
+    accumulators (a param-sized fp32 copy per device) or re-shards them
+    against the carry (a GSPMD reshard per microbatch) fails the lint
+    before it ever compiles.  Device-free: specs only, no mesh."""
+    import jax.tree_util as jtu
+
+    from distributed_llms_example_tpu.parallel.sharding import _path_str
+    from distributed_llms_example_tpu.train.step import accumulator_shardings
+
+    if rules is None:
+        from distributed_llms_example_tpu.parallel.sharding import default_rules
+
+        rules = default_rules()
+
+    paths: list[str] = []
+    specs: list[Any] = []
+    jtu.tree_map_with_path(
+        lambda path, x: (
+            paths.append(_path_str(path)),
+            specs.append(rules.spec_for(_path_str(path), len(getattr(x, "shape", ())))),
+        )
+        and None,
+        params,
+    )
+    param_spec_tree = jtu.tree_unflatten(jtu.tree_structure(params), specs)
+    mirrored = accumulator_shardings(param_spec_tree)
+    mirrored_leaves = jtu.tree_leaves(mirrored)
+    findings: list[Finding] = []
+    if len(mirrored_leaves) != len(specs):
+        return [
+            Finding(
+                severity="error",
+                pass_name="spec",
+                code="accumulator-tree-mismatch",
+                message=(
+                    f"accumulator_shardings returned {len(mirrored_leaves)} "
+                    f"leaves for a {len(specs)}-leaf param tree — the fp32 "
+                    "accumulator tree no longer mirrors the params"
+                ),
+            )
+        ]
+    for path, want, got in zip(paths, specs, mirrored_leaves):
+        if got != want:
+            findings.append(
+                Finding(
+                    severity="error",
+                    pass_name="spec",
+                    code="accumulator-spec-mismatch",
+                    message=(
+                        f"{path}: gradient accumulator spec {got} differs "
+                        f"from the param spec {want} — the in-step fp32 "
+                        "accumulators must mirror the param shardings "
+                        "exactly (anything else replicates a param-sized "
+                        "fp32 tree per device, or forces GSPMD to reshard "
+                        "every microbatch's gradients against the carry)"
+                    ),
+                    context={"param": path, "param_spec": str(want), "accum_spec": str(got)},
+                )
+            )
+    return findings
+
+
 def lint_sharding_rules(
     rules: Any,
     mesh_axes: Mapping[str, int],

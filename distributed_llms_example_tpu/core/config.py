@@ -280,7 +280,23 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--weight-decay", type=float, default=_D.weight_decay)
     p.add_argument("--max-grad-norm", type=float, default=_D.max_grad_norm)
     p.add_argument("--label-smoothing", type=float, default=_D.label_smoothing)
-    p.add_argument("--grad-accum-steps", type=int, default=_D.grad_accum_steps)
+    p.add_argument(
+        "--grad-accum-steps",
+        # the reference's parameter name (train-torchrun.py:126), as
+        # valohai.yaml passes it — both spellings land on grad_accum_steps
+        "--gradient-accumulation-steps",
+        "--gradient_accumulation_steps",
+        dest="grad_accum_steps",
+        type=int, default=_D.grad_accum_steps,
+        help="microbatches accumulated INSIDE each compiled step (a "
+             "lax.scan with fp32 accumulators sharded like the params): "
+             "--batch-size stays the effective optimizer batch and must "
+             "divide evenly; one optimizer apply per step regardless of N. "
+             "The reference's gradient_accumulation_steps "
+             "(train-torchrun.py:126). Composes with data/fsdp/tensor "
+             "meshes; stage>1 pipelines microbatch via "
+             "--pipeline-microbatches instead",
+    )
     p.add_argument("--shuffle-seed", type=int, default=_D.shuffle_seed)
     p.add_argument("--pad-to-multiple", type=int, default=_D.pad_to_multiple)
     p.add_argument("--max-source-length", type=int, default=_D.max_source_length)
@@ -435,4 +451,19 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         ckpt_kw["resume"] = not present["no_resume"]
     if ckpt_kw:
         kw["checkpoint"] = CheckpointConfig(**ckpt_kw)
-    return TrainConfig(**kw)
+    cfg = TrainConfig(**kw)
+    # fail at parse time, not at first compile: the batch/accumulation
+    # divisibility is knowable here (the mesh-aware microbatch-vs-shards
+    # check runs at Trainer startup, where the device mesh exists)
+    if cfg.grad_accum_steps < 1:
+        raise ValueError(
+            f"--grad-accum-steps must be >= 1, got {cfg.grad_accum_steps}"
+        )
+    if cfg.batch_size % cfg.grad_accum_steps:
+        raise ValueError(
+            f"--batch-size {cfg.batch_size} is not divisible by "
+            f"--grad-accum-steps {cfg.grad_accum_steps}: batch-size is the "
+            "EFFECTIVE optimizer batch; the step cuts it into "
+            "grad-accum-steps equal microbatches"
+        )
+    return cfg

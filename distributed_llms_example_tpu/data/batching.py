@@ -28,6 +28,55 @@ from distributed_llms_example_tpu.data.dataset import (
 LABEL_PAD = -100  # loss-mask value, parity with HF label padding
 
 
+def microbatch_size(
+    global_batch: int,
+    grad_accum_steps: int,
+    *,
+    batch_shards: int = 1,
+    process_count: int = 1,
+) -> int:
+    """Validate the (global batch, accumulation, sharding) triple and
+    return the microbatch size.
+
+    One iterator batch = one optimizer step, ALWAYS — ``grad_accum_steps``
+    never changes the epoch/resume iterator contract (the step counter,
+    checkpoints, and O(1) resume all count optimizer steps; the compiled
+    step regroups the batch into microbatches internally).  What it does
+    change is the divisibility the regrouping needs:
+
+    - ``global_batch % grad_accum_steps``: the reshape that cuts the
+      microbatches;
+    - ``microbatch % batch_shards``: each microbatch's rows must split
+      evenly over the (data, fsdp, expert) axes, or the shard-local
+      regrouping degrades into a per-step GSPMD reshard;
+    - ``global_batch % process_count``: each host materializes its slice
+      of every optimizer batch (unchanged from accum=1, re-checked here
+      so the error names the accumulation config).
+    """
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    if global_batch % grad_accum_steps:
+        raise ValueError(
+            f"global batch {global_batch} is not divisible by "
+            f"grad_accum_steps={grad_accum_steps}"
+        )
+    micro = global_batch // grad_accum_steps
+    if micro % max(1, batch_shards):
+        raise ValueError(
+            f"microbatch {micro} (batch {global_batch} / grad_accum_steps "
+            f"{grad_accum_steps}) is not divisible by the mesh's "
+            f"{batch_shards} batch shards (data x fsdp x expert) — the "
+            "shard-local microbatch regrouping needs every microbatch to "
+            "split evenly over the batch axes"
+        )
+    if global_batch % max(1, process_count):
+        raise ValueError(
+            f"global batch {global_batch} is not divisible by "
+            f"{process_count} processes"
+        )
+    return micro
+
+
 def bucket_len(max_len_in_batch: int, multiple: int, cap: int) -> int:
     b = ((max(1, max_len_in_batch) + multiple - 1) // multiple) * multiple
     return min(b, cap)

@@ -11,12 +11,21 @@ and the consumer blocks in XLA dispatch anyway.
 ``depth`` items ahead; producer exceptions re-raise in the consumer at the
 point of failure; early consumer exit (``close()``, GC, or ``with``) stops
 the producer promptly instead of leaking the thread on an unbounded queue.
+
+``stats()`` reports how much the consumer actually BLOCKED on the queue
+(plus items moved): the per-run answer to "is the input pipeline on the
+critical path?".  BENCH_r05 measured prefetch depth 2 ≈ depth 0 on the
+trainer loop — the spans showed the loop is device-bound at those shapes
+(batch assembly is ~2% of a 400 ms step, so there is nothing for the
+thread to hide); these counters are what proves that cheaply, per run,
+without a profiler (tests/test_prefetch.py pins both directions).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Iterable, Iterator
 
 _DONE = object()
@@ -30,6 +39,8 @@ class Prefetcher:
         self._stop = threading.Event()
         self._err: BaseException | None = None
         self._finished = False  # latched: never block on the queue again
+        self._items = 0  # items handed to the consumer
+        self._wait_s = 0.0  # wall time the consumer spent blocked on get()
         self._thread = threading.Thread(target=self._fill, args=(iter(it),), daemon=True)
         self._thread.start()
 
@@ -65,13 +76,25 @@ class Prefetcher:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        t0 = time.perf_counter()
         item = self._q.get()
+        self._wait_s += time.perf_counter() - t0
         if item is _DONE:
             self._finished = True
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        self._items += 1
         return item
+
+    def stats(self) -> dict:
+        """``{"items", "consumer_wait_s"}`` — items delivered and the wall
+        time the consumer spent blocked waiting for one.  A healthy
+        overlapped pipeline keeps ``consumer_wait_s`` near the FIRST
+        item's assembly time (the warm-up the thread cannot hide); wait
+        growing with item count means the producer cannot keep up and the
+        input pipeline is on the critical path."""
+        return {"items": self._items, "consumer_wait_s": self._wait_s}
 
     def close(self) -> None:
         self._finished = True

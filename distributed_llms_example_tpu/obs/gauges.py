@@ -180,8 +180,15 @@ def train_step_static_gauges(
         # the compiled (post-SPMD) module is the PER-DEVICE program —
         # measured: an 8-way sharded matmul reports 1/8 of the lowered
         # module's flops — so scale to the global per-step count the MFU
-        # formula divides by aggregate peak
-        flops = float((ca or {}).get("flops", 0.0)) * mesh_size
+        # formula divides by aggregate peak.  Under grad accumulation the
+        # cost analysis counts the scan's while BODY exactly once
+        # (measured on jax 0.4.37: flops(accum=4) ≈ flops(accum=1)/4 +
+        # loop bookkeeping at the same effective batch — pinned in
+        # tests/test_obs.py), so scale by N to cover all N microbatches.
+        # This overcounts the once-per-step optimizer tail by (N-1)× —
+        # visible only at toy widths (~10% on t5-test), vanishing at real
+        # model widths where the tail is <0.1% of model flops.
+        flops = float((ca or {}).get("flops", 0.0)) * mesh_size * int(grad_accum_steps)
     except Exception:
         pass
     if flops <= 0.0:
@@ -196,6 +203,7 @@ def train_step_static_gauges(
         "model": model_name,
         "mesh": dict(mesh.shape),
         "global_batch": global_batch,
+        "grad_accum_steps": int(grad_accum_steps),
         "params": n_params,
         "tokens_per_step": tokens_per_step,
         "flops_per_step": flops,

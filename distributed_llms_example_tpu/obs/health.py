@@ -28,6 +28,14 @@ module is their consumer — host-side, cadence-gated:
 Host clocks and floats only; the one ``jax.device_get`` lives in
 ``to_host`` and runs only at the cadence (pinned by the repo lint's
 step-cadence sync rule and tests/test_health.py).
+
+Every "step" here is an OPTIMIZER step: under in-step gradient
+accumulation (``--grad-accum-steps N``) the compiled step scans N
+microbatches internally and returns ONE metrics dict from the single
+clip/AdamW/health tail, so the watchdog's EWMAs, warmup counter, and
+anomaly attribution all advance once per optimizer step regardless of N
+— microbatches are invisible to this layer by construction (pinned by
+tests/test_health.py).
 """
 
 from __future__ import annotations

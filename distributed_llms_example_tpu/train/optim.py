@@ -44,6 +44,27 @@ def decay_mask(params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(is_decay, params)
 
 
+def multisteps_reference(
+    tx: optax.GradientTransformation, accum_steps: int
+) -> optax.GradientTransformation:
+    """The ``optax.MultiSteps`` twin of the in-step scan accumulation —
+    the cross-check oracle for tests (tests/test_train_step.py).
+
+    ``use_grad_mean=False`` so MultiSteps accumulates the gradient SUM in
+    the same order the scan does (zeros, then += microbatch grads one at
+    a time) and applies the inner transformation exactly once on the
+    k-th microbatch — the same single-apply contract as
+    ``train/step.py optimizer_apply_block``.  Fed the identical
+    normalized gradient stream, its inner apply is bit-equal to ours
+    (same optax ``tx``, same inputs); fed raw per-microbatch gradients
+    it converges to the same params up to fp32 summation-distribution
+    error (the scan divides the sum once, MultiSteps sums pre-divided
+    terms)."""
+    return optax.MultiSteps(
+        tx, every_k_schedule=int(accum_steps), use_grad_mean=False
+    )
+
+
 def make_optimizer(
     *,
     learning_rate: float = 5e-5,

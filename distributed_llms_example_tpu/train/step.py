@@ -15,6 +15,27 @@ mechanisms (torchrun-DDP, Accelerate, hand-rolled NCCL loops):
   TPU-native form of ``gradient_accumulation_steps=16``,
   reference train-torchrun.py:126), accumulating token-weighted loss and
   gradient sums so the result is exactly the full-batch gradient.
+
+Gradient accumulation invariants (the in-step microbatching contract):
+
+- the fp32 accumulators are sharded EXACTLY like the parameters
+  (``accumulator_shardings`` is the one mirror; an explicit
+  ``with_sharding_constraint`` pins the scan carry so FSDP keeps its
+  reduce-scatter gradient shape and the accumulators never replicate —
+  per the weight-update-sharding recipe of arXiv:2004.13336);
+- microbatches are cut SHARD-LOCALLY when the microbatch divides the
+  batch shards: each device scans over slices of rows it already holds,
+  so the (B,) → (N, B/N) regrouping costs zero collectives.  Loss and
+  gradient sums are additive over rows, so any partition of the batch
+  into microbatches yields the identical optimizer step;
+- clip + AdamW + the health numerics run ONCE per optimizer step, after
+  the scan (``optimizer_apply_block`` — a named function so the IR lint
+  can prove from compiled-HLO metadata that none of it slid into the
+  scan body), amortizing the non-layer overhead over N microbatches;
+- a global batch is ONE optimizer step regardless of ``accum_steps``:
+  the data iterator, the step counter, checkpoints, and the health
+  watchdog all count optimizer steps, so O(1) resume lands on an
+  optimizer-step boundary by construction.
 """
 
 from __future__ import annotations
@@ -127,6 +148,73 @@ def health_metrics(params: Any, grads: Any, updates: Any) -> dict[str, jnp.ndarr
 
 def create_train_state(params: Any, tx: optax.GradientTransformation) -> TrainState:
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+
+
+def accumulator_shardings(param_shardings: Any) -> Any:
+    """Shardings for the in-step fp32 gradient accumulators: EXACTLY the
+    param shardings, leaf for leaf.
+
+    This identity is THE accumulator layout contract — the scan carry is
+    constrained with it, ``analysis/spec_lint.py`` lints against it, and
+    the compiled-carry test pins it — so the three cannot drift.  Anything
+    else either replicates a param-sized fp32 tree per device (the memory
+    cliff accumulation exists to avoid) or forces GSPMD to reshard every
+    microbatch's gradients against the carry."""
+    return jax.tree.map(lambda s: s, param_shardings)
+
+
+def optimizer_apply_block(
+    state: TrainState,
+    tx: optax.GradientTransformation,
+    schedule: optax.Schedule,
+    lsum: jnp.ndarray,
+    tokens: jnp.ndarray,
+    grads: Any,
+    *,
+    health: bool,
+) -> tuple[TrainState, dict]:
+    """The once-per-optimizer-step tail: normalize the token-weighted
+    sums, clip + AdamW, and the health numerics.
+
+    A NAMED function on purpose: jax stamps each HLO instruction with the
+    first non-library source frame, so everything traced here (including
+    optax's clip/adamw internals, attributed to the call lines below)
+    carries this function's source span — ``once_per_step_source_spans``
+    hands that span to ``analysis/ir_lint.py``, which proves on the
+    compiled program that none of it was scheduled inside the
+    grad-accumulation scan body, i.e. the optimizer genuinely runs once
+    per step regardless of ``accum_steps``."""
+    tokens = jnp.maximum(tokens, 1.0)
+    loss = lsum / tokens
+    grads = jax.tree.map(lambda g: (g / tokens).astype(jnp.float32), grads)
+    updates, new_opt = tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
+    metrics = {
+        "loss": loss,
+        "learning_rate": schedule(state.step),
+        "grad_norm": optax.global_norm(grads),
+        "target_tokens": tokens,
+    }
+    if health:
+        metrics.update(health_metrics(state.params, grads, updates))
+    return new_state, metrics
+
+
+def once_per_step_source_spans() -> list[tuple[str, int, int]]:
+    """``(source_file, first_line, last_line)`` spans of the code that
+    must execute exactly once per optimizer step — ``optimizer_apply_block``
+    plus the health-numerics helpers it calls (their bodies are user code,
+    so jax attributes their instructions to these lines, not to the apply
+    block's call site).  Computed from the live source so the spans track
+    edits; consumed by ``ir_lint.once_per_step_placement``."""
+    import inspect
+
+    spans = []
+    for fn in (optimizer_apply_block, health_metrics, _bucket_sumsq):
+        lines, first = inspect.getsourcelines(fn)
+        spans.append((inspect.getsourcefile(fn), first, first + len(lines) - 1))
+    return spans
 
 
 def cross_entropy_sums(
@@ -271,6 +359,15 @@ def make_train_step(
     a sharding over a non-divisible length is a dispatch-time error, not
     a graceful fallback.
     """
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    if grad_accum_steps > 1 and hasattr(model, "num_microbatches"):
+        # stage>1 pipeline adapters own their microbatching; the table row
+        # owns the message (analysis/composition.py — the Trainer checks
+        # the same row at startup, this deep guard catches direct callers)
+        from distributed_llms_example_tpu.analysis.composition import reason_for
+
+        raise ValueError(reason_for("grad-accum-pipelined"))
     loss_sums = make_loss_fn(model, config, label_smoothing, is_seq2seq=is_seq2seq)
     seq_sharded = (
         sequence_sharded
@@ -299,46 +396,76 @@ def make_train_step(
             (lsum, tokens), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
             return lsum, tokens, grads
 
-    def step_fn(state: TrainState, batch: dict, rng: jax.Array | None = None) -> tuple[TrainState, dict]:
-        if grad_accum_steps > 1:
-            micro = jax.tree.map(
-                lambda x: x.reshape(grad_accum_steps, x.shape[0] // grad_accum_steps, *x.shape[1:]),
-                batch,
-            )
-            micro = jax.lax.with_sharding_constraint(micro, jax.tree.map(lambda _: micro_sharding, batch))
+    def make_step_fn(accum_sh: Any) -> Callable:
+        """The step body, closed over the accumulator shardings (the
+        mirror of the param shardings — ``accumulator_shardings``) so the
+        scan carry is PINNED to the param layout: under FSDP each
+        device's accumulator holds exactly its gradient shard, gradients
+        reduce-scatter straight into it, and the fp32 tree never
+        replicates.  ``accum_sh=None`` (abstract callers without resolved
+        shardings) leaves the layout to GSPMD."""
 
-            def body(carry, mb):
-                lsum_acc, tok_acc, g_acc, i = carry
-                r = jax.random.fold_in(rng, i) if rng is not None else None
-                lsum, tokens, grads = value_and_grad_sums(state.params, mb, r)
-                return (
-                    lsum_acc + lsum,
-                    tok_acc + tokens,
-                    jax.tree.map(jnp.add, g_acc, grads),
-                    i + 1,
-                ), None
+        def step_fn(state: TrainState, batch: dict, rng: jax.Array | None = None) -> tuple[TrainState, dict]:
+            if grad_accum_steps > 1:
+                b = jax.tree.leaves(batch)[0].shape[0]
+                if b % grad_accum_steps:
+                    raise ValueError(
+                        f"global batch {b} is not divisible by "
+                        f"grad_accum_steps={grad_accum_steps}"
+                    )
+                # Shard-local microbatch grouping: row r joins microbatch
+                # r mod N (reshape to (B/N, N, ...) then swap), NOT the
+                # contiguous slab r // (B/N).  With the batch sharded
+                # contiguously over devices on dim 0, each device's rows
+                # land wholly inside its own shard of every microbatch —
+                # the slab grouping would instead scatter each microbatch
+                # across device boundaries and GSPMD would pay an
+                # all-to-all per step.  Loss and gradient sums are
+                # additive over rows, so any grouping yields the same
+                # optimizer step.
+                micro = jax.tree.map(
+                    lambda x: jnp.swapaxes(
+                        x.reshape(x.shape[0] // grad_accum_steps, grad_accum_steps, *x.shape[1:]),
+                        0,
+                        1,
+                    ),
+                    batch,
+                )
+                micro = jax.lax.with_sharding_constraint(
+                    micro, jax.tree.map(lambda _: micro_sharding, batch)
+                )
 
-            zero_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            (lsum, tokens, grads, _), _ = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zero_g, 0), micro
+                def pin(g_acc: Any) -> Any:
+                    if accum_sh is None:
+                        return g_acc
+                    return jax.lax.with_sharding_constraint(g_acc, accum_sh)
+
+                def body(carry, mb):
+                    lsum_acc, tok_acc, g_acc, i = carry
+                    r = jax.random.fold_in(rng, i) if rng is not None else None
+                    lsum, tokens, grads = value_and_grad_sums(state.params, mb, r)
+                    g_acc = pin(
+                        jax.tree.map(
+                            lambda a, g: a + g.astype(jnp.float32), g_acc, grads
+                        )
+                    )
+                    return (lsum_acc + lsum, tok_acc + tokens, g_acc, i + 1), None
+
+                zero_g = pin(
+                    jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                )
+                (lsum, tokens, grads, _), _ = jax.lax.scan(
+                    body,
+                    (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zero_g, 0),
+                    micro,
+                )
+            else:
+                lsum, tokens, grads = value_and_grad_sums(state.params, batch, rng)
+            return optimizer_apply_block(
+                state, tx, schedule, lsum, tokens, grads, health=health
             )
-        else:
-            lsum, tokens, grads = value_and_grad_sums(state.params, batch, rng)
-        tokens = jnp.maximum(tokens, 1.0)
-        loss = lsum / tokens
-        grads = jax.tree.map(lambda g: (g / tokens).astype(jnp.float32), grads)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
-        metrics = {
-            "loss": loss,
-            "learning_rate": schedule(state.step),
-            "grad_norm": optax.global_norm(grads),
-            "target_tokens": tokens,
-        }
-        if health:
-            metrics.update(health_metrics(state.params, grads, updates))
-        return new_state, metrics
+
+        return step_fn
 
     # shardings: state per rules; batch over (data, fsdp) with lengths over
     # sequence under context parallelism; rng replicated
@@ -352,6 +479,12 @@ def make_train_step(
 
     def jit_it(state_sh: Any) -> Callable:
         metrics_sh = {k: repl for k in metric_keys}
+        # the fp32 gradient accumulators mirror the param shardings leaf
+        # for leaf — the weight-update-sharding contract the spec lint
+        # checks and the compiled-carry test pins
+        step_fn = make_step_fn(
+            accumulator_shardings(state_sh.params) if grad_accum_steps > 1 else None
+        )
         in_shardings = (state_sh, {"input_ids": bsh, "attention_mask": bsh, "labels": bsh})
         if with_dropout:
             jitted = jax.jit(

@@ -272,8 +272,33 @@ class Trainer:
                 fused_ce=cfg.fused_ce,
                 attention_impl=cfg.attention_impl,
                 num_experts=int(getattr(self.config, "num_experts", 0) or 0),
+                grad_accum_steps=cfg.grad_accum_steps,
             ),
         )
+
+        # In-step gradient accumulation: batch_size stays the EFFECTIVE
+        # optimizer batch (one iterator batch = one optimizer step, so the
+        # epoch/resume contract is untouched); the compiled step cuts it
+        # into N shard-local microbatches.  Validate the divisibility the
+        # regrouping needs against the actual mesh, before any compile.
+        if cfg.grad_accum_steps > 1:
+            from distributed_llms_example_tpu.data.batching import microbatch_size
+
+            batch_shards = 1
+            for ax in ("data", "fsdp", "expert"):
+                batch_shards *= self.mesh.shape.get(ax, 1)
+            micro = microbatch_size(
+                cfg.batch_size,
+                cfg.grad_accum_steps,
+                batch_shards=batch_shards,
+                process_count=jax.process_count(),
+            )
+            log_json({
+                "event": "grad_accum",
+                "grad_accum_steps": cfg.grad_accum_steps,
+                "effective_batch": cfg.batch_size,
+                "microbatch": micro,
+            })
 
         # attn_dropout_rate alone (e.g. an HF checkpoint with
         # attention_dropout > 0 but dropout 0, or a llama recipe enabling
@@ -898,6 +923,20 @@ class Trainer:
                 # stop the producer thread even when the loop body raises
                 if isinstance(epoch_batches, Prefetcher):
                     epoch_batches.close()
+                    # the per-run "is the input pipeline on the critical
+                    # path?" answer (host counters, once per epoch): a
+                    # consumer_wait_s near the first batch's assembly time
+                    # means the thread hid everything (device-bound loop —
+                    # BENCH_r05's prefetch2 ≈ prefetch0); wait growing with
+                    # items means the producer cannot keep up
+                    s = epoch_batches.stats()
+                    log_json({
+                        "event": "prefetch_stats",
+                        "epoch": epoch,
+                        "depth": cfg.prefetch_batches,
+                        "items": s["items"],
+                        "consumer_wait_s": round(s["consumer_wait_s"], 4),
+                    })
             # Epoch boundary: a SIGTERM that landed between sync steps may
             # have set only the LOCAL flag (the cadence check above skipped
             # it) — acting on it here un-agreed would desynchronize the

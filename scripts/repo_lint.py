@@ -34,6 +34,19 @@ Five rules, all born from real regressions at TPU scale:
    build-time constructors) allowlisted by name; a conversion anywhere
    else in those files fails here.
 
+5a. **No second gradient-accumulation layer in models/ and train/.**
+   ``train/step.py`` owns in-step accumulation (the lax.scan with fp32
+   accumulators sharded like the params, ONE optimizer apply per step)
+   and the pipeline executors (parallel/) own their schedule-internal
+   microbatching.  A manual ``acc += grads`` / ``tree.map(add, acc,
+   grads)`` anywhere else in models/ or train/ is a rogue third layer:
+   it would double-count against the step's scan, its accumulators would
+   carry no sharding contract (a replicated fp32 param-tree per device),
+   and the once-per-step optimizer census could no longer prove
+   anything.  Flagged: augmented ``+=`` on grad-named values and
+   tree-map calls combining an add with grad-named operands, outside
+   ``train/step.py``.
+
 5. **No raw dropout primitives in models/ and train/.**  ``nn.Dropout``
    or ``jax.random.bernoulli`` in a model or train file bypasses the
    shared dropout helper (``ops/fused_dropout.py``) — the call site would
@@ -128,6 +141,81 @@ DROPOUT_RULE_DIRS = (
     os.path.join(PACKAGE, "train"),
 )
 
+# Rule 5a: gradient accumulation is owned by train/step.py (the in-step
+# scan) and the pipeline executors (parallel/); a manual accumulator
+# anywhere else in these dirs is a rogue second accumulation layer.
+GRAD_ACCUM_RULE_DIRS = DROPOUT_RULE_DIRS
+GRAD_ACCUM_OWNER = os.path.join(PACKAGE, "train", "step.py")
+_GRAD_NAMES = ("grad", "grads", "gradient")
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id.lower())
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr.lower())
+    return out
+
+
+def _is_grad_named(node: ast.AST) -> bool:
+    return any(
+        any(g in name for g in _GRAD_NAMES) for name in _names_in(node)
+    )
+
+
+def _is_add_fn(node: ast.AST) -> bool:
+    """jnp.add / np.add / operator.add / a bare ``add`` / an add-lambda."""
+    if isinstance(node, ast.Attribute) and node.attr == "add":
+        return True
+    if isinstance(node, ast.Name) and node.id == "add":
+        return True
+    if isinstance(node, ast.Lambda) and isinstance(node.body, ast.BinOp):
+        return isinstance(node.body.op, ast.Add)
+    return False
+
+
+def _grad_accum_violations(tree: ast.AST, rel: str) -> list[str]:
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.AugAssign)
+            and isinstance(node.op, ast.Add)
+            and (_is_grad_named(node.target) or _is_grad_named(node.value))
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: manual '+=' gradient accumulator "
+                "outside train/step.py — the compiled step owns in-step "
+                "accumulation (sharded fp32 carry, one optimizer apply per "
+                "step) and the pipeline executors own their microbatching; "
+                "a third layer double-accumulates with no sharding contract"
+            )
+        elif (
+            isinstance(node, ast.Call)
+            and (
+                (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("map", "tree_map", "tree_multimap")
+                )
+                or (
+                    # `from jax.tree_util import tree_map` must not evade
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("tree_map", "tree_multimap")
+                )
+            )
+            and node.args
+            and _is_add_fn(node.args[0])
+            and any(_is_grad_named(a) for a in node.args[1:])
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: tree-map(add, ..., grads) "
+                "accumulator outside train/step.py — use "
+                "make_train_step(..., grad_accum_steps=N); the step owns "
+                "accumulation (sharded fp32 carry, one optimizer apply)"
+            )
+    return violations
+
 
 def _is_json_dumps_call(node: ast.AST) -> bool:
     return (
@@ -207,6 +295,10 @@ def lint_file(path: str, rel: str) -> list[str]:
     )
     if rel in STEP_CADENCE_FILES:
         violations.extend(_cadence_violations(tree, rel, STEP_CADENCE_FILES[rel]))
+    if rel != GRAD_ACCUM_OWNER and any(
+        rel.startswith(d + os.sep) for d in GRAD_ACCUM_RULE_DIRS
+    ):
+        violations.extend(_grad_accum_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)

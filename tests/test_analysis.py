@@ -122,6 +122,8 @@ def test_default_rules_clean_on_llama_fsdp():
 
 BAD_CASES = [
     # (row id, family, schedule, mesh axes, flags)
+    ("grad-accum-pipelined", "llama", "gpipe", {"stage": 2, "data": 2},
+     ("pipelined", "grad_accum")),
     ("seq2seq-1f1b-fsdp", "bart", "1f1b", {"stage": 2, "fsdp": 2}, ("pipelined",)),
     ("seq2seq-1f1b-fsdp", "t5", "1f1b", {"stage": 4, "fsdp": 2}, ("pipelined",)),
     ("seq2seq-interleaved", "bart", "interleaved", {"stage": 2}, ("pipelined",)),
@@ -158,6 +160,10 @@ def test_good_combos_do_not_fire():
         ("bart", "1f1b", {"stage": 2, "data": 2, "tensor": 2}, ("pipelined",)),
         ("llama", None, {"data": 4, "fsdp": 2}, ("fused_ce",)),
         ("t5", None, {"data": 4, "sequence": 2}, ()),
+        # in-step accumulation composes with every GSPMD mesh; only
+        # stage>1 (the pipeline's own microbatching) is condemned
+        ("llama", None, {"data": 4, "fsdp": 2}, ("grad_accum",)),
+        ("bart", None, {"data": 2, "fsdp": 2, "tensor": 2}, ("grad_accum",)),
     ]:
         composition.validate_composition(
             family=family, schedule=schedule, mesh_axes=axes, flags=flags
@@ -561,3 +567,178 @@ def test_repo_lint_clean_and_catches_violations(tmp_path):
     )
     rel = os.path.join("distributed_llms_example_tpu", "models", "okmodel.py")
     assert repo_lint.lint_file(str(ok_drop), rel) == []
+
+
+# ---------------------------------------------------------------------------
+# grad accumulation (ISSUE 5): accumulator-mirror spec lint, the
+# once-per-step placement census, the ppermute-chain smell, rule 5a
+# ---------------------------------------------------------------------------
+
+
+def test_spec_lint_accumulator_mirror_clean_and_catches_drift(monkeypatch):
+    """The fp32 accumulators must mirror the param specs leaf for leaf:
+    the live accumulator_shardings is the identity (clean), and an edit
+    that replicates the accumulators is an error naming the leaf."""
+    import distributed_llms_example_tpu.train.step as step_mod
+    from distributed_llms_example_tpu.analysis.spec_lint import lint_accumulator_mirror
+
+    a_params = _abstract_llama_params()
+    assert lint_accumulator_mirror(a_params) == []
+
+    # a drifted implementation: replicate every accumulator leaf
+    monkeypatch.setattr(
+        step_mod, "accumulator_shardings",
+        lambda tree: jax.tree.map(lambda s: P(), tree),
+    )
+    findings = lint_accumulator_mirror(a_params)
+    assert findings and all(f.severity == "error" for f in findings)
+    assert {f.code for f in findings} == {"accumulator-spec-mismatch"}
+    # only the genuinely sharded leaves drifted (replicated ones still match)
+    assert any("kernel" in f.message for f in findings)
+
+
+def test_ir_once_per_step_placement_fixture():
+    """Hand-built HLO: the census attributes span-stamped instructions to
+    their computation, and the finding fires iff optimizer code sits in a
+    while-body (or warns when the metadata is missing entirely)."""
+    from distributed_llms_example_tpu.analysis.ir_lint import (
+        once_per_step_finding,
+        once_per_step_placement,
+    )
+    from distributed_llms_example_tpu.train.step import once_per_step_source_spans
+
+    spans = once_per_step_source_spans()
+    f, first, _last = spans[0]
+    meta = f'metadata={{op_name="adamw" source_file="{f}" source_line={first}}}'
+
+    def prog(opt_in_body: bool) -> str:
+        body_extra = f"\n  %opt.b = f32[] add(f32[] %g.1, f32[] %g.1), {meta}" if opt_in_body else ""
+        entry_extra = "" if opt_in_body else f"\n  %opt.e = f32[] add(f32[] %c.1, f32[] %c.1), {meta}"
+        return f"""HloModule fixture
+
+%body.1 (p.1: (s32[], f32[])) -> (s32[], f32[]) {{
+  %p.1 = (s32[], f32[]) parameter(0)
+  %i.1 = s32[] get-tuple-element((s32[], f32[]) %p.1), index=0
+  %g.1 = f32[] get-tuple-element((s32[], f32[]) %p.1), index=1{body_extra}
+  ROOT %t.1 = (s32[], f32[]) tuple(%i.1, %g.1)
+}}
+
+%cond.1 (q.1: (s32[], f32[])) -> pred[] {{
+  %q.1 = (s32[], f32[]) parameter(0)
+  ROOT %lt.1 = pred[] compare(s32[] %j.1, s32[] %n.1), direction=LT
+}}
+
+ENTRY %main.1 (a.1: f32[]) -> f32[] {{
+  %c.1 = f32[] parameter(0)
+  %init.1 = (s32[], f32[]) tuple(s32[] %z.1, f32[] %c.1)
+  %w.1 = (s32[], f32[]) while((s32[], f32[]) %init.1), condition=%cond.1, body=%body.1{entry_extra}
+  ROOT %r.1 = f32[] get-tuple-element((s32[], f32[]) %w.1), index=1
+}}
+"""
+
+    good = prog(opt_in_body=False)
+    census = once_per_step_placement(good, spans)
+    assert census == {"total": 1, "in_loop": 0, "in_loop_examples": []}
+    assert once_per_step_finding(good, spans) is None
+
+    bad = prog(opt_in_body=True)
+    census = once_per_step_placement(bad, spans)
+    assert census["total"] == 1 and census["in_loop"] == 1
+    finding = once_per_step_finding(bad, spans)
+    assert finding is not None and finding.severity == "error"
+    assert finding.code == "optimizer-in-scan-body"
+
+    # no span-stamped instruction at all: the census proves nothing → warning
+    empty = prog(opt_in_body=False).replace(meta, "")
+    finding = once_per_step_finding(empty, spans)
+    assert finding is not None and finding.severity == "warning"
+    assert finding.code == "optimizer-census-empty"
+
+
+_PPERMUTE_CHAIN_HLO = """\
+HloModule rings
+
+ENTRY %main {
+  %p0 = f32[64]{0} parameter(0)
+  %cp.1 = f32[64]{0} collective-permute(f32[64]{0} %p0), source_target_pairs={{0,1},{1,0}}
+  %cp.2 = f32[64]{0} collective-permute(f32[64]{0} %cp.1), source_target_pairs={{0,1},{1,0}}
+  %cp.3 = f32[64]{0} collective-permute(f32[64]{0} %cp.2), source_target_pairs={{0,1},{1,0}}
+  ROOT %t.1 = f32[64]{0} tuple(%cp.3)
+}
+"""
+
+
+def test_ir_ppermute_chain_smell_fixture():
+    """The ROADMAP smell, pinned on a hand-built 3-permute dependency
+    chain: longer than the stage ring → warning with the chain length;
+    within the ring, or no stage axis → silent."""
+    from distributed_llms_example_tpu.analysis.ir_lint import (
+        parse_hlo_instructions,
+        ppermute_chain_smell,
+    )
+
+    instrs = parse_hlo_instructions(_PPERMUTE_CHAIN_HLO)
+    smell = ppermute_chain_smell(instrs, {"stage": 2})
+    assert smell is not None and smell.severity == "warning"
+    assert smell.code == "ppermute-chain-exceeds-stage-ring"
+    assert smell.context == {"chain_length": 3, "stage": 2}
+    # a 3-hop chain fits a 4-stage ring; stage=1 has no ring at all
+    assert ppermute_chain_smell(instrs, {"stage": 4}) is None
+    assert ppermute_chain_smell(instrs, {"stage": 1, "data": 8}) is None
+    # mixed stage x sequence: ring/context-parallel permutes chain once
+    # per layer and are textually indistinguishable — the smell stands down
+    assert ppermute_chain_smell(instrs, {"stage": 2, "sequence": 2}) is None
+    # wired into the scanner (stage>1 meshes only)
+    findings = scan_hlo_text(_PPERMUTE_CHAIN_HLO, mesh_axes={"stage": 2, "data": 2})
+    assert "ppermute-chain-exceeds-stage-ring" in _codes(findings)
+    findings = scan_hlo_text(_PPERMUTE_CHAIN_HLO, mesh_axes={"data": 8})
+    assert "ppermute-chain-exceeds-stage-ring" not in _codes(findings)
+
+
+def test_cli_grad_accum_pipelined_composition(capsys):
+    """--grad-accum-steps > 1 on a stage>1 mesh is condemned by the
+    composition table before any compile."""
+    rc, findings = _run_cli(
+        capsys, "--model", "llama-test", "--mesh", "stage=2,data=2",
+        "--grad-accum-steps", "2", "--no-ir",
+    )
+    assert rc == 1
+    assert any(f.get("code") == "grad-accum-pipelined" for f in findings)
+
+
+def test_repo_lint_grad_accum_rule(tmp_path):
+    """Rule 5a: a manual gradient accumulator outside train/step.py is a
+    rogue second accumulation layer — flagged in models/ and train/,
+    exempt in the owning file and in parallel/ (the pipeline executors'
+    schedule-internal microbatching)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    repo_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repo_lint)
+
+    bad = tmp_path / "acc.py"
+    bad.write_text(
+        "import jax\n"
+        "from jax.tree_util import tree_map\n"
+        "def f(acc, grads, loss, x):\n"
+        "    acc += grads\n"
+        "    acc = jax.tree.map(lambda a, g: a + g, acc, grads)\n"
+        "    acc = tree_map(lambda a, g: a + g, acc, grads)\n"  # bare-name import must not evade
+        "    loss += x\n"  # non-gradient accumulator stays legal
+        "    return acc, loss\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "models", "acc.py")
+    assert len(repo_lint.lint_file(str(bad), rel)) == 3
+    rel = os.path.join("distributed_llms_example_tpu", "train", "acc.py")
+    assert len(repo_lint.lint_file(str(bad), rel)) == 3
+    # the owner is exempt — train/step.py IS the accumulation layer
+    rel = os.path.join("distributed_llms_example_tpu", "train", "step.py")
+    assert repo_lint.lint_file(str(bad), rel) == []
+    # parallel/ owns the pipeline executors' microbatching
+    rel = os.path.join("distributed_llms_example_tpu", "parallel", "acc.py")
+    assert repo_lint.lint_file(str(bad), rel) == []

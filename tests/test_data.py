@@ -247,3 +247,22 @@ def test_epoch_start_step_resumes_without_assembly():
         np.testing.assert_array_equal(tail[0][k], full[3][k])
     # only the ONE remaining batch's examples were encoded (src + tgt each)
     assert tok.calls == 2 * 8
+
+
+def test_microbatch_size_contract():
+    """The (global batch, accumulation, sharding) validation: one iterator
+    batch stays one optimizer step; every failure names the offending
+    numbers."""
+    from distributed_llms_example_tpu.data.batching import microbatch_size
+
+    assert microbatch_size(16, 4) == 4
+    assert microbatch_size(16, 4, batch_shards=4, process_count=2) == 4
+    assert microbatch_size(8, 1, batch_shards=8) == 8
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        microbatch_size(16, 0)
+    with pytest.raises(ValueError, match="not divisible"):
+        microbatch_size(10, 4)
+    with pytest.raises(ValueError, match="batch shards"):
+        microbatch_size(16, 4, batch_shards=8)
+    with pytest.raises(ValueError, match="processes"):
+        microbatch_size(16, 4, batch_shards=2, process_count=3)

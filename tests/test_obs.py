@@ -701,3 +701,34 @@ def test_repo_lint_forbids_rogue_json_print(tmp_path):
     assert repo_lint.lint_file(str(rogue), rel) == []
     # and the repo itself stays clean under the new rule
     assert repo_lint.main([]) == 0
+
+
+@pytest.mark.slow  # two AOT gauge compiles: slow tier
+def test_mfu_flops_invariant_under_grad_accum():
+    """The MFU numerator is ×N-corrected under grad accumulation: XLA's
+    cost analysis counts the scan's while body exactly ONCE (measured on
+    jax 0.4.37 — without the correction MFU would underreport by ~N), so
+    gauges.py scales by grad_accum_steps.  At the same effective batch
+    the corrected flops match accum=1 from below (equal model flops) and
+    exceed it only by N-1 extra optimizer tails + loop bookkeeping —
+    ~10% at this toy width, ~0 at real widths.  grad_accum_steps is
+    stamped into the gauge report."""
+    from distributed_llms_example_tpu.obs.gauges import train_step_static_gauges
+
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, sequence=1, tensor=2))
+    reports = {}
+    for n in (1, 4):
+        reports[n] = train_step_static_gauges(
+            "t5-test", mesh, global_batch=16, src_len=32, tgt_len=16,
+            dtype="bfloat16", grad_accum_steps=n,
+        )
+    assert reports[1]["grad_accum_steps"] == 1
+    assert reports[4]["grad_accum_steps"] == 4
+    assert reports[1]["flops_source"] == reports[4]["flops_source"] == "hlo_cost_analysis"
+    f1, f4 = reports[1]["flops_per_step"], reports[4]["flops_per_step"]
+    assert f1 > 0
+    # same effective batch → same model flops, so the ×N-corrected accum
+    # count brackets accum=1: at least f1 (nothing lost — an uncorrected
+    # body-counted-once number would sit at ~f1/4), at most f1 + the
+    # (N-1) duplicated optimizer tails (~10% at this toy width)
+    assert f1 * 0.98 <= f4 <= f1 * 1.2

@@ -121,3 +121,37 @@ def test_prefetcher_close_stops_producer():
     time.sleep(0.2)
     assert len(produced) == n_after_close, "producer kept running after close()"
     assert n_after_close < 1000
+
+
+def test_prefetch_stats_locate_the_blocking_side():
+    """The span-based answer to BENCH_r05's 'prefetch2 ≈ prefetch0'
+    puzzle, as a regression test: stats() must show a large consumer wait
+    when the producer is the bottleneck (prefetch cannot hide it) and a
+    near-zero wait when the consumer is (the device-bound trainer loop —
+    depth buys nothing because there is nothing to hide)."""
+    import time as _time
+
+    def slow_producer():
+        for i in range(10):
+            _time.sleep(0.02)
+            yield i
+
+    pf = Prefetcher(slow_producer(), depth=2)
+    assert list(pf) == list(range(10))
+    s_producer_bound = pf.stats()
+    assert s_producer_bound["items"] == 10
+    # ~0.2 s of production blocked the consumer
+    assert s_producer_bound["consumer_wait_s"] > 0.1
+
+    pf = Prefetcher(iter(range(10)), depth=2)
+    got = []
+    for x in pf:
+        _time.sleep(0.005)  # consumer-bound: producer always ahead
+        got.append(x)
+    assert got == list(range(10))
+    s = pf.stats()
+    assert s["items"] == 10
+    # relative, not an absolute wall-clock bound (a scheduler stall on a
+    # loaded runner must not flake this): the consumer-bound wait is a
+    # small fraction of the producer-bound one
+    assert s["consumer_wait_s"] < s_producer_bound["consumer_wait_s"] / 2

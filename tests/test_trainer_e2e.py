@@ -193,3 +193,86 @@ def test_preemption_checkpoints_and_resumes(tmp_path):
     assert result2.get("preempted") is None
     assert result2["steps"] == total
     assert os.path.isfile(os.path.join(str(tmp_path), "model", "model.safetensors"))
+
+
+@pytest.mark.slow  # two short real training runs: slow tier
+def test_grad_accum_resume_on_optimizer_step_boundary(tmp_path, capsys):
+    """O(1) resume under in-step accumulation: one iterator batch is one
+    optimizer step regardless of grad_accum_steps, so a preemption that
+    lands mid-run resumes exactly on an optimizer-step boundary — there
+    is no 'mid-accumulation-window' state to lose, by construction.  The
+    resumed run completes with the same step accounting as accum=1."""
+    import signal
+
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model_ckpt="t5-test",
+        output_dir=str(tmp_path),
+        batch_size=8,
+        grad_accum_steps=2,
+        num_epochs=2,
+        warmup_steps=0,
+        evaluation_steps=0,
+        max_source_length=64,
+        max_target_length=16,
+        pad_to_multiple=32,
+        num_beams=1,
+        log_every_steps=100,
+        mesh=MeshConfig(data=2, fsdp=2, sequence=1, tensor=2),
+        checkpoint=CheckpointConfig(save_every_steps=0, resume=True, async_save=False),
+        tokenizer="byte",
+    )
+    records = _records()
+
+    trainer = Trainer(cfg, train_records=records)
+    total = trainer.total_steps
+    assert total == 8  # optimizer steps: 32/8 * 2 epochs, independent of accum
+    # startup announces the accumulation config (microbatch = 8/2 = 4 rows)
+    out = capsys.readouterr().out
+    accum_events = [
+        json.loads(ln) for ln in out.splitlines()
+        if ln.startswith("{") and '"grad_accum"' in ln
+    ]
+    assert any(
+        e.get("event") == "grad_accum"
+        and e.get("grad_accum_steps") == 2
+        and e.get("microbatch") == 4
+        for e in accum_events
+    )
+
+    orig = trainer._batch_tokens
+    seen = []
+
+    def hook(batch):
+        seen.append(1)
+        if len(seen) == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return orig(batch)
+
+    trainer._batch_tokens = hook
+    result = trainer.train()
+    assert result.get("preempted") is True
+    assert result["steps"] == 3  # optimizer steps, not microbatches
+
+    resumed = Trainer(cfg, train_records=records)
+    assert resumed.start_step == 3  # O(1) resume on the optimizer-step boundary
+    result2 = resumed.train()
+    assert result2["steps"] == total
+    assert os.path.isfile(os.path.join(str(tmp_path), "model", "model.safetensors"))
+
+    # the per-epoch prefetch counters land in the metric stream: the
+    # production consumer of Prefetcher.stats() (the per-run span-level
+    # answer to whether the input pipeline is on the critical path)
+    out = capsys.readouterr().out
+    pf_events = [
+        json.loads(ln) for ln in out.splitlines()
+        if ln.startswith("{") and '"prefetch_stats"' in ln
+    ]
+    assert pf_events, "trainer did not emit prefetch_stats at epoch end"
+    assert all(
+        e["depth"] == cfg.prefetch_batches
+        and e["items"] >= 1
+        and e["consumer_wait_s"] >= 0.0
+        for e in pf_events
+    )

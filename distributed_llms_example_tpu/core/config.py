@@ -215,8 +215,15 @@ class TrainConfig:
     # what the run does when an anomaly is agreed across hosts:
     # "warn" logs obs_anomaly and continues; "halt" stops the run (no
     # extra save); "checkpoint" force-saves a resumable checkpoint, dumps
-    # the flight recorder, and stops
+    # the flight recorder, and stops; "rewind" recovers IN-PROCESS —
+    # restore the last verified checkpoint, quarantine the anomaly
+    # step's batch by fingerprint so the retry skips it, escalation
+    # rewind → skip-batch → halt (train/recovery.py).  Requires periodic
+    # checkpointing (--save-every-steps) and the flight recorder.
     on_anomaly: str = "warn"
+    # bounded in-process rewind budget for --on-anomaly rewind; once
+    # exhausted the escalation continues skip-batch → halt
+    max_rewinds: int = 2
     # flight-recorder ring capacity in steps (0 = off): the last N steps'
     # metrics + batch fingerprints, dumped on anomaly/SIGTERM/crash
     recorder_steps: int = 256
@@ -229,6 +236,12 @@ class TrainConfig:
     # finite steps the EWMAs absorb before spike/explosion detection arms
     # (the NaN/Inf tripwire is always armed)
     health_warmup_steps: int = 20
+
+    # --- chaos (obs/chaos.py): deterministic fault injection, e.g.
+    #     "nan_grad@120,ckpt_corrupt@2,data_error@300,sigterm@240" —
+    #     every firing is logged as a chaos_injection event so obs.report
+    #     separates injected faults from organic ones ("" = off) ---
+    chaos: str = ""
 
     # --- profiling (SURVEY.md §7 step 8: jax.profiler hooks; the reference's
     #     only "profiling" is an nvidia-smi report at startup) ---
@@ -383,10 +396,26 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument(
         "--on-anomaly", type=str, default=_D.on_anomaly,
-        choices=("warn", "halt", "checkpoint"),
-        help="agreed-anomaly policy: warn and continue, halt the run, or "
+        choices=("warn", "halt", "checkpoint", "rewind"),
+        help="agreed-anomaly policy: warn and continue, halt the run, "
              "force-save a resumable checkpoint + flight-recorder bundle "
-             "and stop",
+             "and stop, or rewind — restore the last verified checkpoint "
+             "in-process, quarantine the poison batch, and retry "
+             "(escalation rewind -> skip-batch -> halt; needs "
+             "--save-every-steps and the flight recorder)",
+    )
+    p.add_argument(
+        "--max-rewinds", type=int, default=_D.max_rewinds,
+        help="in-process rewind budget for --on-anomaly rewind; exhausted "
+             "budget escalates skip-batch -> halt",
+    )
+    p.add_argument(
+        "--chaos", type=str, default=_D.chaos,
+        help="deterministic fault injection: comma list of kind@tick with "
+             "kind in nan_grad/ckpt_corrupt/data_error/sigterm (tick = "
+             "global step; for ckpt_corrupt the Nth checkpoint save), "
+             "e.g. 'nan_grad@120,ckpt_corrupt@2'; every firing is logged "
+             "as a chaos_injection event",
     )
     p.add_argument(
         "--recorder-steps", type=int, default=_D.recorder_steps,
@@ -466,4 +495,26 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "EFFECTIVE optimizer batch; the step cuts it into "
             "grad-accum-steps equal microbatches"
         )
+    # rewind recovery has hard prerequisites — surface them at parse time
+    # with a fix-it, not as a mid-run halt the first time an anomaly fires
+    if cfg.max_rewinds < 0:
+        raise ValueError(f"--max-rewinds must be >= 0, got {cfg.max_rewinds}")
+    if cfg.on_anomaly == "rewind":
+        if cfg.checkpoint.save_every_steps <= 0:
+            raise ValueError(
+                "--on-anomaly rewind needs periodic checkpointing to rewind "
+                "TO: set --save-every-steps N (N bounds the optimizer steps "
+                "one recovery can lose)"
+            )
+        if cfg.recorder_steps <= 0:
+            raise ValueError(
+                "--on-anomaly rewind quarantines the poison batch via the "
+                "flight recorder's fingerprints: set --recorder-steps N "
+                "(default 256) instead of 0"
+            )
+    if cfg.chaos:
+        # grammar errors fail here, not at injection time mid-run
+        from distributed_llms_example_tpu.obs.chaos import parse_chaos
+
+        parse_chaos(cfg.chaos)
     return cfg

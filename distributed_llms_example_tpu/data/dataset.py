@@ -38,12 +38,51 @@ SOURCE_COLUMNS = ("dialogue", "article", "document", "text")
 TARGET_COLUMNS = ("summary", "highlights", "target")
 
 
-def load_json_records(path: str) -> Sequence[dict]:
+DATA_READ_RETRIES = 3  # transient-I/O retry budget for load_json_records
+
+
+def load_json_records(
+    path: str, *, retries: int = DATA_READ_RETRIES, backoff_s: float = 0.1
+) -> Sequence[dict]:
     """Load a JSON array / JSONL / {"data": [...]} file into records.
 
     JSONL goes through the native C++ loader when it is available (returns
     a lazy zero-copy sequence); anything the native parser rejects — and
-    the non-line-delimited layouts — takes the Python path."""
+    the non-line-delimited layouts — takes the Python path.
+
+    Robustness (ISSUE 6): transient read errors (a flaky NFS/GCS mount
+    mid-preemption-storm) retry with capped exponential backoff instead
+    of killing the run at startup; malformed JSONL lines are skipped with
+    a counter surfaced as a ``data_skipped_records`` event instead of
+    killing the epoch — one corrupt line in a million-record corpus is a
+    data bug to report, not a reason to lose the pod reservation."""
+    import time
+
+    delay = float(backoff_s)
+    for attempt in range(max(0, retries) + 1):
+        try:
+            return _read_json_records(path)
+        except (FileNotFoundError, PermissionError, IsADirectoryError,
+                NotADirectoryError):
+            raise  # permanent: a typo'd path must fail fast, not "retry"
+        except OSError as e:
+            if attempt == retries:
+                raise
+            from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+            log_json({
+                "event": "data_retry",
+                "path": path,
+                "attempt": attempt + 1,
+                "backoff_s": round(delay, 3),
+                "error": str(e)[:200],
+            })
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+    raise AssertionError("unreachable")
+
+
+def _read_json_records(path: str) -> Sequence[dict]:
     import os
 
     from distributed_llms_example_tpu import native
@@ -61,7 +100,7 @@ def load_json_records(path: str) -> Sequence[dict]:
             try:
                 recs = native.load_jsonl(path)
             except ValueError:
-                pass  # multi-line object / data-wrapper → Python path below
+                pass  # multi-line object / data-wrapper / bad line → Python path
             else:
                 if len(recs) == 1:
                     only = recs[0]  # materialize once: json.loads runs on access
@@ -71,16 +110,44 @@ def load_json_records(path: str) -> Sequence[dict]:
         if head == "[":
             return json.load(f)
         if head == "{":
-            try:
-                records = [json.loads(line) for line in f if line.strip()]
-            except json.JSONDecodeError:
-                # not line-delimited (e.g. a pretty-printed {"data": [...]}
-                # wrapper): parse the whole file as one JSON value
+            records: list[dict] = []
+            skipped = 0
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    skipped += 1
+                    continue
+                if not isinstance(rec, dict):
+                    skipped += 1  # a bare scalar/array line is not a record
+                    continue
+                records.append(rec)
+            if skipped:
+                # unparseable lines: either this is really a pretty-printed
+                # single JSON document (not JSONL at all — parse it whole)
+                # or a JSONL file with corrupt lines (skip them, loudly)
                 f.seek(0)
-                whole = json.load(f)
-                if isinstance(whole.get("data"), list):
-                    return whole["data"]
-                return [whole]
+                try:
+                    whole = json.load(f)
+                except json.JSONDecodeError:
+                    pass  # genuinely line-delimited with bad lines
+                else:
+                    if isinstance(whole, dict) and isinstance(whole.get("data"), list):
+                        return whole["data"]
+                    return [whole]
+                if not records:
+                    raise ValueError(f"{path}: no parseable JSON records")
+                from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+                log_json({
+                    "event": "data_skipped_records",
+                    "path": path,
+                    "skipped": skipped,
+                    "kept": len(records),
+                })
             if len(records) == 1 and isinstance(records[0].get("data"), list):
                 return records[0]["data"]
             return records

@@ -117,6 +117,10 @@ class TrainerObs:
         )
         self._pending_health: list[tuple[int, dict]] = []
         self._last_health: dict[str, Any] | None = None
+        # the last agreed obs_anomaly record (pod-consistent fields:
+        # step/code/policy) — what the rewind recovery path consumes when
+        # on_step returns its action
+        self.last_anomaly: dict[str, Any] | None = None
         self._trigger = getattr(cfg, "profile_trigger", "") or (
             os.path.join(cfg.output_dir, "obs", "profile.trigger")
             if self.enabled
@@ -266,6 +270,7 @@ class TrainerObs:
         )
         if event is None:
             return "ok"
+        self.last_anomaly = event
         if self.recorder is not None:
             self.recorder.dump(
                 self.cfg.output_dir,

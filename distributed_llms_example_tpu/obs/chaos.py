@@ -1,0 +1,157 @@
+"""Deterministic fault injection — the chaos harness.
+
+Detection (the anomaly watchdog), recovery (rewind-and-retry), and
+integrity (checkpoint checksums) are only trustworthy if they can be
+EXERCISED: a fault path nothing can trigger is a fault path nobody has
+seen work.  This module injects the pod-scale fault classes on a fixed,
+reproducible schedule so every recovery mechanism has a test switch:
+
+    --chaos nan_grad@120,ckpt_corrupt@2,data_error@300,sigterm@240
+
+Grammar: a comma list of ``kind@tick``.  Ticks are **global optimizer
+steps** except for ``ckpt_corrupt``, whose tick is the **Nth checkpoint
+save of the run** (corruption must hit a checkpoint regardless of how
+the save cadence maps to steps).  Kinds:
+
+- ``nan_grad@K``      poison one parameter element with NaN right before
+                      step K dispatches (a lazy device-side op — the NaN
+                      surfaces in the step's in-graph numerics, exactly
+                      like a real numeric fault would)
+- ``ckpt_corrupt@N``  flip bytes in the Nth checkpoint AFTER its
+                      checksum manifest is finalized — the manifest
+                      verification, not luck, must catch it
+- ``data_error@K``    raise one transient ``OSError`` from the batch
+                      fetch before step K — exercises the loader's
+                      retry-with-backoff
+- ``sigterm@K``       deliver SIGTERM to this process after step K —
+                      exercises the graceful-preemption checkpoint path
+
+Every injection is **one-shot** (armed → fired): a rewind replaying the
+same steps does not re-inject, so a recovered run stays recovered.  Each
+firing is logged as a schema-stamped ``chaos_injection`` obs event, which
+is how ``obs.report`` separates *injected* faults from *organic* ones
+(``--strict`` fails only on the latter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Iterable
+
+KINDS = ("nan_grad", "ckpt_corrupt", "data_error", "sigterm")
+
+GRAMMAR_HELP = (
+    "expected a comma list of kind@tick with kind in "
+    f"{'/'.join(KINDS)} and tick a positive integer "
+    "(global step; for ckpt_corrupt the Nth checkpoint save), "
+    "e.g. 'nan_grad@120,ckpt_corrupt@2,sigterm@240'"
+)
+
+
+@dataclasses.dataclass
+class Injection:
+    kind: str
+    at: int  # global step, or save ordinal for ckpt_corrupt
+    fired: bool = False
+
+
+class ChaosSchedule:
+    """The armed injections, consumed one-shot via ``take``."""
+
+    def __init__(self, injections: Iterable[Injection] = ()):
+        self.injections = list(injections)
+
+    def __bool__(self) -> bool:
+        return bool(self.injections)
+
+    def arm(self, kind: str, at: int) -> None:
+        if kind not in KINDS:
+            raise ValueError(f"unknown chaos kind {kind!r}; {GRAMMAR_HELP}")
+        self.injections.append(Injection(kind, int(at)))
+
+    def armed_at(self, kind: str) -> list[int]:
+        """Unfired ticks for one kind (the legacy test-hook getter)."""
+        return [i.at for i in self.injections if i.kind == kind and not i.fired]
+
+    def disarm(self, kind: str) -> None:
+        """Drop every UNFIRED injection of one kind (fired ones stay for
+        the record) — the legacy test hook's ``= None`` disarm."""
+        self.injections = [
+            i for i in self.injections if i.kind != kind or i.fired
+        ]
+
+    def take(self, kind: str, tick: int) -> bool:
+        """True — exactly once — when an unfired ``kind@tick`` injection
+        is armed; marks it fired and logs the ``chaos_injection`` event
+        (``local``: every process's JSONL carries its own firing — the
+        schedule is deterministic, so all ranks fire together)."""
+        for inj in self.injections:
+            if inj.kind == kind and inj.at == tick and not inj.fired:
+                inj.fired = True
+                from distributed_llms_example_tpu.obs import sink as sink_mod
+
+                sink_mod.emit(
+                    {"event": "chaos_injection", "kind": kind, "step": int(tick)},
+                    local=True,
+                )
+                return True
+        return False
+
+
+def parse_chaos(spec: str) -> ChaosSchedule:
+    """Parse the ``--chaos`` grammar; raises ValueError (with the grammar
+    help) on anything malformed — chaos configs must fail at parse time,
+    not at injection time 4 hours into the run."""
+    schedule = ChaosSchedule()
+    spec = (spec or "").strip()
+    if not spec:
+        return schedule
+    for part in spec.split(","):
+        part = part.strip()
+        kind, sep, tick = part.partition("@")
+        if not sep or kind not in KINDS or not tick.isdigit() or int(tick) < 1:
+            raise ValueError(f"bad --chaos entry {part!r}: {GRAMMAR_HELP}")
+        schedule.arm(kind, int(tick))
+    return schedule
+
+
+def corrupt_checkpoint(step_dir: str, *, nbytes: int = 64) -> str | None:
+    """Flip ``nbytes`` in the middle of the largest file under a
+    checkpoint step directory (deterministic pick: size desc, then path)
+    — the torn/bit-rotted-storage simulation the integrity manifest must
+    catch.  Returns the corrupted file's path, or None if the directory
+    holds no files."""
+    candidates: list[tuple[int, str]] = []
+    for dirpath, _, files in os.walk(step_dir):
+        for name in sorted(files):
+            path = os.path.join(dirpath, name)
+            candidates.append((-os.path.getsize(path), path))
+    if not candidates:
+        return None
+    candidates.sort()
+    path = candidates[0][1]
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        off = max(0, size // 2 - nbytes // 2)
+        f.seek(off)
+        chunk = f.read(min(nbytes, max(1, size - off)))
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+        f.flush()
+        os.fsync(f.fileno())
+    from distributed_llms_example_tpu.obs import sink as sink_mod
+
+    record = {
+        "event": "chaos_ckpt_corrupted",
+        "path": path,
+        "bytes_flipped": len(chunk),
+    }
+    # orbax step dirs are named by their step number: carrying it lets
+    # obs.report match a later ckpt_verify_failed to THIS injection
+    # per-step (an unrelated organic corruption must stay organic)
+    base = os.path.basename(os.path.normpath(step_dir))
+    if base.isdigit():
+        record["step"] = int(base)
+    sink_mod.emit(record, local=True)
+    return path

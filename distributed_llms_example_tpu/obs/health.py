@@ -47,7 +47,7 @@ import numpy as np
 
 from distributed_llms_example_tpu.obs import sink as sink_mod
 
-ANOMALY_POLICIES = ("warn", "halt", "checkpoint")
+ANOMALY_POLICIES = ("warn", "halt", "checkpoint", "rewind")
 
 # stable wire codes for the agreement allgather (int32 payload)
 CODE_IDS = {"nonfinite": 1, "loss_spike": 2, "grad_explosion": 3}

@@ -89,6 +89,15 @@ class FlightRecorder:
         self._ring.append(entry)
         self._by_step[int(step)] = entry
 
+    def fingerprint_for(self, step: int) -> dict | None:
+        """The batch fingerprint recorded for one step (None once evicted
+        or never recorded) — what the rewind recovery path quarantines
+        by."""
+        entry = self._by_step.get(int(step))
+        if entry is None:
+            return None
+        return entry.get("fingerprint")
+
     def annotate(self, step: int, host_metrics: Mapping[str, float]) -> None:
         """Replace a step's device-scalar metrics with the host floats the
         health cadence already fetched — dump then needs no sync for any

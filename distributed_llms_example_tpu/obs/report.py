@@ -249,6 +249,135 @@ def comm_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
     return None
 
 
+def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
+    """The fault-tolerance timeline: chaos injections, recovery actions
+    (rewinds / skip-batch / halts), quarantines, checkpoint-integrity
+    failures, data retries — with the injected/organic split.
+
+    A fault is **injected** when a ``chaos_injection`` event explains it
+    (``nan_grad`` at the anomaly's step; any ``ckpt_corrupt`` firing for
+    an integrity failure; ``data_error`` at a retry's step); everything
+    else is **organic** — the distinction ``--strict`` gates on (a chaos
+    run is green only when every fault it saw is one it caused)."""
+    injections: list[dict] = []
+    corrupted: list[dict] = []
+    recoveries: list[dict] = []
+    quarantines: list[dict] = []
+    verify_failures: list[dict] = []
+    data_events: list[dict] = []
+    anomalies: list[dict] = []
+    # injections/recoveries/quarantines are ``local`` events (every
+    # rank's file carries its own copy — the schedule and the escalation
+    # are deterministic across the pod): dedup to per-run rows
+    seen: set = set()
+
+    def dedup(into: list[dict], rec: dict, *keys: str) -> None:
+        k = (rec.get("event"),) + tuple(rec.get(x) for x in keys)
+        if k not in seen:
+            seen.add(k)
+            into.append(rec)
+
+    for _, records in sorted(processes.items()):
+        ev = _by_event(records)
+        for r in ev.get("chaos_injection", []):
+            dedup(injections, r, "kind", "step")
+        for r in ev.get("chaos_ckpt_corrupted", []):
+            dedup(corrupted, r, "step", "path")
+        for r in ev.get("recovery", []):
+            # rewind_index is in the key: two rewinds with the same
+            # (step, restored_step) — a second poison batch on the replay
+            # — are distinct recoveries, not per-rank copies
+            dedup(
+                recoveries, r,
+                "action", "step", "detected_at_step", "restored_step",
+                "rewind_index",
+            )
+        for r in ev.get("quarantine", []):
+            dedup(quarantines, r, "epoch", "epoch_step")
+        for kind in ("ckpt_verify_failed", "ckpt_restore_failed"):
+            verify_failures.extend(ev.get(kind, []))
+        for kind in ("data_retry", "data_skipped_records"):
+            data_events.extend(ev.get(kind, []))
+        anomalies.extend(ev.get("obs_anomaly", []))
+    injected_at: dict[str, set] = {}
+    for i in injections:
+        injected_at.setdefault(i.get("kind", "?"), set()).add(i.get("step"))
+
+    def fault_row(kind: str, step: Any, injected: bool, detail: str) -> dict:
+        return {"kind": kind, "step": step, "injected": injected, "detail": detail}
+
+    faults: list[dict] = []
+    seen_anomaly_steps = set()
+    for a in anomalies:
+        key = (a.get("step"), a.get("code"))
+        if key in seen_anomaly_steps:
+            continue  # one fault per (step, code), however many ranks logged it
+        seen_anomaly_steps.add(key)
+        injected = a.get("step") in injected_at.get("nan_grad", set())
+        faults.append(fault_row(
+            f"anomaly:{a.get('code')}", a.get("step"), injected,
+            str(a.get("detail", ""))[:120],
+        ))
+    # per-step match: a verify failure is injected only when the chaos
+    # harness corrupted THAT step (chaos_ckpt_corrupted carries the step
+    # dir's number) — an organic corruption elsewhere in the same chaos
+    # run must stay organic
+    corrupted_steps = {c.get("step") for c in corrupted if "step" in c}
+    seen_ckpt_steps = set()
+    for v in verify_failures:
+        if v.get("step") in seen_ckpt_steps:
+            continue
+        seen_ckpt_steps.add(v.get("step"))
+        faults.append(fault_row(
+            "ckpt_integrity", v.get("step"), v.get("step") in corrupted_steps,
+            str(v.get("detail", v.get("error", "")))[:120],
+        ))
+    seen_data_steps = set()
+    for d in data_events:
+        if d.get("event") == "data_retry" and d.get("step") not in seen_data_steps:
+            seen_data_steps.add(d.get("step"))
+            injected = d.get("step") in injected_at.get("data_error", set())
+            faults.append(fault_row(
+                "data_retry", d.get("step"), injected, str(d.get("error", ""))[:120]
+            ))
+    organic = [f for f in faults if not f["injected"]]
+    rewinds = [r for r in recoveries if r.get("action") == "rewind"]
+    mttr_vals = [
+        r["recovery_wall_s"]
+        for r in rewinds
+        if isinstance(r.get("recovery_wall_s"), (int, float))
+    ]
+    return {
+        "injections": [
+            {"kind": i.get("kind"), "step": i.get("step")} for i in injections
+        ],
+        "actions": [
+            {
+                k: r.get(k)
+                for k in (
+                    "action", "step", "code", "restored_step", "steps_lost",
+                    "rewind_index", "recovery_wall_s", "reason",
+                )
+                if k in r
+            }
+            for r in recoveries
+        ],
+        "quarantines": [
+            {k: q.get(k) for k in ("epoch", "epoch_step", "reason") if k in q}
+            for q in quarantines
+        ],
+        "rewinds": len(rewinds),
+        "steps_lost_total": sum(
+            int(r.get("steps_lost", 0) or 0) for r in rewinds
+        ),
+        "mttr_s": (
+            round(sum(mttr_vals) / len(mttr_vals), 4) if mttr_vals else None
+        ),
+        "faults": faults,
+        "organic_faults": organic,
+    }
+
+
 def build_report(output_dir: str) -> dict[str, Any]:
     run = load_run(output_dir)
     processes = run["processes"]
@@ -267,6 +396,7 @@ def build_report(output_dir: str) -> dict[str, Any]:
         "trends": window_trends(processes),
         "stragglers": straggler_attribution(processes),
         "comm": comm_report(processes),
+        "recovery": recovery_report(processes),
         "anomalies": anomalies,
         "recorders": {
             str(p): {
@@ -371,6 +501,47 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
                 )
         if "reduce_scatter_smell" in comm:
             add(f"- **smell**: {comm['reduce_scatter_smell'].get('message')}")
+    rec = report.get("recovery") or {}
+    add("")
+    add("## Recovery timeline")
+    if rec.get("injections"):
+        add(
+            "- chaos injections: "
+            + ", ".join(f"{i['kind']}@{i['step']}" for i in rec["injections"])
+        )
+    for a in rec.get("actions", []):
+        if a.get("action") == "rewind":
+            add(
+                f"- **rewind** {a.get('rewind_index')}: anomaly "
+                f"[{a.get('code')}] at step {a.get('step')} → restored step "
+                f"{a.get('restored_step')} ({a.get('steps_lost')} steps lost, "
+                f"{_fmt(a.get('recovery_wall_s'))} s)"
+            )
+        else:
+            add(
+                f"- **{a.get('action')}**: anomaly [{a.get('code')}] at step "
+                f"{a.get('step')} — {a.get('reason', '')}"
+            )
+    for q in rec.get("quarantines", []):
+        add(
+            f"- quarantined batch (epoch {q.get('epoch')}, epoch_step "
+            f"{q.get('epoch_step')}): {q.get('reason', '')}"
+        )
+    if rec.get("rewinds"):
+        add(
+            f"- {rec['rewinds']} rewind(s), {rec['steps_lost_total']} optimizer "
+            f"steps lost, MTTR {_fmt(rec.get('mttr_s'))} s"
+        )
+    injected = [f for f in rec.get("faults", []) if f["injected"]]
+    organic = rec.get("organic_faults", [])
+    if not rec.get("faults"):
+        add("- no faults observed")
+    else:
+        add(f"- faults: {len(injected)} injected, {len(organic)} organic")
+        for f in organic:
+            add(
+                f"  - **organic** {f['kind']} at step {f['step']}: {f['detail']}"
+            )
     add("")
     add(f"## Anomalies ({len(report['anomalies'])})")
     for a in report["anomalies"]:
@@ -396,7 +567,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--last", type=int, default=20, help="timeline rows to render")
     p.add_argument(
         "--strict", action="store_true",
-        help="nonzero exit on any schema-invalid line",
+        help="nonzero exit on any schema-invalid line OR any ORGANIC fault "
+             "(one no chaos_injection event explains) — a chaos run is "
+             "green only when every fault it saw is one it caused",
     )
     args = p.parse_args(argv)
     if not os.path.isdir(os.path.join(args.output_dir, "obs")):
@@ -407,7 +580,9 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(report))
     else:
         print(render_markdown(report, last=args.last), end="")
-    if args.strict and report["schema_errors"]:
+    if args.strict and (
+        report["schema_errors"] or report["recovery"]["organic_faults"]
+    ):
         return 1
     return 0
 

@@ -1,0 +1,196 @@
+"""In-run rewind-and-retry recovery — the other half of the watchdog.
+
+PRs 2–3 built fault *detection*: in-graph numerics, a pod-agreed anomaly
+watchdog, a flight recorder.  But every policy ended the run —
+``--on-anomaly checkpoint`` saves and stops, and resuming costs a full
+process restart (scheduler round-trip, weight reload, recompile).  At
+pod scale most anomalies are cheaper than that: a poison batch or a
+transient numeric fault costs at most ``save_every_steps`` optimizer
+steps IF the run can rewind in-process.  ``--on-anomaly rewind`` does
+exactly that:
+
+1. **Rewind**: restore the newest VERIFIED checkpoint strictly older
+   than the anomaly step (``io/checkpoint.py`` ``restore_before`` — a
+   checkpoint saved at/after the anomaly may already hold poisoned
+   state), reset the data cursor via the O(1) index-level epoch
+   fast-forward, and restore the dropout RNG snapshot taken at save
+   time, so the replay is bit-identical to the original steps.
+2. **Quarantine**: the anomaly is attributed to an exact step by the
+   watchdog, and the flight recorder holds that step's batch
+   fingerprint (shapes + crc32s + the deterministic (epoch, epoch_step)
+   plan position).  The batch is quarantined by plan position — a
+   pod-consistent key, since every host computes the same batch plan —
+   and the replay SKIPS it (crc-checked on the way past), so a poison
+   batch cannot re-trip the same anomaly.
+3. **Escalation**: rewind → skip-batch → halt.  Rewinds are bounded by
+   ``--max-rewinds``.  When the budget is exhausted and the state is
+   still finite (a loss spike / grad explosion, not NaN), one degraded
+   ``skip_batch`` attempt quarantines the batch and continues WITHOUT
+   restoring; anything beyond that — or an anomaly recurring on a batch
+   already quarantined (the data hypothesis is refuted) — halts.
+
+Pod consistency: every decision here derives only from pod-agreed
+inputs (the agreed anomaly record, the shared checkpoint directory, the
+deterministic batch plan, counters that advance identically on every
+rank), so all ranks rewind to the same step without any extra
+collective; the restore itself is orbax's usual collaborative restore.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+from distributed_llms_example_tpu.obs import sink as sink_mod
+
+# escalation actions, in order
+ACTIONS = ("rewind", "skip_batch", "halt")
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    action: str  # one of ACTIONS
+    reason: str
+
+
+class RecoveryController:
+    """The rewind state machine: budget, quarantine set, save snapshots.
+
+    One instance per Trainer; its counters and quarantine keys advance
+    identically on every process (all inputs are pod-agreed), which is
+    what makes the escalation itself agreement-free.
+    """
+
+    def __init__(self, *, max_rewinds: int = 2):
+        self.max_rewinds = int(max_rewinds)
+        self.rewinds_done = 0
+        self.skips_done = 0
+        # (epoch, epoch_step) → quarantine record (crc32s for verification)
+        self.quarantined: dict[tuple[int, int], dict[str, Any]] = {}
+        # checkpoint step → host-side extras orbax does not hold: the
+        # dropout RNG key and the (epoch, pos) data cursor at save time
+        self._snapshots: dict[int, dict[str, Any]] = {}
+
+    # -- save-time bookkeeping ------------------------------------------
+
+    def note_save(self, step: int, *, rng: Any, epoch: int, pos: int) -> None:
+        """Record the host-side state a bit-exact in-process rewind needs
+        alongside the checkpoint at ``step``: the dropout key (restored
+        so replayed steps split the identical stream) and the data
+        cursor (epoch, iterator items consumed — NOT the global step:
+        quarantine skips make the two diverge)."""
+        self._snapshots[int(step)] = {"rng": rng, "epoch": int(epoch), "pos": int(pos)}
+
+    def snapshot_for(self, step: int) -> dict[str, Any] | None:
+        return self._snapshots.get(int(step))
+
+    # -- quarantine ------------------------------------------------------
+
+    def quarantine(
+        self, epoch: int, epoch_step: int, fingerprint: Mapping[str, Any], *, reason: str
+    ) -> None:
+        """Quarantine one batch-plan position; emits the ``quarantine``
+        event (once — replay skips are silent ``quarantine_skip``s)."""
+        key = (int(epoch), int(epoch_step))
+        record = {
+            "input_ids_crc32": fingerprint.get("input_ids_crc32"),
+            "labels_crc32": fingerprint.get("labels_crc32"),
+            "reason": reason,
+        }
+        self.quarantined[key] = record
+        sink_mod.emit(
+            {
+                "event": "quarantine",
+                "epoch": key[0],
+                "epoch_step": key[1],
+                **{k: v for k, v in record.items() if v is not None},
+            },
+            local=True,
+        )
+
+    def should_skip(self, epoch: int, epoch_step: int, batch: Mapping[str, Any]) -> bool:
+        """Replay-time check: is this batch-plan position quarantined?
+        The local crc is re-checked against the quarantine record — a
+        mismatch means the deterministic plan did NOT reproduce the
+        poisoned batch (seed/data drift), which is worth a loud event,
+        but the position is skipped either way (the pod-consistent key
+        is the position, not the per-host bytes)."""
+        record = self.quarantined.get((int(epoch), int(epoch_step)))
+        if record is None:
+            return False
+        expected = record.get("input_ids_crc32")
+        if expected is not None:
+            import zlib
+
+            import numpy as np
+
+            v = batch.get("input_ids")
+            got = (
+                zlib.crc32(np.ascontiguousarray(v).tobytes()) & 0xFFFFFFFF
+                if v is not None
+                else None
+            )
+            if got != expected:
+                sink_mod.emit(
+                    {
+                        "event": "quarantine_crc_mismatch",
+                        "epoch": int(epoch),
+                        "epoch_step": int(epoch_step),
+                        "expected_crc32": expected,
+                        "got_crc32": got,
+                    },
+                    local=True,
+                )
+        sink_mod.emit(
+            {
+                "event": "quarantine_skip",
+                "epoch": int(epoch),
+                "epoch_step": int(epoch_step),
+            },
+            local=True,
+        )
+        return True
+
+    # -- escalation ------------------------------------------------------
+
+    def decide(
+        self,
+        anomaly: Mapping[str, Any],
+        *,
+        fingerprint: Mapping[str, Any] | None,
+    ) -> Decision:
+        """Pick the escalation stage for one agreed anomaly.  Inputs are
+        pod-agreed (anomaly code/step; the fingerprint's plan position is
+        deterministic), so every rank returns the same Decision."""
+        key = None
+        if fingerprint is not None:
+            key = (int(fingerprint["epoch"]), int(fingerprint["epoch_step"]))
+        if key is not None and key in self.quarantined:
+            return Decision(
+                "halt",
+                f"anomaly recurred at already-quarantined batch {key} — "
+                "not the data; rewinding again cannot help",
+            )
+        if self.rewinds_done < self.max_rewinds:
+            self.rewinds_done += 1
+            return Decision(
+                "rewind",
+                f"rewind {self.rewinds_done}/{self.max_rewinds}",
+            )
+        if (
+            anomaly.get("code") != "nonfinite"
+            and key is not None
+            and self.skips_done == 0
+        ):
+            # degraded mode: the state is still finite, so dropping the
+            # suspect batch and continuing loses nothing more — one try
+            self.skips_done += 1
+            return Decision(
+                "skip_batch",
+                "rewind budget exhausted; state finite — quarantining the "
+                "batch and continuing without restore",
+            )
+        return Decision(
+            "halt",
+            f"rewind budget exhausted ({self.rewinds_done}/{self.max_rewinds})",
+        )

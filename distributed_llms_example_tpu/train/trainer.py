@@ -337,11 +337,14 @@ class Trainer:
             health=self.health_on,
         )
         self.train_step, _ = build(self.state)
-        # test hook: inject a NaN into one parameter element right before
-        # dispatching this global step (None = never) — the cheapest way
-        # to fault a real run's numerics deterministically; the poison is
-        # a lazy device-side op, so even the injection adds no sync
-        self._poison_nan_at_step: int | None = None
+        # deterministic fault injection (obs/chaos.py --chaos): the ONE
+        # injection point for faulted numerics, checkpoint corruption,
+        # transient data errors and signals; the legacy
+        # ``_poison_nan_at_step`` test hook is a thin alias that arms a
+        # nan_grad injection here
+        from distributed_llms_example_tpu.obs.chaos import parse_chaos
+
+        self.chaos = parse_chaos(cfg.chaos)
 
         ckpt_dir = os.path.join(cfg.output_dir, "checkpoints")
         self.checkpointer = Checkpointer(
@@ -350,6 +353,13 @@ class Trainer:
             keep=cfg.checkpoint.keep,
             async_save=cfg.checkpoint.async_save,
         )
+        # in-run rewind-and-retry recovery (train/recovery.py): the state
+        # machine is always constructed (its quarantine check is a dict
+        # lookup per batch); only --on-anomaly rewind ever drives it
+        from distributed_llms_example_tpu.train.recovery import RecoveryController
+
+        self.recovery = RecoveryController(max_rewinds=cfg.max_rewinds)
+        self._save_ordinal = 0  # chaos ckpt_corrupt ticks on save ordinals
         # Stacked-block STORAGE ORDER is schedule-dependent (interleaved
         # packs each device's v non-contiguous chunks contiguously) but
         # invisible to array shapes — resuming a checkpoint under a
@@ -433,13 +443,21 @@ class Trainer:
                 # restore the old structure and rely on the sidecar guard
                 # above, which already ran for this directory
                 restored = self.checkpointer.restore_latest(abstract)
-                if restored is not None:
-                    self.state, self.start_step = restored
-                    log_json({
-                        "event": "resumed", "step": self.start_step,
-                        "legacy_payload": True,
-                    })
+                if restored is None:
+                    self._refuse_unverifiable_resume(ckpt_dir)
+                self.state, self.start_step = restored
+                log_json({
+                    "event": "resumed", "step": self.start_step,
+                    "legacy_payload": True,
+                })
                 restored = None
+            else:
+                if restored is None:
+                    # checkpoints EXIST but none passed verification:
+                    # training silently from step 0 would let this run's
+                    # retention garbage-collect the (possibly salvageable)
+                    # corrupt steps — refuse loudly instead
+                    self._refuse_unverifiable_resume(ckpt_dir)
             if restored is not None:
                 payload, self.start_step = restored
                 stored_leaf = np.asarray(jax.device_get(payload["stacked_layout"]))
@@ -455,6 +473,25 @@ class Trainer:
                     )
                 self.state = payload["state"]
                 log_json({"event": "resumed", "step": self.start_step})
+        # cross-run recovery state: the (epoch, pos) cursor and the
+        # quarantine set ride a sidecar next to the restored step —
+        # after a quarantine skip the cursor drifts from step %
+        # steps_per_epoch, so the arithmetic fallback would re-train one
+        # batch and shift the rest of the epoch
+        self._resume_cursor: tuple[int, int] | None = None
+        if self.start_step:
+            side = self._load_recovery_sidecar(self.start_step)
+            if side is not None:
+                self._resume_cursor = (int(side["epoch"]), int(side["pos"]))
+                for e, s, rec in side.get("quarantined", []):
+                    self.recovery.quarantined[(int(e), int(s))] = rec
+                log_json({
+                    "event": "recovery_cursor_restored",
+                    "step": self.start_step,
+                    "epoch": self._resume_cursor[0],
+                    "pos": self._resume_cursor[1],
+                    "quarantined": len(self.recovery.quarantined),
+                })
         # Written at init, AFTER the mismatch guard: a mixed dir has
         # already been refused above, and deferring to the first save
         # would leave a crash window (preemption save lands, SIGKILL
@@ -547,6 +584,153 @@ class Trainer:
             if impl == "threefry"
             else jax.random.key(self.cfg.shuffle_seed, impl=impl)
         )
+
+    def _refuse_unverifiable_resume(self, ckpt_dir: str) -> None:
+        raise ValueError(
+            f"resume: checkpoints exist under {ckpt_dir} "
+            f"(steps {self.checkpointer.all_steps()}) but none passed "
+            "integrity verification — see the ckpt_verify_failed events "
+            "for per-file detail; inspect/restore the step dirs against "
+            "their integrity-<step>.json manifests, or pass --no-resume "
+            "to train from scratch (which will eventually retention-"
+            "delete the corrupt steps)"
+        )
+
+    @property
+    def _poison_nan_at_step(self) -> int | None:
+        """Legacy test hook, kept as a thin alias over the chaos harness:
+        reading returns the first armed-but-unfired nan_grad step (None =
+        never), assigning arms a ``nan_grad@step`` injection."""
+        armed = self.chaos.armed_at("nan_grad")
+        return armed[0] if armed else None
+
+    @_poison_nan_at_step.setter
+    def _poison_nan_at_step(self, step: int | None) -> None:
+        # assignment REPLACES the armed injection, exactly like the plain
+        # attribute it used to be: None disarms, a step re-arms
+        self.chaos.disarm("nan_grad")
+        if step is not None:
+            self.chaos.arm("nan_grad", int(step))
+
+    def _save_checkpoint(
+        self,
+        step: int,
+        *,
+        epoch: int | None = None,
+        pos: int | None = None,
+        force: bool = False,
+    ) -> bool:
+        """THE checkpoint save path — every save (cadence, rewind anchor,
+        anomaly, preemption, final) goes through here so the recovery
+        snapshot (RNG + data cursor, needed for a bit-exact in-process
+        rewind) and the chaos ``ckpt_corrupt`` ordinal counter cannot
+        miss one."""
+        saved = self.checkpointer.save(step, self._with_layout(self.state), force=force)
+        if not saved:
+            return False
+        self._save_ordinal += 1
+        if epoch is not None and pos is not None:
+            self.recovery.note_save(step, rng=self._rng, epoch=epoch, pos=pos)
+            self._write_recovery_sidecar(step, epoch, pos)
+        if self.chaos.take("ckpt_corrupt", self._save_ordinal):
+            # finalize the data AND its checksum manifest first: the
+            # corruption must be caught by integrity verification, not by
+            # an unluckily torn write orbax happens to notice
+            self.checkpointer.wait()
+            if jax.process_index() == 0:
+                from distributed_llms_example_tpu.obs.chaos import corrupt_checkpoint
+
+                corrupt_checkpoint(self.checkpointer.step_dir(step))
+        return True
+
+    def _recovery_sidecar_path(self, step: int) -> str:
+        from distributed_llms_example_tpu.io.checkpoint import RECOVERY_PREFIX
+
+        return os.path.join(
+            self.checkpointer.directory, f"{RECOVERY_PREFIX}{int(step)}.json"
+        )
+
+    def _write_recovery_sidecar(self, step: int, epoch: int, pos: int) -> None:
+        """Persist the host-side recovery state orbax's payload cannot
+        hold — the (epoch, pos) data cursor and the quarantine set — next
+        to the checkpoint (atomic, p0).  Quarantine skips make the cursor
+        drift from ``step % steps_per_epoch``, so a CROSS-RUN resume that
+        reconstructed it arithmetically would re-train one batch and
+        shift the rest of the epoch; with the sidecar, resume is exact
+        and the quarantine survives the restart (the dropout-RNG snapshot
+        stays in-memory only: bit-exact replay is a same-process
+        property).  GC'd with the step by io/checkpoint.py."""
+        if jax.process_index() != 0:
+            return
+        payload = {
+            "step": int(step),
+            "epoch": int(epoch),
+            "pos": int(pos),
+            "quarantined": [
+                [e, s, rec] for (e, s), rec in self.recovery.quarantined.items()
+            ],
+        }
+        path = self._recovery_sidecar_path(step)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            # best-effort, like the manifest write: resume falls back to
+            # the arithmetic cursor when the sidecar is missing
+            log_json({
+                "event": "recovery_sidecar_write_failed",
+                "step": int(step),
+                "error": str(e)[:200],
+            })
+
+    def _load_recovery_sidecar(self, step: int) -> dict | None:
+        try:
+            with open(self._recovery_sidecar_path(step)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _with_data_retries(self, batches: Any):
+        """Wrap the epoch's batch stream with the chaos ``data_error``
+        injection point and its retry (capped backoff, ``data_retry``
+        events).  The injected error is raised BEFORE touching the
+        iterator, so the retry cleanly re-fetches.  A real error from
+        the iterator propagates immediately: a generator or Prefetcher
+        that raised is dead (the producer latches the error), so
+        retrying could only emit phantom ``data_retry`` events and sleep
+        before failing with the same exception — transient FILE errors
+        are retried where the read is actually restartable, inside
+        ``data/dataset.py``."""
+        class _Injected(OSError):
+            pass  # raised BEFORE next(it): the iterator is untouched
+
+        it = iter(batches)
+        while True:
+            attempt, delay = 0, 0.05
+            while True:
+                try:
+                    if self.chaos.take("data_error", self._last_step + 1):
+                        raise _Injected("chaos: injected transient data-read error")
+                    batch = next(it)
+                    break
+                except StopIteration:
+                    return
+                except _Injected as e:
+                    attempt += 1
+                    log_json({
+                        "event": "data_retry",
+                        "step": self._last_step + 1,
+                        "attempt": attempt,
+                        "backoff_s": round(delay, 3),
+                        "error": str(e)[:200],
+                    })
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+            yield batch
 
     def _with_layout(self, state: Any, abstract: bool = False) -> dict:
         """Checkpoint payload: the TrainState plus the stacked-block layout
@@ -796,6 +980,111 @@ class Trainer:
             return False
         return self._preemption_agreed()
 
+    def _handle_rewind(
+        self, step: int, epoch: int, pos: int
+    ) -> tuple[int, int, int] | None:
+        """The agreed ``rewind`` anomaly action: run the escalation
+        (rewind / skip-batch / halt) through the recovery controller and
+        execute it.  Returns the (epoch, pos, step) cursor the loop
+        resumes at, or None to stop (``self._anomaly_action`` set).
+
+        Every input here is pod-agreed — the anomaly record's step/code,
+        the deterministic fingerprint plan position, the shared
+        checkpoint dir — so all processes execute the same branch and
+        enter the (collective) orbax restore together."""
+        from distributed_llms_example_tpu.obs import sink as sink_mod
+
+        t0 = time.perf_counter()
+        anomaly = self.obs.last_anomaly or {"step": step, "code": "unknown"}
+        a_step = int(anomaly.get("step", step))
+        fingerprint = (
+            self.obs.recorder.fingerprint_for(a_step)
+            if self.obs.recorder is not None
+            else None
+        )
+        decision = self.recovery.decide(anomaly, fingerprint=fingerprint)
+        action, reason = decision.action, decision.reason
+        if action != "halt" and fingerprint is not None:
+            # quarantine FIRST (for rewind and skip_batch alike): even if
+            # the restore below fails and we halt, the quarantine record
+            # is evidence for the post-mortem
+            self.recovery.quarantine(
+                fingerprint["epoch"],
+                fingerprint["epoch_step"],
+                fingerprint,
+                reason=f"anomaly:{anomaly.get('code')}@{a_step}",
+            )
+        if action == "skip_batch":
+            sink_mod.emit({
+                "event": "recovery", "action": "skip_batch",
+                "step": a_step, "detected_at_step": int(step),
+                "code": anomaly.get("code"), "reason": reason,
+            }, local=True)
+            sink_mod.flush(fsync=True)
+            return epoch, pos, step
+        if action == "rewind":
+            abstract = abstract_like(self.state, self.state_sh)
+            restored = self.checkpointer.restore_before(
+                a_step, self._with_layout(abstract, abstract=True)
+            )
+            if restored is None:
+                action = "halt"
+                reason = (
+                    f"no verified checkpoint older than anomaly step {a_step}"
+                )
+            else:
+                payload, rstep = restored
+                self.state = payload["state"]
+                # checkpoints newer than the restore target may hold the
+                # poisoned state (saved between anomaly and detection)
+                # with CLEAN checksums — drop them so the replay re-saves
+                # from recovered state and no later rewind/resume can
+                # pick them (collective, like the restore above)
+                self.checkpointer.delete_after(rstep)
+                snap = self.recovery.snapshot_for(rstep)
+                if snap is not None:
+                    # bit-exact replay: the dropout key and the data
+                    # cursor exactly as they stood when this checkpoint
+                    # was saved
+                    self._rng = snap["rng"]
+                    r_epoch, r_pos = snap["epoch"], snap["pos"]
+                else:
+                    # checkpoint predates this process (resume-then-
+                    # rewind): its recovery sidecar carries the exact
+                    # cursor even across prior-run quarantine skips; the
+                    # arithmetic cursor is the last resort.  The dropout
+                    # stream continues from the current key (bit-replay
+                    # is a same-process property)
+                    side = self._load_recovery_sidecar(rstep)
+                    if side is not None:
+                        r_epoch, r_pos = int(side["epoch"]), int(side["pos"])
+                    else:
+                        spe = self.batches.steps_per_epoch()
+                        r_epoch, r_pos = rstep // spe, rstep % spe
+                sink_mod.emit({
+                    "event": "recovery", "action": "rewind",
+                    "step": a_step, "detected_at_step": int(step),
+                    "code": anomaly.get("code"),
+                    "restored_step": int(rstep),
+                    "steps_lost": int(step - rstep),
+                    "rewind_index": self.recovery.rewinds_done,
+                    "max_rewinds": self.recovery.max_rewinds,
+                    "quarantined": fingerprint is not None,
+                    "recovery_wall_s": round(time.perf_counter() - t0, 4),
+                    "reason": reason,
+                }, local=True)
+                sink_mod.flush(fsync=True)
+                return r_epoch, r_pos, int(rstep)
+        # halt (decided, or a rewind that found nothing to restore)
+        self._anomaly_action = "halt"
+        sink_mod.emit({
+            "event": "recovery", "action": "halt",
+            "step": a_step, "detected_at_step": int(step),
+            "code": anomaly.get("code"), "reason": reason,
+        }, local=True)
+        sink_mod.flush(fsync=True)
+        return None
+
     def train(self) -> dict[str, Any]:
         # handlers restored in a finally: a raising train step must not
         # leave the flag-setting handler installed process-wide (it would
@@ -837,26 +1126,49 @@ class Trainer:
         last_eval: dict[str, float] = {}
         last_metrics: dict[str, Any] | None = None
         steps_per_epoch = self.batches.steps_per_epoch()
-        start_epoch = step // steps_per_epoch
-        epoch = start_epoch
-        for epoch in range(start_epoch, cfg.num_epochs):
+        # (epoch, pos) is the DATA cursor: ``pos`` counts iterator items
+        # consumed this epoch INCLUDING quarantine-skipped batches, so it
+        # can drift ahead of ``step % steps_per_epoch`` after a recovery
+        # skip.  The global ``step`` stays the optimizer-step counter
+        # (checkpoints, LR schedule, resume contract); only the cursor
+        # knows about skips, and rewinds restore both together.
+        if self._resume_cursor is not None:
+            # exact cursor from the recovery sidecar (survives quarantine
+            # skips); arithmetic otherwise
+            epoch, pos = self._resume_cursor
+        else:
+            epoch = step // steps_per_epoch
+            pos = step - epoch * steps_per_epoch
+        report_epoch = epoch
+        if cfg.on_anomaly == "rewind" and self.checkpointer.latest_step() is None:
+            # the rewind anchor: an anomaly before the first periodic save
+            # must still find a verified step to restore to — without it
+            # the very first recovery attempt could only halt
+            self._save_checkpoint(step, epoch=epoch, pos=pos, force=True)
+            self.checkpointer.wait()
+        while epoch < cfg.num_epochs:
+            report_epoch = epoch
             # assemble host batches (tokenize/pad/bucket) on a background
             # thread, prefetch_batches ahead, so input work overlaps the
             # device step instead of sitting on the critical path.  A
-            # resumed epoch fast-forwards at the INDEX level (the batch
-            # plan is deterministic per (seed, epoch)): no skipped batch
-            # is ever tokenized or padded.
-            skip = step - start_epoch * steps_per_epoch if epoch == start_epoch else 0
-            epoch_batches = self.batches.epoch(epoch, start_step=skip)
+            # resumed (or rewound) epoch fast-forwards at the INDEX level
+            # (the batch plan is deterministic per (seed, epoch)): no
+            # skipped batch is ever tokenized or padded.
+            epoch_batches = self.batches.epoch(epoch, start_step=pos)
             if cfg.prefetch_batches > 0:
                 epoch_batches = Prefetcher(epoch_batches, depth=cfg.prefetch_batches)
+            rewind_cursor: tuple[int, int, int] | None = None
             try:
-                for batch in obs.wrap_batches(epoch_batches):
+                for batch in obs.wrap_batches(self._with_data_retries(epoch_batches)):
+                    pos += 1
+                    if self.recovery.should_skip(epoch, pos - 1, batch):
+                        continue  # quarantined batch: the retry skips it
                     obs.profiler.before_step(step + 1)
-                    if self._poison_nan_at_step == step + 1:
-                        # test hook: corrupt one param element (lazy
-                        # device op — the NaN surfaces in this step's
-                        # in-graph numerics, nowhere on the host)
+                    if self.chaos.take("nan_grad", step + 1):
+                        # chaos (or the legacy test hook): corrupt one
+                        # param element (lazy device op — the NaN surfaces
+                        # in this step's in-graph numerics, nowhere on the
+                        # host)
                         flat, treedef = jax.tree.flatten(self.state.params)
                         flat[0] = flat[0].at[(0,) * flat[0].ndim].set(float("nan"))
                         self.state = self.state.replace(
@@ -866,7 +1178,7 @@ class Trainer:
                         batch_fingerprint(
                             batch,
                             epoch=epoch,
-                            epoch_step=step - epoch * steps_per_epoch,
+                            epoch_step=pos - 1,
                         )
                         if obs.recorder is not None
                         else None
@@ -906,9 +1218,16 @@ class Trainer:
                         # process takes this branch at the same step
                         self._anomaly_action = action
                         break
+                    if action == "rewind":
+                        # agreed like halt/checkpoint; the escalation
+                        # (rewind / skip-batch / halt) derives only from
+                        # pod-agreed inputs, so every process computes the
+                        # same cursor (or the same halt)
+                        rewind_cursor = self._handle_rewind(step, epoch, pos)
+                        break
                     if self.checkpointer.should_save(step):
                         with obs.checkpoint_span():
-                            self.checkpointer.save(step, self._with_layout(self.state))
+                            self._save_checkpoint(step, epoch=epoch, pos=pos)
                     if cfg.evaluation_steps > 0 and step % cfg.evaluation_steps == 0:
                         with obs.eval_span():
                             last_eval = self.evaluate(epoch, step=step)
@@ -916,6 +1235,12 @@ class Trainer:
                     # their own spans and must not inflate the NEXT step's
                     # ring-buffer duration (false straggler flags)
                     obs.spans.mark_step_start()
+                    if self.chaos.take("sigterm", step):
+                        # chaos: a real signal through the real handler —
+                        # the graceful-preemption path, not a shortcut
+                        import signal as _signal
+
+                        os.kill(os.getpid(), _signal.SIGTERM)
                     if self._check_preemption(step):
                         self._preempted = True  # agreed across hosts
                         break
@@ -937,6 +1262,15 @@ class Trainer:
                         "items": s["items"],
                         "consumer_wait_s": round(s["consumer_wait_s"], 4),
                     })
+            if rewind_cursor is not None:
+                # resume the loop at the restored (epoch, pos, step) —
+                # same-process, no recompilation, no weight reload; the
+                # replay re-runs the surviving steps bit-identically and
+                # skips the quarantined batch
+                epoch, pos, step = rewind_cursor
+                self._last_step = step
+                obs.spans.mark_step_start()
+                continue
             # Epoch boundary: a SIGTERM that landed between sync steps may
             # have set only the LOCAL flag (the cadence check above skipped
             # it) — acting on it here un-agreed would desynchronize the
@@ -955,14 +1289,25 @@ class Trainer:
             with obs.eval_span():
                 # per-epoch eval, reference parity
                 last_eval = self.evaluate(epoch, step=step)
-        logger.flush(step, epoch=epoch)
+            epoch += 1
+            pos = 0
+        logger.flush(step, epoch=report_epoch)
         # close any open trace window (flushed, not lost) and emit the
         # final obs window (plus the final partial-window health check)
         final_action = obs.finalize(
-            step, epoch, sync_leaf=last_metrics["loss"] if last_metrics else None
+            step, report_epoch, sync_leaf=last_metrics["loss"] if last_metrics else None
         )
-        if self._anomaly_action is None and final_action in ("halt", "checkpoint"):
-            self._anomaly_action = final_action
+        if self._anomaly_action is None and final_action in (
+            "halt", "checkpoint", "rewind"
+        ):
+            # a rewind agreed in the FINAL partial window has no loop left
+            # to replay: degrade to the checkpoint policy (preserve the
+            # evidence, stop with the anomaly marker) — never fall through
+            # to save_final() exporting possibly-poisoned params as a
+            # successful run
+            self._anomaly_action = (
+                "checkpoint" if final_action == "rewind" else final_action
+            )
         if self._anomaly_action is not None:
             wall = time.perf_counter() - t0
             if self._anomaly_action == "checkpoint":
@@ -970,7 +1315,7 @@ class Trainer:
                 # poisoned) state: post-mortem work restores it next to
                 # the flight-recorder bundle — resuming a diverged run
                 # from here is the operator's explicit call
-                self.checkpointer.save(step, self._with_layout(self.state), force=True)
+                self._save_checkpoint(step, epoch=epoch, pos=pos, force=True)
                 self.checkpointer.wait()
             log_json({
                 "event": "anomaly_stop", "step": step,
@@ -988,8 +1333,8 @@ class Trainer:
                     self.cfg.output_dir, reason="preemption", step=step
                 )
             # ...then save where we stopped and get out; resume restarts
-            # from here
-            self.checkpointer.save(step, self._with_layout(self.state), force=True)
+            # from here (cursor + quarantine ride the recovery sidecar)
+            self._save_checkpoint(step, epoch=epoch, pos=pos, force=True)
             self.checkpointer.wait()
             wall = time.perf_counter() - t0
             log_json({"event": "preempted", "step": step, "wall_seconds": wall})
@@ -997,7 +1342,7 @@ class Trainer:
                 "steps": step, "wall_seconds": wall, "final_eval": last_eval,
                 "preempted": True,
             }
-        self.checkpointer.save(self.total_steps, self._with_layout(self.state), force=True)
+        self._save_checkpoint(self.total_steps, epoch=epoch, pos=pos, force=True)
         self.checkpointer.wait()
         self.save_final()
         wall = time.perf_counter() - t0

@@ -57,6 +57,16 @@ Five rules, all born from real regressions at TPU scale:
    are allowed only inside ``ops/`` (the helper and the attention
    reference path are the implementation).
 
+6. **No bare orbax ``manager.save`` / ``manager.restore`` outside
+   ``io/checkpoint.py``.**  The Checkpointer wrappers are where save
+   retry-with-backoff, the checksum-manifest sidecar, and
+   verify-before-restore-with-fallback live — a direct ``manager.save``
+   skips the manifest (its checkpoint can never be verified) and a
+   direct ``manager.restore`` trusts a possibly-corrupt highest step
+   unconditionally, the exact crash the integrity layer exists to
+   prevent.  Everything goes through ``Checkpointer.save`` /
+   ``restore_latest`` / ``restore_before``.
+
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
 tests/test_health.py) next to the analysis-CLI smoke run.
@@ -147,6 +157,38 @@ DROPOUT_RULE_DIRS = (
 GRAD_ACCUM_RULE_DIRS = DROPOUT_RULE_DIRS
 GRAD_ACCUM_OWNER = os.path.join(PACKAGE, "train", "step.py")
 _GRAD_NAMES = ("grad", "grads", "gradient")
+
+# Rule 6: checkpoint save/restore is owned by io/checkpoint.py — its
+# wrappers carry the retry/backoff, checksum manifest, and
+# verify-with-fallback contracts a bare manager call would skip.
+CKPT_OWNER = os.path.join(PACKAGE, "io", "checkpoint.py")
+_MANAGER_NAMES = ("manager", "_manager", "checkpoint_manager", "ckpt_manager")
+
+
+def _ckpt_manager_violations(tree: ast.AST, rel: str) -> list[str]:
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("save", "restore")
+        ):
+            continue
+        base = node.func.value
+        name = (
+            base.attr if isinstance(base, ast.Attribute)
+            else base.id if isinstance(base, ast.Name)
+            else None
+        )
+        if name in _MANAGER_NAMES:
+            violations.append(
+                f"{rel}:{node.lineno}: bare {name}.{node.func.attr}(...) "
+                "outside io/checkpoint.py bypasses the verified checkpoint "
+                "wrappers (save retry/backoff, checksum manifest, "
+                "verify-before-restore with fallback) — go through "
+                "Checkpointer.save / restore_latest / restore_before"
+            )
+    return violations
 
 
 def _names_in(node: ast.AST) -> set[str]:
@@ -299,6 +341,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         rel.startswith(d + os.sep) for d in GRAD_ACCUM_RULE_DIRS
     ):
         violations.extend(_grad_accum_violations(tree, rel))
+    if rel != CKPT_OWNER:
+        violations.extend(_ckpt_manager_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)

@@ -742,3 +742,37 @@ def test_repo_lint_grad_accum_rule(tmp_path):
     # parallel/ owns the pipeline executors' microbatching
     rel = os.path.join("distributed_llms_example_tpu", "parallel", "acc.py")
     assert repo_lint.lint_file(str(bad), rel) == []
+
+
+def test_repo_lint_ckpt_manager_rule(tmp_path):
+    """Rule 6 (ISSUE 6): bare orbax ``manager.save``/``manager.restore``
+    outside io/checkpoint.py bypasses the integrity wrappers (save
+    retry/backoff, checksum manifest, verify-before-restore with
+    fallback) — flagged everywhere except the owning module."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    repo_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repo_lint)
+
+    bad = tmp_path / "rogue_ckpt.py"
+    bad.write_text(
+        "def f(self, manager, ckpt_manager, state, step):\n"
+        "    manager.save(step, state)\n"
+        "    manager.restore(step)\n"
+        "    self.manager.save(step, state)\n"       # attribute base too
+        "    ckpt_manager.restore(step)\n"           # aliased spelling
+        "    self.checkpointer.save(step, state)\n"  # the WRAPPER is legal
+        "    manager.wait_until_finished()\n"  # non-save/restore call is ok
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "train", "rogue_ckpt.py")
+    violations = repo_lint.lint_file(str(bad), rel)
+    assert len(violations) == 4
+    assert all("verified checkpoint wrappers" in v for v in violations)
+    # the owning module holds the one sanctioned call site
+    rel = os.path.join("distributed_llms_example_tpu", "io", "checkpoint.py")
+    assert repo_lint.lint_file(str(bad), rel) == []

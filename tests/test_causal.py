@@ -123,6 +123,8 @@ def test_causal_beam_parity_vs_hf(seed, length_penalty):
         )
 
 
+@pytest.mark.slow  # ~12s generation compile: slow tier (beam-parity
+# legs keep padding coverage fast)
 def test_causal_greedy_right_padded_rows_match_unpadded():
     """A batch of right-padded prompts must generate exactly what each row
     generates alone without padding (true-sequence RoPE positions)."""
@@ -192,6 +194,8 @@ def test_causal_dataset_masks_prompt():
     assert ex.target_ids[-1] == tok.eos_id
 
 
+@pytest.mark.slow  # ~10s training loop: slow tier (the trainer e2e
+# suites keep loop coverage fast)
 def test_causal_training_end_to_end(tmp_path):
     """llama-test trains and evals through the full Trainer."""
     from distributed_llms_example_tpu.core.config import CheckpointConfig, MeshConfig, TrainConfig

@@ -10,6 +10,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_llms_example_tpu.models.bart import BartForConditionalGeneration
 from distributed_llms_example_tpu.models.llama import LlamaForCausalLM
@@ -23,6 +24,8 @@ def _variants(cfg, module_cls):
     return mods
 
 
+@pytest.mark.slow  # ~9s dual-impl compile: slow tier (t5 flash parity
+# stays fast)
 def test_llama_flash_matches_xla():
     cfg = LLAMA_CONFIGS["llama-test"]  # head_dim 16
     mods = _variants(cfg, LlamaForCausalLM)
@@ -117,6 +120,8 @@ def test_t5_flash_matches_xla_incl_bias_table_grad():
             assert np.abs(np.asarray(b)).sum() > 0, f"{name}: zero bias-table grad"
 
 
+@pytest.mark.slow  # ~20s sharded-lbias compile: slow tier (single-device
+# t5 flash parity incl. the bias-table grad stays fast)
 def test_t5_flash_multi_device_bias_table_grads(mesh8):
     """T5 with attention_impl='flash' on an 8-device mesh: self-attention
     takes the sharded learned-bias path (hand-written vjp) — logits and

@@ -509,6 +509,8 @@ def test_llama_attn_only_dropout_fires():
     assert cfg.dropout_rate > 0.0 or attn_only
 
 
+@pytest.mark.slow  # ~21s train-step compile: slow tier (kernel parity
+# and the xla-impl step stay fast)
 def test_train_step_with_fused_dropout_runs():
     """make_train_step with dropout rng + --dropout-impl fused: one full
     optimizer step on the CPU mesh, finite loss/grad-norm, and a second

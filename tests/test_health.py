@@ -95,6 +95,8 @@ def test_bucket_of_path_covers_model_families():
     assert bucket_of_path((_Key("stacked_blocks"), _Key("self_attn"), _Key("k_proj"))) == "attn"
 
 
+@pytest.mark.slow  # ~11s health-step compile: slow tier (the injected
+# -NaN trainer e2e keeps in-graph numerics covered fast)
 def test_health_metrics_ride_the_compiled_step(dp_mesh, tiny_llama4):
     from distributed_llms_example_tpu.data.batching import LABEL_PAD
     from distributed_llms_example_tpu.train.optim import make_optimizer

@@ -58,6 +58,7 @@ def test_forward_parity(kv_heads):
     np.testing.assert_allclose(got[1, :8], ref[1, :8], atol=3e-4, rtol=2e-3)
 
 
+@pytest.mark.slow  # ~7s cached-decode compile: slow tier
 def test_cached_decode_matches_full():
     import jax
     import jax.numpy as jnp

@@ -6,6 +6,8 @@ ever materialized.  The compiled-path plumbing (AOT lower + XLA
 memory_analysis) is exercised on the tiny config.
 """
 
+import pytest
+
 from distributed_llms_example_tpu.core.config import MeshConfig
 from distributed_llms_example_tpu.utils.memory_audit import (
     HBM_BYTES_V5E,
@@ -60,6 +62,8 @@ def test_llama_2_7b_multihost_fits_conservatively():
     assert r["analytic_peak_conservative_bytes"] < 0.75 * HBM_BYTES_V5E
 
 
+@pytest.mark.slow  # ~14s AOT compile: slow tier (the analytic path and
+# --strict bound pins stay fast)
 def test_compiled_path_runs_on_tiny_config():
     """The AOT compile + memory_analysis plumbing, on a model small enough
     to compile in CI."""

@@ -79,6 +79,8 @@ def test_aux_loss_uniform_routing_is_one():
     assert aux == pytest.approx(1.0, rel=1e-5)
 
 
+@pytest.mark.slow  # ~11s mixtral compile: slow tier (routing pins
+# stay fast)
 def test_mixtral_forward_and_aux_plumbing(mesh8):
     """Mixtral-class model: logits well-formed; moe_aux_weight>0 routes the
     sown loss into the train-step objective (loss changes with the weight)."""
@@ -123,6 +125,8 @@ def test_mixtral_forward_and_aux_plumbing(mesh8):
     assert losses[0.5] > losses[0.0] + 0.2
 
 
+@pytest.mark.slow  # ~18s two-topology compile: slow tier (forward/aux
+# plumbing and routing pins stay fast)
 def test_moe_sharded_step_equals_single_device(mesh8):
     """Expert-parallel train step == single device on TWO topologies:
     the general mesh8 (expert=1: experts replicated, megatron splits over

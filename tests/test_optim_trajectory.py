@@ -121,6 +121,8 @@ def _ours_losses(model, cfg, params) -> list[float]:
     return losses
 
 
+@pytest.mark.slow  # ~24s twin-compile trajectory: slow tier (the fast
+# tier keeps the single-step optimizer parity pins)
 def test_twenty_step_loss_curve_parity():
     hf_model, model, cfg, params = _pair()
     ours = _ours_losses(model, cfg, params)

@@ -154,6 +154,8 @@ def test_mha_module_uses_ring(sp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # ~20s+ full-step compiles per model: slow tier (the
+# kernel fwd/grad parity pins stay fast)
 @pytest.mark.parametrize("model_name", ["bart-test", "llama-test"])
 def test_train_step_equals_single_device(sp_mesh, model_name):
     """Full train step on the data×sequence×tensor mesh == single device:

@@ -174,6 +174,8 @@ def test_dropout_step_runs(mesh8, setup):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # ~15s extra step compile for the rbg key type: slow
+# tier (test_dropout_step_runs pins the threefry path fast)
 def test_dropout_step_accepts_rbg_key(mesh8, setup):
     """--prng-impl rbg hands the step a TYPED key array (TPU hardware RNG
     stream); the jitted step's replicated rng sharding must accept it and
@@ -192,6 +194,7 @@ def test_dropout_step_accepts_rbg_key(mesh8, setup):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # ~12s per-policy recompiles: slow tier
 def test_remat_policies_match_no_remat(mesh8):
     """Remat never changes math — 'full' and 'dots' policies must produce
     the identical loss as no remat at all."""

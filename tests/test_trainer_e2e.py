@@ -42,6 +42,8 @@ def tiny_cfg(tmp_path_factory):
     )
 
 
+@pytest.mark.slow  # ~29s compile+train: the fast tier keeps the health/
+# causal/recovery e2e loops; this full loop rides the slow suite
 def test_trainer_end_to_end(tiny_cfg, capsys):
     from distributed_llms_example_tpu.train.trainer import Trainer
 
@@ -63,6 +65,8 @@ def test_trainer_end_to_end(tiny_cfg, capsys):
     assert any(p.get("event") == "eval" and "rouge1" in p for p in parsed)
 
 
+@pytest.mark.slow  # rides with test_trainer_end_to_end: it resumes from
+# that run's checkpoints in the module-scoped output dir
 def test_trainer_resume(tiny_cfg):
     """A new Trainer over the same output dir must resume from the last
     checkpoint, not start over."""

@@ -24,7 +24,9 @@ Known flags: ``pipelined`` (a stage>1 pipeline adapter is in play),
 ``seq2seq``/``causal`` (family shape), ``moe`` (config has routed
 experts), ``fused_ce`` (--fused-ce), ``ring`` (--attention-impl ring),
 ``forced_dense_attention`` (--attention-impl xla/flash), ``grad_accum``
-(--grad-accum-steps > 1 — the in-step scan accumulation).
+(--grad-accum-steps > 1 — the in-step scan accumulation), ``decode``
+(the KV-cache serving workload: prefill/decode split + continuous
+batching — serving/engine.py and the Evaluator's split path).
 """
 
 from __future__ import annotations
@@ -100,6 +102,30 @@ KNOWN_BAD: tuple[BadCombo, ...] = (
             "memory trade for pure scan overhead; raise "
             "--pipeline-microbatches instead (the step owns accumulation "
             "on GSPMD meshes, the pipeline owns it under stage>1)"
+        ),
+    ),
+    BadCombo(
+        id="decode-pipelined",
+        flags=("decode",),
+        axes_over_1=("stage",),
+        reason=(
+            "KV-cache decode does not run through stage>1 pipelines: the "
+            "pipeline schedules are training/teacher-forced only (no cache "
+            "path in their manual regions) — unstack the pipelined params "
+            "onto an fsdp/tensor mesh to serve (the trainer's ROUGE eval "
+            "does exactly this)"
+        ),
+    ),
+    BadCombo(
+        id="decode-sequence",
+        flags=("decode",),
+        axes_over_1=("sequence",),
+        reason=(
+            "KV-cache decode does not compose with sequence parallelism: "
+            "a length-sharded cache would index slots with LOCAL shard "
+            "positions (the same contract ops/mha.py enforces inside "
+            "manual sequence regions); serve on data/fsdp/tensor axes — "
+            "the cache shards batch rows and heads instead"
         ),
     ),
     BadCombo(
@@ -197,6 +223,15 @@ KNOWN_GOOD: tuple[GoodCombo, ...] = (
         id="gspmd-data-fsdp-tensor-expert",
         axes=("data", "fsdp", "tensor", "expert"),
         notes="no pipeline: GSPMD partitions everything (all families)",
+    ),
+    GoodCombo(
+        id="decode-gspmd",
+        flags=("decode",),
+        axes=("data", "fsdp", "tensor", "expert"),
+        notes="KV-cache serving: cache slots shard batch rows over "
+              "data×fsdp×expert and heads over tensor (CACHE_RULES); "
+              "pinned by the continuous-batching determinism test on the "
+              "8-device mesh",
     ),
     GoodCombo(
         id="sequence-parallel-unpipelined",

@@ -466,6 +466,62 @@ def ppermute_chain_smell(
     )
 
 
+def prefill_in_decode_smell(
+    instrs: Mapping[str, HloInstr],
+    *,
+    enc_len: int,
+    batch: int,
+    heads: int,
+    q_len: int = 1,
+    margin: float = 2.0,
+) -> Finding | None:
+    """The serving twin of the once-per-step census: error when the
+    compiled DECODE-STEP program contains encoder/prefill-sized matmuls.
+
+    Contract: prefill runs the encoder and projects cross-attention K/V
+    exactly ONCE per sequence (``cross_kv``-computed-once); the per-token
+    decode step may only read them.  The largest legitimate tensor with an
+    ``enc_len`` dimension a decode step PRODUCES in a dot is the
+    cross-attention score block — ``batch·heads·q_len·enc_len`` elements.
+    A re-projected cross K/V is ``head_dim/q_len`` times that; a re-run
+    encoder matmul (d_model/d_ff wide) is orders of magnitude past it.  So
+    the predicate is: any ``dot`` whose output shape carries a dim equal
+    to ``enc_len`` AND whose element count exceeds ``margin ×`` the score
+    bound.  ``enc_len`` is the encoder length (seq2seq) or the cache/mask
+    width (causal — a re-run prompt pass shows the same signature).  Pure
+    over parsed instructions; ``lint_decode_step`` wires it to the real
+    AOT-compiled step."""
+    bound = margin * batch * heads * max(q_len, 1) * enc_len
+    offenders: list[str] = []
+    for name, instr in instrs.items():
+        if instr.op != "dot":
+            continue
+        dims = [int(d) for d in instr.dims.split(",") if d]
+        if enc_len in dims and instr.elems > bound:
+            offenders.append(name)
+    if not offenders:
+        return None
+    worst = max(offenders, key=lambda n: instrs[n].elems)
+    return Finding(
+        severity="error",
+        pass_name="ir",
+        code="prefill-in-decode",
+        message=(
+            f"{len(offenders)} dot(s) in the compiled decode step produce "
+            f"prefill-sized tensors (an {enc_len}-long dim at "
+            f"{instrs[worst].elems} elements, e.g. %{worst}) — the decode "
+            "step is re-running encoder/prefill compute or re-projecting "
+            "cross-attention K/V every token; prefill computes those ONCE "
+            "per sequence (the cross_kv contract)"
+        ),
+        context={
+            "count": len(offenders),
+            "instructions": offenders[:8],
+            "bound_elems": int(bound),
+        },
+    )
+
+
 def host_transfer_instructions(instrs: Mapping[str, HloInstr]) -> list[str]:
     """Names of instructions that move data between host and device —
     the ROADMAP "host-transfer ops inside the step body" smell.  Pure
@@ -494,12 +550,17 @@ def scan_hlo_text(
     largest_param_bytes: int = 0,
     gather_bytes_threshold: int = 16 * 1024**2,
     param_element_counts: Iterable[int] | None = None,
+    decode_contract: Mapping[str, int] | None = None,
 ) -> list[Finding]:
     """Scan post-optimization HLO text.  Pure function of the text.
 
     ``param_element_counts`` (full per-leaf element counts of the model's
     parameter tree) additionally splits the collective census byte totals
-    into gradient/parameter vs activation traffic."""
+    into gradient/parameter vs activation traffic.
+
+    ``decode_contract`` marks the text as a SERVING decode step and runs
+    ``prefill_in_decode_smell`` over it; keys: ``enc_len``, ``batch``,
+    ``heads``, optional ``q_len``/``margin``."""
     findings: list[Finding] = []
     instrs = parse_hlo_instructions(hlo_text)
     defs = {n: (i.dtype, i.dims, i.op) for n, i in instrs.items()}
@@ -599,6 +660,12 @@ def scan_hlo_text(
             ),
             context={"count": len(host_xfers), "instructions": host_xfers[:8]},
         ))
+
+    # ---- prefill-sized compute inside a decode step --------------------
+    if decode_contract is not None:
+        smell = prefill_in_decode_smell(instrs, **decode_contract)
+        if smell is not None:
+            findings.append(smell)
 
     # ---- collective-permute chains vs the stage ring -------------------
     chain = ppermute_chain_smell(instrs, mesh_axes)
@@ -736,6 +803,75 @@ def lint_train_step(
         if placement is not None:
             findings.append(placement)
     return findings
+
+
+def decode_heads(config: Any) -> int:
+    """Decoder attention head count across the model families' config
+    spellings (bart/t5/llama) — the heads term of the decode contract."""
+    for attr in ("decoder_attention_heads", "num_heads", "num_attention_heads"):
+        n = getattr(config, attr, None)
+        if n:
+            return int(n)
+    return 1
+
+
+def lint_decode_step(
+    model_name: str,
+    *,
+    mesh_config: Any = None,
+    slots: int = 8,
+    src_len: int = 64,
+    max_new_tokens: int = 16,
+    dtype: str = "float32",
+) -> list[Finding]:
+    """AOT-compile the SERVING decode step (the per-token program of the
+    prefill/decode split, evaluation/generation.py) from abstract args and
+    scan it: ``prefill_in_decode_smell`` (no encoder recompute, no
+    per-step cross-KV re-projection) plus host transfers and the
+    collective census.  The prefill carry is ``eval_shape``-derived — no
+    weights, same recipe as ``lint_train_step``."""
+    import jax
+
+    from distributed_llms_example_tpu.core.config import MeshConfig
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.core.precision import parse_dtype
+    from distributed_llms_example_tpu.evaluation.generation import (
+        CausalGenerator,
+        Seq2SeqGenerator,
+    )
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.parallel.activation import activation_mesh
+
+    mesh = build_mesh(mesh_config or MeshConfig())
+    lm = load_model(model_name, load_weights=False, dtype=parse_dtype(dtype))
+    a_params = jax.eval_shape(lambda: lm.init_params(0))
+    cls = Seq2SeqGenerator if lm.is_seq2seq else CausalGenerator
+    gen = cls(lm.module, lm.config, max_new_tokens, num_beams=1)
+    ids = jax.ShapeDtypeStruct((slots, src_len), jnp_int32())
+    mask = jax.ShapeDtypeStruct((slots, src_len), jnp_int32())
+    with activation_mesh(mesh):
+        a_carry = jax.eval_shape(gen.prefill, a_params, ids, mask)
+        compiled = jax.jit(gen.decode_step).lower(a_params, a_carry).compile()
+    text = compiled.as_text()
+    # causal decode attends the full prompt+generation cache width; a
+    # re-run prompt pass shows up at the same width
+    enc_len = src_len if lm.is_seq2seq else src_len + max_new_tokens
+    return scan_hlo_text(
+        text,
+        mesh_axes=dict(mesh.shape),
+        decode_contract={
+            "enc_len": enc_len,
+            "batch": slots,
+            "heads": decode_heads(lm.config),
+            "q_len": 1,
+        },
+    )
+
+
+def jnp_int32():
+    import jax.numpy as jnp
+
+    return jnp.int32
 
 
 def skipped(reason: str) -> list[Finding]:

@@ -79,6 +79,7 @@ def run_passes(
     dtype: str = "bfloat16",
     remat: bool = False,
     grad_accum_steps: int = 1,
+    serve: bool = False,
 ) -> list[Finding]:
     """The three passes over one (model, mesh, config) triple."""
     import jax
@@ -107,6 +108,26 @@ def run_passes(
         a_params, rules if rules is not None else default_rules()
     )
 
+    # Serving passes (--serve): the KV-cache rule set validated like the
+    # param rules, over the abstract decode cache — plus the decode rows
+    # of the composition matrix and (below, with the IR pass) the compiled
+    # decode step's prefill-in-decode scan
+    serve_flags: tuple[str, ...] = ()
+    if serve:
+        from distributed_llms_example_tpu.evaluation.generation import (
+            abstract_cache,
+        )
+
+        serve_flags = ("decode", "seq2seq" if lm.is_seq2seq else "causal")
+        findings += spec_lint.lint_cache_sharding(
+            abstract_cache(
+                lm.module, a_params,
+                batch=global_batch, max_new_tokens=tgt_len,
+                src_len=src_len, is_seq2seq=lm.is_seq2seq,
+            ),
+            axis_sizes,
+        )
+
     # Pass 3 — composition matrix (cheap; run before the compile pass so a
     # known-crash combo is reported even when the compile would die)
     pipelined = axis_sizes.get("stage", 1) > 1
@@ -120,7 +141,7 @@ def run_passes(
             attention_impl=attention_impl,
             num_experts=int(getattr(lm.config, "num_experts", 0) or 0),
             grad_accum_steps=grad_accum_steps,
-        ),
+        ) | set(serve_flags),
     )
 
     # Pass 2 — lowered-program lint (needs real devices for the SPMD
@@ -157,6 +178,17 @@ def run_passes(
                 remat=remat,
                 grad_accum_steps=grad_accum_steps,
             )
+            if serve:
+                # the compiled SERVING decode step: no encoder recompute,
+                # no per-step cross-KV re-projection (prefill-in-decode)
+                findings += ir_lint.lint_decode_step(
+                    model,
+                    mesh_config=MeshConfig(**axis_sizes),
+                    slots=global_batch,
+                    src_len=src_len,
+                    max_new_tokens=tgt_len,
+                    dtype=dtype,
+                )
     return findings
 
 
@@ -203,6 +235,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="lint the in-step grad-accumulation config: the "
                         "composition row (accum x stage>1) and, with the IR "
                         "pass, the once-per-step optimizer placement census")
+    p.add_argument("--serve", action="store_true",
+                   help="also lint the SERVING surfaces: cache sharding "
+                        "rules over the abstract decode cache, the decode "
+                        "composition rows, and (with the IR pass) the "
+                        "compiled decode step's prefill-in-decode scan")
     p.add_argument("--no-ir", action="store_true",
                    help="skip the lowered-program pass (no AOT compile)")
     p.add_argument("--strict", action="store_true",
@@ -242,6 +279,7 @@ def main(argv: list[str] | None = None) -> int:
             dtype=args.dtype,
             remat=args.remat,
             grad_accum_steps=args.grad_accum_steps,
+            serve=args.serve,
         )
     emit(findings, as_json=args.json)
     counts = count_by_severity(findings)

@@ -120,6 +120,60 @@ def lint_accumulator_mirror(params: Any, rules: Any = None) -> list[Finding]:
     return findings
 
 
+def lint_cache_sharding(
+    cache: Any,
+    mesh_axes: Mapping[str, int],
+    *,
+    rules: Any = None,
+    replicated_bytes_threshold: int = DEFAULT_REPLICATED_BYTES_THRESHOLD,
+) -> list[Finding]:
+    """Pass 1 for the SERVING state: the per-layer KV cache is the second
+    long-lived sharded tree (params being the first), so its rule set
+    (``parallel/sharding.py CACHE_RULES``) gets the same validation —
+    unknown axes, duplicate axes, dead rules, ragged dims, and any
+    cached_key/cached_value leaf that would end up fully replicated on a
+    mesh with batch/tensor capacity (a replicated cache multiplies decode
+    HBM by the mesh size, exactly the unsharded-cache failure this
+    subsystem exists to close).  ``cache`` is an abstract tree
+    (ShapeDtypeStruct leaves) — e.g. ``evaluation.generation
+    abstract_cache``."""
+    if rules is None:
+        from distributed_llms_example_tpu.parallel.sharding import cache_rules
+
+        rules = cache_rules()
+    findings = lint_sharding_rules(
+        rules, mesh_axes, cache,
+        replicated_bytes_threshold=replicated_bytes_threshold,
+    )
+    # the oversized-replicated check above only fires on rule FALLTHROUGH;
+    # for the cache the contract is stronger — every K/V buffer must hit a
+    # sharding rule (a cache leaf no rule matches decodes replicated)
+    import jax.tree_util as jtu
+
+    from distributed_llms_example_tpu.parallel.sharding import _path_str
+
+    leaves: list[tuple[str, Any]] = []
+    jtu.tree_map_with_path(lambda p, x: leaves.append((_path_str(p), x)), cache)
+    for path, leaf in leaves:
+        if len(getattr(leaf, "shape", ())) != 4:
+            continue
+        if rules.match_path(path) is None:
+            findings.append(
+                Finding(
+                    severity="error",
+                    pass_name="spec",
+                    code="unmatched-cache-leaf",
+                    message=(
+                        f"cache leaf {path} matches no cache sharding rule — "
+                        "it would decode fully replicated (per-device HBM × "
+                        "mesh size for the serving state)"
+                    ),
+                    context={"leaf": path},
+                )
+            )
+    return findings
+
+
 def lint_sharding_rules(
     rules: Any,
     mesh_axes: Mapping[str, int],

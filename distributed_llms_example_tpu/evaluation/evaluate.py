@@ -22,10 +22,8 @@ from distributed_llms_example_tpu.data.dataset import SummarizationDataset
 from distributed_llms_example_tpu.data.tokenizer import Tokenizer
 from distributed_llms_example_tpu.evaluation import rouge as rouge_mod
 from distributed_llms_example_tpu.evaluation.generation import (
-    make_beam_search,
-    make_causal_beam_search,
-    make_causal_greedy,
-    make_greedy_generate,
+    CausalGenerator,
+    Seq2SeqGenerator,
 )
 from distributed_llms_example_tpu.evaluation.metrics import aggregate_mean
 from distributed_llms_example_tpu.parallel.activation import activation_mesh
@@ -63,28 +61,28 @@ class Evaluator:
     is_seq2seq: bool = True
 
     def __post_init__(self) -> None:
-        if not self.is_seq2seq:
-            # decoder-only models: prompt prefill + cached decode, beam or
-            # greedy per num_beams (reference live contract: beams=2)
-            if self.num_beams > 1:
-                gen = make_causal_beam_search(
-                    self.model, self.config, self.max_new_tokens, self.num_beams, self.length_penalty
-                )
-            else:
-                gen = make_causal_greedy(self.model, self.config, self.max_new_tokens)
-        elif self.num_beams > 1:
-            gen = make_beam_search(
-                self.model, self.config, self.max_new_tokens, self.num_beams, self.length_penalty
-            )
-        else:
-            gen = make_greedy_generate(self.model, self.config, self.max_new_tokens)
-        jitted = jax.jit(gen)
+        # prefill/decode SPLIT path: the encoder + cross-KV projection and
+        # the per-token decode loop are separately compiled programs, each
+        # carrying the sharded cache (batch rows over data×fsdp, heads over
+        # tensor — constrain_cache) instead of whatever GSPMD would guess
+        # for an unconstrained zeros-init.  Multi-chip eval thus decodes
+        # with sharded params AND sharded serving state.
+        cls = Seq2SeqGenerator if self.is_seq2seq else CausalGenerator
+        self.generator = cls(
+            self.model, self.config, self.max_new_tokens,
+            num_beams=self.num_beams, length_penalty=self.length_penalty,
+        )
+        prefill = jax.jit(self.generator.prefill)
+        decode = jax.jit(self.generator.decode_loop)
+        finalize = jax.jit(self.generator.finalize)
 
-        # tracing must see the mesh so the models' activation constraints
-        # bake into the compiled generation program (same as the train step)
-        def generate(*args):
+        # tracing must see the mesh so the models' activation + cache
+        # constraints bake into the compiled programs (same as the train step)
+        def generate(params, ids, mask):
             with activation_mesh(self.mesh):
-                return jitted(*args)
+                carry = prefill(params, ids, mask)
+                carry = decode(params, carry)
+                return finalize(carry)
 
         self._generate = generate
 

@@ -1,6 +1,12 @@
 """Unified training CLI — replaces the reference's three entry-point scripts.
 
-One command serves all three of the reference's launch modes (SURVEY.md §7):
+Subcommand ``serve`` runs the continuous-batching inference engine over a
+prompts file (``python -m distributed_llms_example_tpu.launch.cli serve
+--model-ckpt ... --prompts-file prompts.json``): prefill/decode split,
+sharded KV-cache slots, admit/evict per token step, serve_window /
+serve_summary obs events — see README "Serving" and serving/engine.py.
+
+One (sub)command serves all three of the reference's launch modes (SURVEY.md §7):
 
 - local / single host:   ``python -m distributed_llms_example_tpu.launch.cli
                            --train-file train.json --val-file val.json``
@@ -39,6 +45,7 @@ health & post-mortem").
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -86,7 +93,163 @@ def resolve_dataset_files(train_file: str, val_file: str) -> tuple[str, str]:
         ) from None
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dllm-train serve",
+        description="continuous-batching inference over a prompts file "
+                    "(serving/engine.py): prefill/decode split, sharded "
+                    "KV-cache slots, admit/evict per token step",
+    )
+    p.add_argument("--model-ckpt", type=str, default="t5-small")
+    p.add_argument("--tokenizer", type=str, default="")
+    p.add_argument("--prompts-file", type=str, required=True,
+                   help="JSON array / JSONL of records (source column "
+                        "resolved like training data) or plain strings")
+    p.add_argument("--source-column", type=str, default="")
+    p.add_argument("--output-file", type=str, default="",
+                   help="write {prompt, output, tokens} JSONL here "
+                        "(default: stdout)")
+    p.add_argument("--num-prompts", type=int, default=0, help="0 = all")
+    p.add_argument("--max-slots", type=int, default=8,
+                   help="concurrent decode slots (the fixed serving batch)")
+    p.add_argument("--prefill-batch", type=int, default=0,
+                   help="sequences prefilled per admission chunk "
+                        "(0 = max-slots, which always shards when the "
+                        "slot count does)")
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("--max-source-length", type=int, default=1024)
+    p.add_argument("--log-every-steps", type=int, default=50)
+    p.add_argument("--mesh", type=str, default="data=-1")
+    p.add_argument("--compute-dtype", type=str, default="bfloat16")
+    p.add_argument("--attention-impl", type=str, default="",
+                   choices=("", "auto", "flash", "ring", "xla"))
+    p.add_argument("--lint", type=str, default="warn",
+                   choices=("off", "warn", "strict"),
+                   help="serving startup lint: cache sharding rules vs the "
+                        "mesh + the decode composition rows")
+    return p
+
+
+def _prompt_text(record, source_column: str) -> str:
+    if isinstance(record, str):
+        return record
+    if source_column:
+        return str(record[source_column])
+    for col in ("dialogue", "article", "prompt", "text", "source"):
+        if col in record:
+            return str(record[col])
+    raise SystemExit(
+        f"cannot resolve a prompt column in record keys {sorted(record)}; "
+        "pass --source-column"
+    )
+
+
+def serve_main(argv: list[str] | None = None) -> int:
+    """The ``serve`` subcommand: load → shard → continuous-batching decode."""
+    args = build_serve_parser().parse_args(argv)
+    import jax
+
+    from distributed_llms_example_tpu.core.config import parse_mesh_arg
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.core.precision import parse_dtype
+    from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.parallel.sharding import shard_params
+    from distributed_llms_example_tpu.serving.engine import (
+        ServeConfig,
+        ServingEngine,
+        trim_eos,
+    )
+    from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+    if jax.process_count() > 1:
+        raise SystemExit(
+            "the serving engine is single-controller; run one process "
+            "(multi-host serving is a router above it, not a collective)"
+        )
+    records = load_json_records(args.prompts_file)
+    if args.num_prompts > 0:
+        records = records[: args.num_prompts]
+    prompts = [_prompt_text(r, args.source_column) for r in records]
+    lm = load_model(
+        args.model_ckpt,
+        dtype=parse_dtype(args.compute_dtype),
+        attention_impl=args.attention_impl or None,
+    )
+    mesh = build_mesh(parse_mesh_arg(args.mesh))
+    if args.lint != "off":
+        from distributed_llms_example_tpu.analysis.composition import (
+            check_composition,
+        )
+        from distributed_llms_example_tpu.analysis.findings import (
+            emit as emit_findings,
+            has_errors,
+        )
+        from distributed_llms_example_tpu.analysis.spec_lint import (
+            lint_cache_sharding,
+        )
+        from distributed_llms_example_tpu.evaluation.generation import abstract_cache
+
+        a_params = jax.eval_shape(lambda: lm.init_params(0))
+        findings = lint_cache_sharding(
+            abstract_cache(
+                lm.module, a_params,
+                batch=args.max_slots, max_new_tokens=args.max_new_tokens,
+                src_len=args.max_source_length, is_seq2seq=lm.is_seq2seq,
+            ),
+            dict(mesh.shape),
+        )
+        findings += check_composition(
+            family=lm.family, mesh_axes=dict(mesh.shape),
+            flags=("decode", "seq2seq" if lm.is_seq2seq else "causal"),
+        )
+        emit_findings(findings, as_json=True)
+        if args.lint == "strict" and has_errors(findings):
+            raise SystemExit(
+                "serving lint found error-level findings; rerun with "
+                "--lint warn to proceed anyway"
+            )
+    tok = get_tokenizer(args.tokenizer, args.model_ckpt)
+    params = lm.params if lm.params is not None else lm.init_params(0)
+    params = shard_params(params, mesh)
+    encode = tok.encode_source if lm.is_seq2seq else tok.encode_prompt
+    requests = [encode(t, args.max_source_length) for t in prompts]
+    engine = ServingEngine(
+        lm.module, lm.config, mesh,
+        ServeConfig(
+            max_slots=args.max_slots,
+            prefill_batch=args.prefill_batch,
+            max_new_tokens=args.max_new_tokens,
+            max_source_length=args.max_source_length,
+            log_every_steps=args.log_every_steps,
+        ),
+        is_seq2seq=lm.is_seq2seq,
+    )
+    outputs = engine.generate(params, requests)
+    eos, pad = lm.config.eos_token_id, lm.config.pad_token_id
+    lines = []
+    for prompt, ids in zip(prompts, outputs):
+        kept = [t for t in trim_eos(ids, eos, pad) if t != eos]
+        lines.append({"prompt": prompt, "output": tok.decode(kept), "tokens": len(kept)})
+    # request OUTPUTS (the served product), not telemetry: they go to the
+    # chosen sink as a plain JSONL document — the metric/obs channel is
+    # log_json's, which already carried serve_window/serve_summary above
+    out = open(args.output_file, "w") if args.output_file else sys.stdout
+    try:
+        for rec in lines:
+            out.write(json.dumps(rec) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+            log_json({"event": "serve_output", "path": args.output_file, "records": len(lines)})
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.source_column:

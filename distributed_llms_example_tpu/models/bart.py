@@ -141,10 +141,12 @@ class BartDecoderLayer(nn.Module):
         deterministic: bool = True,
         use_cache: bool = False,
         cross_kv=None,
+        cache_positions=None,
     ):
         residual = hidden
         h = self.self_attn(
-            hidden, bias=self_bias, use_cache=use_cache, deterministic=deterministic
+            hidden, bias=self_bias, use_cache=use_cache, deterministic=deterministic,
+            cache_positions=cache_positions,
         )
         hidden = self.self_attn_layer_norm(self.dropout(h, deterministic, residual=residual))
         residual = hidden
@@ -231,8 +233,19 @@ class BartForConditionalGeneration(nn.Module):
     ):
         cfg = self.config
         q_len = decoder_input_ids.shape[1]
-        pos = jnp.arange(q_len) + cache_offset + cfg.POSITION_OFFSET
-        hidden = self.shared(decoder_input_ids) * cfg.embed_scale + self.decoder_embed_positions(pos)[None]
+        # a (B,) cache_offset is the continuous-batching form: each serving
+        # slot decodes at its own position (per-row position embeddings +
+        # per-row cache writes)
+        cache_positions = None
+        off = jnp.asarray(cache_offset)
+        if off.ndim == 1:
+            cache_positions = off.astype(jnp.int32)
+            pos = off[:, None] + jnp.arange(q_len)[None, :] + cfg.POSITION_OFFSET
+            pos_embed = self.decoder_embed_positions(pos)  # (B, q, d)
+        else:
+            pos = jnp.arange(q_len) + cache_offset + cfg.POSITION_OFFSET
+            pos_embed = self.decoder_embed_positions(pos)[None]
+        hidden = self.shared(decoder_input_ids) * cfg.embed_scale + pos_embed
         hidden = self.dropout(self.decoder_layernorm_embedding(hidden), deterministic=deterministic)
         if use_cache:
             self_bias = None  # causal/validity handled inside cached attention
@@ -250,6 +263,7 @@ class BartForConditionalGeneration(nn.Module):
             hidden = constrain_hidden(blk(
                 hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache,
                 cross_kv=None if cross_kv is None else cross_kv[i],
+                cache_positions=cache_positions,
             ))
         logits = constrain_logits(hidden @ self.shared.embedding.astype(self.dtype).T)
         return logits + self.final_logits_bias.astype(logits.dtype)

@@ -117,11 +117,13 @@ class LlamaBlock(nn.Module):
         self.dropout = Dropout(self.config.dropout_rate)
 
     def __call__(
-        self, hidden, bias=None, deterministic: bool = True, use_cache: bool = False, positions=None
+        self, hidden, bias=None, deterministic: bool = True, use_cache: bool = False,
+        positions=None, cache_positions=None,
     ):
         h = self.self_attn(
             self.attn_norm(hidden), bias=bias, use_cache=use_cache,
             positions=positions, deterministic=deterministic,
+            cache_positions=cache_positions,
         )
         # rate defaults to 0 (checkpoint-faithful): the helper is then a
         # plain residual add; a recipe that turns dropout on gets the
@@ -452,13 +454,16 @@ class LlamaForCausalLM(nn.Module):
         cache_offset: int | jnp.ndarray = 0,
         max_kv_len: int | None = None,
         positions: jnp.ndarray | None = None,
+        cache_positions: jnp.ndarray | None = None,
     ):
         hidden = constrain_hidden(self.embed_tokens(input_ids))
         # causal masking lives inside MultiHeadAttention (applied natively by
         # the flash kernel); only the padding mask is passed as a bias
         bias = mask_to_bias(attention_mask) if attention_mask is not None else None
         for blk in self.blocks:
-            hidden = constrain_hidden(blk(hidden, bias, deterministic, use_cache, positions))
+            hidden = constrain_hidden(
+                blk(hidden, bias, deterministic, use_cache, positions, cache_positions)
+            )
         return constrain_logits(self.lm_head(self.final_norm(hidden)))
 
     def hidden_states(self, input_ids, attention_mask=None, *, deterministic: bool = True):

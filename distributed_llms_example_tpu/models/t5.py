@@ -141,9 +141,13 @@ class T5Attention(nn.Module):
         return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
     @nn.compact
-    def _cache_kv(self, key: jnp.ndarray, value: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, Any]:
+    def _cache_kv(self, key: jnp.ndarray, value: jnp.ndarray,
+                  cache_positions: jnp.ndarray | None = None) -> tuple[jnp.ndarray, jnp.ndarray, Any]:
         """Append this step's k/v into the cache; returns full-length k/v and
-        the (pre-update) cache index."""
+        the (pre-update) cache index.  ``cache_positions`` (B,) switches to
+        per-row writes (continuous-batching slots at distinct offsets;
+        q_len must be 1, out-of-range positions drop — idle slots park
+        there)."""
         # At creation time (init with full-length dummy inputs) the buffers
         # are allocated but NOT written: cache_index must stay 0 so the first
         # real decode step writes at position 0.
@@ -153,11 +157,25 @@ class T5Attention(nn.Module):
         cache_index = self.variable("cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32))
         idx = cache_index.value
         if is_initialized:
-            # buffers are stored (batch, heads, max_len, head_dim); write at idx on axis 2
-            k = jax.lax.dynamic_update_slice(cached_k.value, key, (0, 0, idx, 0))
-            v = jax.lax.dynamic_update_slice(cached_v.value, value, (0, 0, idx, 0))
-            cached_k.value, cached_v.value = k, v
-            cache_index.value = idx + key.shape[2]
+            if cache_positions is not None:
+                if key.shape[2] != 1:
+                    raise ValueError(
+                        f"per-row cache_positions requires q_len == 1, got {key.shape[2]}"
+                    )
+                b = jnp.arange(key.shape[0])
+                k = cached_k.value.at[b, :, cache_positions].set(
+                    key[:, :, 0, :], mode="drop"
+                )
+                v = cached_v.value.at[b, :, cache_positions].set(
+                    value[:, :, 0, :], mode="drop"
+                )
+                cached_k.value, cached_v.value = k, v
+            else:
+                # buffers are stored (batch, heads, max_len, head_dim); write at idx on axis 2
+                k = jax.lax.dynamic_update_slice(cached_k.value, key, (0, 0, idx, 0))
+                v = jax.lax.dynamic_update_slice(cached_v.value, value, (0, 0, idx, 0))
+                cached_k.value, cached_v.value = k, v
+                cache_index.value = idx + key.shape[2]
         else:
             k, v = cached_k.value, cached_v.value
         return k, v, idx
@@ -172,6 +190,7 @@ class T5Attention(nn.Module):
         learned_bias: jnp.ndarray | None = None,
         cross_kv: tuple[jnp.ndarray, jnp.ndarray] | None = None,
         deterministic: bool = True,
+        cache_positions: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """``bias``: constant (mask-like) additive bias.  ``learned_bias``:
         the (1, H, Q, K) relative-position bias, kept SEPARATE so the flash
@@ -200,14 +219,56 @@ class T5Attention(nn.Module):
             v = self._split(self.v_proj(kv_src))
         causal_in_bias = False
         if use_cache and self.causal:
-            k, v, idx = self._cache_kv(k, v)
-            # mask out cache slots beyond the current position
+            from distributed_llms_example_tpu.ops.flash_attention import (
+                flash_decode_run,
+            )
+            from distributed_llms_example_tpu.ops.mha import (
+                _log_impl_once,
+                decode_step_bias,
+                select_decode_impl,
+            )
+            from distributed_llms_example_tpu.parallel.activation import current_mesh
+
+            k, v, idx = self._cache_kv(k, v, cache_positions)
             kv_len = k.shape[2]
             q_len = q.shape[2]
-            pos = jnp.arange(kv_len)[None, None, None, :]
-            valid = pos <= (idx + q_len - 1)
-            causal = pos <= (idx + jnp.arange(q_len)[None, None, :, None])
-            step_bias = jnp.where(valid & causal, 0.0, NEG_INF)
+            offsets = (
+                cache_positions
+                if cache_positions is not None
+                else jnp.full((q.shape[0],), idx, jnp.int32)
+            )
+            mesh = current_mesh()
+            impl, reason = select_decode_impl(
+                self.config.attention_impl,
+                batch=q.shape[0],
+                heads=self.config.num_heads,
+                head_dim=self.config.d_kv,
+                q_len=q_len,
+                kv_len=kv_len,
+                mesh=mesh,
+                backend=jax.default_backend(),
+                device_count=jax.device_count(),
+            )
+            if (
+                impl == "flash_decode"
+                and not deterministic
+                and float(self.config.attn_dropout_rate) > 0.0
+            ):
+                # no in-kernel mask stream in the decode kernel: keep the
+                # XLA probs-dropout semantics via _attend below
+                impl, reason = "xla", "probs dropout requested on cached decode"
+            _log_impl_once(f"t5:{impl}", reason)
+            if impl == "flash_decode":
+                # the decode-step relative-position bias rides ``bias`` as a
+                # constant (no gradients in decode); validity/causality ride
+                # the kernel's per-row length mask.  T5 scores are unscaled.
+                out = flash_decode_run(
+                    q, k, v, bias, offsets=offsets, mesh=mesh, scale=1.0,
+                    dtype=self.dtype,
+                )
+                return self.o_proj(self._merge(out))
+            # XLA path: per-row validity+causality mask merged into the bias
+            step_bias = decode_step_bias(offsets, q_len, kv_len)
             bias = step_bias if bias is None else bias + step_bias
             causal_in_bias = True
         out = self._attend(
@@ -352,6 +413,7 @@ class T5Block(nn.Module):
         use_cache: bool = False,
         pos_bias: jnp.ndarray | None = None,
         cross_kv=None,
+        cache_positions: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         # deterministic/use_cache are positional so nn.remat can mark them
         # static (argnums 5, 6 counting self at 0); pos_bias is the learned
@@ -360,6 +422,7 @@ class T5Block(nn.Module):
         h = self.self_attn(
             self.self_attn_norm(hidden), bias=self_bias, use_cache=use_cache,
             learned_bias=pos_bias, deterministic=deterministic,
+            cache_positions=cache_positions,
         )
         # residual rides the dropout kernel (one fused pass on TPU)
         hidden = self.dropout(h, deterministic, residual=hidden)
@@ -400,17 +463,29 @@ class T5Stack(nn.Module):
         self.dropout = Dropout(cfg.dropout_rate)
 
     def position_bias(self, q_len: int, kv_len: int, offset: int | jnp.ndarray = 0) -> jnp.ndarray:
-        """(1, heads, q_len, kv_len) additive relative-position bias."""
+        """(1, heads, q_len, kv_len) additive relative-position bias.
+
+        ``offset`` may be a (B,) array — per-ROW decode offsets for
+        continuous-batching slots, yielding a (B, heads, q_len, kv_len)
+        bias (each slot's relative positions computed against its own
+        cache offset)."""
         cfg = self.config
-        q_pos = jnp.arange(q_len)[:, None] + offset
-        kv_pos = jnp.arange(kv_len)[None, :]
+        off = jnp.asarray(offset)
+        if off.ndim == 1:
+            q_pos = off[:, None, None] + jnp.arange(q_len)[None, :, None]  # (B, q, 1)
+            rel = jnp.arange(kv_len)[None, None, :] - q_pos  # (B, q, kv)
+        else:
+            q_pos = jnp.arange(q_len)[:, None] + off
+            rel = jnp.arange(kv_len)[None, :] - q_pos  # (q, kv)
         buckets = relative_position_bucket(
-            kv_pos - q_pos,
+            rel,
             bidirectional=not self.causal,
             num_buckets=cfg.relative_attention_num_buckets,
             max_distance=cfg.relative_attention_max_distance,
         )
-        bias = self.relative_attention_bias(buckets)  # (q, kv, heads)
+        bias = self.relative_attention_bias(buckets)  # (..., q, kv, heads)
+        if off.ndim == 1:
+            return bias.transpose(0, 3, 1, 2).astype(self.dtype)
         return bias.transpose(2, 0, 1)[None].astype(self.dtype)
 
     def __call__(
@@ -428,14 +503,20 @@ class T5Stack(nn.Module):
     ) -> jnp.ndarray:
         q_len = hidden.shape[1]
         pos_bias = None
+        cache_positions = None
         if use_cache and self.causal:
             # Incremental decoding: relative bias of the current step(s)
             # against the full cache buffer (max_kv_len); masking of not-yet-
             # written cache slots + causality is added inside T5Attention.
-            # Decode always takes the XLA path, so the learned bias can ride
-            # the combined (constant-treated) bias — no gradients in decode.
+            # The learned bias rides the combined constant-treated bias on
+            # both decode impls (XLA merged mask, flash_decode additive
+            # input) — no gradients in decode.  A (B,) ``cache_offset``
+            # is the continuous-batching form: per-SLOT offsets, per-row
+            # position bias and per-row cache writes.
             if max_kv_len is None:
                 raise ValueError("max_kv_len is required when decoding with a cache")
+            if getattr(jnp.asarray(cache_offset), "ndim", 0) == 1:
+                cache_positions = jnp.asarray(cache_offset, jnp.int32)
             self_bias = self.position_bias(q_len, max_kv_len, offset=cache_offset)
         else:
             # keep the LEARNED bias separate from the constant mask:
@@ -451,7 +532,8 @@ class T5Stack(nn.Module):
             # propagates a param sharding (d_model over fsdp/tensor) into it
             hidden = constrain_hidden(
                 blk(hidden, self_bias, encoder_hidden, cross_bias, deterministic, use_cache, pos_bias,
-                    cross_kv=None if cross_kv is None else cross_kv[i])
+                    cross_kv=None if cross_kv is None else cross_kv[i],
+                    cache_positions=cache_positions)
             )
         return self.dropout(self.final_norm(hidden), deterministic=deterministic)
 

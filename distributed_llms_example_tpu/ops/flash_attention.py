@@ -879,6 +879,246 @@ def flash_supported(q_len: int, kv_len: int, head_dim: int,
     )
 
 
+# ------------------------------------------------------- decode variant
+#
+# The four kernels above are the TRAINING shapes: square (or prefill-
+# rectangular) attention where q tiles stream against kv tiles and a
+# backward pass exists.  Serving's hot op is different: ONE query row per
+# sequence (the token being decoded) against a full-length cached K/V
+# buffer of which only the first ``offset+1`` slots are live.  The decode
+# kernel reuses the same online-softmax block machinery with three
+# changes: the whole (tiny) q block rides every grid step, validity is a
+# per-ROW length mask (k_pos <= offset[b] + q_row, bottom-right aligned —
+# exactly the alignment the training kernel's top-left causal mask cannot
+# express), and kv tiles entirely beyond the longest live row are SKIPPED
+# via a dynamic pl.when, so a step early in the decode reads ~offset/L of
+# the cache instead of all of it.  Inference only: no vjp.
+
+
+def _decode_kernel(
+    *refs, scale: float, block_k: int, nk: int, has_bias: bool,
+):
+    it = iter(refs)
+    off_ref = next(it)  # SMEM (batch,) int32: absolute position of q row 0
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    o_ref, m_scr, l_scr, acc_scr = it
+    bi = pl.program_id(0)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    offset = off_ref[bi]
+    q_len = q_ref.shape[2]
+    # every live position of this row's tile is <= offset + q_len - 1:
+    # tiles past that contribute nothing — skip their DMA'd compute
+    live = ki * block_k <= offset + q_len - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]  # (q_len, d)
+        k = k_ref[0, 0]  # (block_k, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= scale
+        if bias_ref is not None:
+            s += bias_ref[0, 0].astype(jnp.float32)
+        # bottom-right aligned length mask: q row r sits at absolute
+        # position offset + r and may attend cache slots <= its own
+        q_pos = offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        safe_m = jnp.where(m_next == -jnp.inf, 0.0, m_next)
+        alpha = jnp.exp(m_prev - safe_m)
+        p = jnp.exp(s - safe_m)
+        l_scr[:] = jax.lax.broadcast_in_dim(
+            (alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True))[:, 0],
+            l_scr.shape, (0,),
+        )
+        m_scr[:] = jax.lax.broadcast_in_dim(m_next[:, 0], m_scr.shape, (0,))
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def flash_decode(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    offsets: jnp.ndarray,
+    scale: float | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+    dtype: jnp.dtype | None = None,
+) -> jnp.ndarray:
+    """Decode-step attention: a short q block against a cached K/V buffer.
+
+    ``q``: (B, H, Q, d) with Q the decode step width (1 for token-by-token
+    decode; beam batches flatten beams into B).  ``k``/``v``: (B, H, L, d)
+    full-length cache buffers.  ``offsets``: (B,) int32 — the absolute
+    cache position of each row's FIRST query; row r of the q block attends
+    cache slots <= offsets[b] + r, so not-yet-written slots never
+    contribute regardless of their (stale, reused) contents.  ``bias`` is
+    a constant additive mask, every dim 1 or full — the padding mask /
+    T5's decode-step relative-position bias.  Inference only (no vjp);
+    numerically identical to masked ``dot_product_attention`` on the same
+    inputs (the parity tests pin greedy and beam decode against it).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    batch, heads, q_len, d = q.shape
+    kv_len = k.shape[2]
+    block_k = auto_block(kv_len) if block_k is None else min(block_k, kv_len)
+    if not block_k or kv_len % block_k or block_k % 8:
+        raise ValueError(
+            f"kv_len {kv_len} not divisible into 8-aligned blocks ({block_k})"
+        )
+    if bias is not None:
+        for i, (bd, full) in enumerate(
+            zip(bias.shape, (batch, heads, q_len, kv_len))
+        ):
+            if bd not in (1, full):
+                raise ValueError(f"bias dim {i} is {bd}, must be 1 or {full}")
+    if interpret is None:
+        interpret = _default_interpret()
+    offsets = jnp.asarray(offsets, jnp.int32).reshape(batch)
+    nk = kv_len // block_k
+    grid = (batch, heads, nk)
+
+    def q_map(b, h, ki):
+        return (b, h, 0, 0)
+
+    def kv_map(b, h, ki):
+        return (b, h, ki, 0)
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets, whole array
+        pl.BlockSpec((1, 1, q_len, d), q_map),
+        pl.BlockSpec((1, 1, block_k, d), kv_map),
+        pl.BlockSpec((1, 1, block_k, d), kv_map),
+    ]
+    if bias is not None:
+        inner = _bias_spec(bias.shape, q_len, block_k)
+
+        def bias_map(b, h, ki):
+            return inner.index_map(b, h, 0, ki)
+
+        in_specs.append(pl.BlockSpec(inner.block_shape, bias_map))
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=float(scale), block_k=block_k, nk=nk,
+            has_bias=bias is not None,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, q_len, d), q_map),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((q_len, LANES), jnp.float32),
+            pltpu.VMEM((q_len, LANES), jnp.float32),
+            pltpu.VMEM((q_len, d), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offsets, *[x for x in (q, k, v, bias) if x is not None])
+    return out if dtype is None else out.astype(dtype)
+
+
+def flash_decode_supported(
+    q_len: int, kv_len: int, head_dim: int, block_k: int | None = None
+) -> bool:
+    """True when a cached decode step is kernel-eligible: the cache length
+    tiles into 8-aligned blocks, the head dim is lane-aligned, and the q
+    block is small enough to live in scratch (decode steps are 1; beam
+    reorder keeps it 1 — the cap just keeps prefill-sized calls out)."""
+    bk = auto_block(kv_len) if block_k is None else min(block_k, kv_len)
+    return (
+        0 < q_len <= 8
+        and bk > 0
+        and kv_len % bk == 0
+        and bk % 8 == 0
+        and head_dim % 8 == 0
+    )
+
+
+def flash_decode_run(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None,
+    *,
+    offsets: jnp.ndarray,
+    mesh,
+    scale: float | None = None,
+    dtype: jnp.dtype | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Run the decode kernel — directly on one device, per-shard under
+    ``shard_map`` on a mesh (batch over data×fsdp×expert, heads over
+    ``tensor``, mirroring ``ops.mha.flash_run``).  ``offsets`` shard with
+    the batch rows; the kernel body needs no collectives (decode never
+    mixes rows or heads).  A bias carrying a HEAD dim must be full-size
+    (it shards with the heads); batch dim 1-or-full as usual."""
+    import math as _math
+
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llms_example_tpu.parallel.activation import BATCH_AXES
+
+    if mesh is None or _math.prod(mesh.devices.shape) == 1:
+        return flash_decode(
+            q, k, v, bias, offsets=offsets, scale=scale, dtype=dtype,
+            interpret=interpret,
+        )
+    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
+    head_axis = "tensor" if "tensor" in mesh.shape else None
+    qkv_spec = P(batch_axes or None, head_axis, None, None)
+    off_spec = P(batch_axes or None)
+
+    def run(q, k, v, off, *rest):
+        return flash_decode(
+            q, k, v, rest[0] if rest else None, offsets=off, scale=scale,
+            dtype=dtype, interpret=interpret,
+        )
+
+    args = (q, k, v, jnp.asarray(offsets, jnp.int32).reshape(q.shape[0]))
+    in_specs = (qkv_spec, qkv_spec, qkv_spec, off_spec)
+    if bias is not None:
+        bias_spec = P(
+            (batch_axes or None) if bias.shape[0] != 1 else None,
+            head_axis if bias.shape[1] != 1 else None,
+            None,
+            None,
+        )
+        args = (*args, bias)
+        in_specs = (*in_specs, bias_spec)
+    return compat_shard_map(
+        run, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
+    )(*args)
+
+
 # --------------------------------------------- multi-device learned bias
 
 

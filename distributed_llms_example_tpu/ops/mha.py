@@ -26,6 +26,8 @@ from distributed_llms_example_tpu.ops.attention import (
 )
 from distributed_llms_example_tpu.ops.flash_attention import (
     flash_attention,
+    flash_decode_run,
+    flash_decode_supported,
     flash_supported,
 )
 from distributed_llms_example_tpu.ops.ring_attention import ring_attention, ring_attention_sharded
@@ -144,6 +146,69 @@ def select_attention_impl(
     return "flash", "auto: TPU" + (" (shard_map per-shard)" if multi_device else "")
 
 
+def select_decode_impl(
+    attention_impl: str,
+    *,
+    batch: int,
+    heads: int,
+    head_dim: int,
+    q_len: int,
+    kv_len: int,
+    mesh: Mesh | None,
+    backend: str,
+    device_count: int,
+) -> tuple[str, str]:
+    """(impl, reason) for a CACHED decode step — the serving twin of
+    ``select_attention_impl``, pure and unit-testable.
+
+    ``auto`` picks the Pallas **decode kernel** (``flash_decode``: one
+    short q block against the cached K/V buffer, per-row length mask,
+    dead-tile skip) on TPU when the cache length tiles and — under a
+    multi-device mesh — batch/heads split evenly over (data×fsdp) and
+    ``tensor`` (the kernel runs per-shard under ``shard_map``, like
+    training flash).  ``flash`` forces the kernel wherever eligible; XLA
+    attention (per-row masked ``dot_product_attention``) otherwise.
+    ``ring`` has no KV-cache path and falls back to XLA."""
+    if attention_impl not in ("auto", "flash", "ring", "xla"):
+        raise ValueError(
+            f"attention_impl={attention_impl!r}: must be 'auto', 'flash', 'ring', or 'xla'"
+        )
+    if attention_impl == "xla":
+        return "xla", "forced"
+    if attention_impl == "ring":
+        return "xla", "ring attention has no KV-cache decode path"
+    if not flash_decode_supported(q_len, kv_len, head_dim):
+        return "xla", (
+            f"decode shape not tileable (q={q_len}, kv={kv_len}, d={head_dim})"
+        )
+    if device_count > 1:
+        if mesh is None:
+            return "xla", "multi-device jit without a mesh context"
+        why = _uneven_split_blocker(mesh, heads=heads, batch=batch)
+        if why is not None:
+            return "xla", why
+    if attention_impl == "flash":
+        return "flash_decode", "forced"
+    if backend != "tpu":
+        return "xla", f"auto: backend={backend} (interpreted kernel is pure overhead)"
+    if kv_len < 128:
+        return "xla", "auto: cache too short to tile"
+    return "flash_decode", "auto: TPU decode" + (
+        " (shard_map per-shard)" if device_count > 1 else ""
+    )
+
+
+def decode_step_bias(offsets: jnp.ndarray, q_len: int, kv_len: int) -> jnp.ndarray:
+    """(B, 1, q_len, kv_len) additive validity+causality mask for a cached
+    decode step: q row r (absolute position ``offsets[b] + r``) attends
+    cache slots <= its own position — the XLA reference semantics for the
+    decode kernel's in-kernel length mask, per-row so continuous-batching
+    slots at different offsets share one program."""
+    k_pos = jnp.arange(kv_len)[None, None, None, :]
+    q_pos = offsets[:, None, None, None] + jnp.arange(q_len)[None, None, :, None]
+    return jnp.where(k_pos <= q_pos, 0.0, NEG_INF)
+
+
 def _ring_blocker(
     seq_shards: int,
     *,
@@ -240,17 +305,43 @@ class MultiHeadAttention(nn.Module):
         )
 
     @nn.compact
-    def _cache_kv(self, key: jnp.ndarray, value: jnp.ndarray):
+    def _cache_kv(self, key: jnp.ndarray, value: jnp.ndarray,
+                  cache_positions: jnp.ndarray | None = None):
+        """Append this step's k/v into the cache.
+
+        ``cache_positions`` (B,) int32 switches to PER-ROW writes — each
+        row lands at its own cache slot, the continuous-batching contract
+        where every serving slot sits at a different decode offset
+        (q_len must be 1; ``mode="drop"`` makes an out-of-range position
+        a no-op, which is how idle slots park).  Without it, the whole
+        batch writes at the shared ``cache_index`` (the static-batch
+        generation loops)."""
         is_initialized = self.has_variable("cache", "cached_key")
         cached_k = self.variable("cache", "cached_key", jnp.zeros, key.shape, key.dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros, value.shape, value.dtype)
         cache_index = self.variable("cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32))
         idx = cache_index.value
         if is_initialized:
-            k = jax.lax.dynamic_update_slice(cached_k.value, key, (0, 0, idx, 0))
-            v = jax.lax.dynamic_update_slice(cached_v.value, value, (0, 0, idx, 0))
-            cached_k.value, cached_v.value = k, v
-            cache_index.value = idx + key.shape[2]
+            if cache_positions is not None:
+                if key.shape[2] != 1:
+                    raise ValueError(
+                        f"per-row cache_positions requires q_len == 1, got {key.shape[2]}"
+                    )
+                b = jnp.arange(key.shape[0])
+                k = cached_k.value.at[b, :, cache_positions].set(
+                    key[:, :, 0, :], mode="drop"
+                )
+                v = cached_v.value.at[b, :, cache_positions].set(
+                    value[:, :, 0, :], mode="drop"
+                )
+                cached_k.value, cached_v.value = k, v
+                # the engine owns per-slot offsets; the shared counter is
+                # meaningless here and stays put
+            else:
+                k = jax.lax.dynamic_update_slice(cached_k.value, key, (0, 0, idx, 0))
+                v = jax.lax.dynamic_update_slice(cached_v.value, value, (0, 0, idx, 0))
+                cached_k.value, cached_v.value = k, v
+                cache_index.value = idx + key.shape[2]
         else:
             k, v = cached_k.value, cached_v.value
         return k, v, idx
@@ -264,6 +355,7 @@ class MultiHeadAttention(nn.Module):
         positions: jnp.ndarray | None = None,
         cross_kv: tuple[jnp.ndarray, jnp.ndarray] | None = None,
         deterministic: bool = True,
+        cache_positions: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """``positions``: optional (batch, q_len) absolute positions for RoPE
         — needed when cache slots don't equal sequence positions (right-
@@ -271,7 +363,10 @@ class MultiHeadAttention(nn.Module):
         ``cross_kv``: precomputed ``project_kv`` output — skips the k/v
         projections entirely (cross-attention decode).  ``deterministic``
         gates ``probs_dropout_rate`` (training passes False + a "dropout"
-        rng, like every other dropout)."""
+        rng, like every other dropout).  ``cache_positions``: (batch,)
+        per-row cache write offsets for continuous-batching decode (each
+        serving slot at its own position; q_len must be 1) — defaults to
+        the shared ``cache_index`` counter."""
         q = self._split(self.q_proj(hidden), self.num_heads)
         if cross_kv is not None:
             k, v = cross_kv
@@ -297,28 +392,34 @@ class MultiHeadAttention(nn.Module):
             v = self._split(self.v_proj(kv_src), self.kv_heads)
 
         offset = 0
+        decode_offsets = None  # (B,) absolute position of q row 0, cached decode
         if use_cache and self.causal:
             # RoPE must see absolute positions, so rotate before caching
             if self.use_rope:
                 if positions is None:
-                    # peek the index without mutating (mutation happens in _cache_kv)
-                    idx = (
-                        self.get_variable("cache", "cache_index")
-                        if self.has_variable("cache", "cache_index")
-                        else 0
-                    )
-                    positions = (jnp.arange(q.shape[2]) + idx)[None, :]
+                    if cache_positions is not None:
+                        positions = cache_positions[:, None] + jnp.arange(q.shape[2])[None, :]
+                    else:
+                        # peek the index without mutating (mutation happens in _cache_kv)
+                        idx = (
+                            self.get_variable("cache", "cache_index")
+                            if self.has_variable("cache", "cache_index")
+                            else 0
+                        )
+                        positions = (jnp.arange(q.shape[2]) + idx)[None, :]
                 cos, sin = rope_cos_sin(positions, self.head_dim, self.rope_theta)
                 cos, sin = cos[:, None], sin[:, None]  # add heads axis
                 q = apply_rope(q, cos, sin)
                 k = apply_rope(k, cos, sin)
-            k, v, offset = self._cache_kv(k, v)
-            kv_len, q_len = k.shape[2], q.shape[2]
-            pos = jnp.arange(kv_len)[None, None, None, :]
-            valid = pos <= (offset + q_len - 1)
-            causal = pos <= (offset + jnp.arange(q_len)[None, None, :, None])
-            step_bias = jnp.where(valid & causal, 0.0, NEG_INF)
-            bias = step_bias if bias is None else bias + step_bias
+            k, v, offset = self._cache_kv(k, v, cache_positions)
+            # validity + causality are the DECODE dispatch's job below:
+            # per-row offsets feed either the decode kernel's in-kernel
+            # length mask or decode_step_bias on the XLA path
+            decode_offsets = (
+                cache_positions
+                if cache_positions is not None
+                else jnp.full((q.shape[0],), offset, jnp.int32)
+            )
         elif self.use_rope:
             if positions is None:
                 pos = jnp.arange(q.shape[2])[None, :]
@@ -388,6 +489,46 @@ class MultiHeadAttention(nn.Module):
             b, h, s, d = out.shape
             return self.o_proj(out.transpose(0, 2, 1, 3).reshape(b, s, h * d))
         mesh = current_mesh()
+        if decode_offsets is not None:
+            decode_dropout = (
+                float(self.probs_dropout_rate) if not deterministic else 0.0
+            )
+            impl, reason = select_decode_impl(
+                self.attention_impl,
+                batch=q.shape[0],
+                heads=self.num_heads,
+                head_dim=self.head_dim,
+                q_len=q.shape[2],
+                kv_len=k.shape[2],
+                mesh=mesh,
+                backend=jax.default_backend(),
+                device_count=jax.device_count(),
+            )
+            if decode_dropout > 0.0 and impl == "flash_decode":
+                # the decode kernel has no in-kernel mask stream; a decode
+                # pass that WANTS probs dropout (MC-dropout eval) keeps the
+                # old XLA semantics instead of silently going deterministic
+                impl, reason = "xla", "probs dropout requested on cached decode"
+            _log_impl_once(impl, reason)
+            if impl == "flash_decode":
+                # bias here is the caller's constant padding mask only —
+                # validity/causality ride the kernel's per-row length mask
+                out = flash_decode_run(
+                    q, k, v, bias, offsets=decode_offsets, mesh=mesh,
+                    dtype=self.dtype,
+                )
+            else:
+                step = decode_step_bias(decode_offsets, q.shape[2], k.shape[2])
+                out = dot_product_attention(
+                    q, k, v, step if bias is None else bias + step,
+                    dtype=self.dtype,
+                    dropout_rate=decode_dropout,
+                    dropout_rng=(
+                        self.make_rng("dropout") if decode_dropout > 0.0 else None
+                    ),
+                )
+            b, h, s, d = out.shape
+            return self.o_proj(out.transpose(0, 2, 1, 3).reshape(b, s, h * d))
         impl, reason = select_attention_impl(
             self.attention_impl,
             batch=q.shape[0],

@@ -186,3 +186,29 @@ def constrain_logits(x: jax.Array) -> jax.Array:
     """(batch, seq, vocab) logits — vocab sharded over ``tensor``, seq over
     ``sequence`` under context parallelism."""
     return constrain(x, P(BATCH_AXES, _seq_axis(x), "tensor"))
+
+
+def constrain_kv(x: jax.Array) -> jax.Array:
+    """(batch, heads, len, head_dim) cached K/V or precomputed cross-K/V:
+    batch rows over the batch axes, heads over ``tensor`` — the serving
+    twin of ``constrain_hidden``.  The layout (and its divisibility
+    fallbacks) is ``parallel/sharding.py kv_leaf_spec`` — the ONE
+    definition CACHE_RULES, this constraint, and the engine's host-side
+    placement all share."""
+    mesh = current_mesh()
+    if mesh is None or x.ndim != 4:
+        return x
+    from distributed_llms_example_tpu.parallel.sharding import kv_leaf_spec
+
+    return constrain(x, kv_leaf_spec(x.shape, dict(mesh.shape)))
+
+
+def constrain_cache(tree):
+    """Pin a whole flax "cache" collection (or cross-KV tuple tree) to the
+    serving layout: every 4-D leaf via ``constrain_kv``, scalars (the
+    ``cache_index`` counters) replicated by GSPMD default.  No-op without
+    an ambient mesh — the decode/prefill programs call it unconditionally,
+    exactly like the models call ``constrain_hidden``."""
+    return jax.tree.map(
+        lambda x: constrain_kv(x) if getattr(x, "ndim", 0) == 4 else x, tree
+    )

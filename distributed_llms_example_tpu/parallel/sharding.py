@@ -130,6 +130,47 @@ def default_rules() -> ShardingRules:
     return ShardingRules(rules=DEFAULT_RULES)
 
 
+# Serving state: the per-layer KV cache is the SECOND long-lived sharded
+# tree (params being the first) — slot rows over the batch axes
+# (data×fsdp×expert, like the batch they decode), heads over ``tensor``
+# (like the attention projections that produce them), sequence position
+# and head_dim replicated.  The per-module ``cache_index`` counters are
+# scalars and stay replicated.  ``analysis/spec_lint.py
+# lint_cache_sharding`` validates this rule set against an abstract cache
+# tree exactly like the param rules; ``parallel/activation.py
+# constrain_cache`` applies it inside the compiled prefill/decode
+# programs.
+CACHE_RULES: list[tuple[str, P]] = [
+    (r"(cached_key|cached_value)$", P(("data", "fsdp", "expert"), "tensor", None, None)),
+    (r"cache_index$", P()),
+]
+
+
+def cache_rules() -> ShardingRules:
+    return ShardingRules(rules=CACHE_RULES)
+
+
+def kv_leaf_spec(shape: tuple, mesh_axes: Any) -> P:
+    """The CACHE_RULES layout for one (batch, heads, len, head_dim) K/V
+    leaf, divisibility-guarded per-dim (ragged batch or head counts
+    replicate that dim, mirroring ``divisible_spec``).  THE single
+    definition of the serving K/V layout — ``activation.constrain_kv``
+    (in-graph constraints) and the engine's host-side placement both
+    derive from it, so they cannot drift."""
+    batch_shards = 1
+    for a in ("data", "fsdp", "expert"):
+        batch_shards *= mesh_axes.get(a, 1)
+    batch = (
+        ("data", "fsdp", "expert")
+        if shape[0] % max(batch_shards, 1) == 0
+        else None
+    )
+    heads = (
+        "tensor" if shape[1] % max(mesh_axes.get("tensor", 1), 1) == 0 else None
+    )
+    return P(batch, heads, None, None)
+
+
 # Pipelined (stage>1) param layout: stacked block trees shard their leading
 # layer dim over ``stage`` AND keep the default megatron/FSDP splits on the
 # per-layer dims behind it (stage × tensor × fsdp compose — the pipeline

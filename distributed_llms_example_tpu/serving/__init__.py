@@ -1,0 +1,4 @@
+from distributed_llms_example_tpu.serving.engine import (  # noqa: F401
+    ServeConfig,
+    ServingEngine,
+)

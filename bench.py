@@ -417,6 +417,21 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
 
         trainer.obs.spans.summary = capturing_summary
 
+        def pass_budget() -> dict | None:
+            """Drain the pass's step_budget accounts (obs/budget.py) into
+            one aggregate: the additive component breakdown plus the
+            wall-weighted dispatch_efficiency — the same-session A/B
+            artifact the ROADMAP's vs_synthetic_step >= 0.95 attack needs
+            (which component to shrink, not just that a gap exists)."""
+            from distributed_llms_example_tpu.obs.budget import aggregate_accounts
+
+            bud = getattr(trainer.obs, "budget", None)
+            if bud is None or not bud.history:
+                return None
+            accounts = bud.history[:]
+            bud.history.clear()
+            return aggregate_accounts(accounts)
+
         def timed_pass() -> float:
             t0 = time.perf_counter()
             trainer.train()
@@ -439,6 +454,7 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
 
         dt_first = timed_pass()  # compile + warmup
         captured_windows.clear()
+        pass_budget()  # drop the warmup pass's accounts
         out = {}
         for prefetch in (2, 0):
             trainer.cfg = cfg.replace(prefetch_batches=prefetch)
@@ -450,6 +466,16 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
             dt = timed_pass()
             out[f"tokens_per_sec_chip_prefetch{prefetch}"] = round(tokens / dt / n_chips, 1)
             out[f"spans_prefetch{prefetch}"] = pass_spans()
+            budget = pass_budget()
+            if budget is not None:
+                out[f"budget_prefetch{prefetch}"] = budget
+        if "budget_prefetch2" in out:
+            # the headline gauge: the fraction of trainer-loop wall the
+            # device was fed or drained (vs host-side stalls) on the
+            # default prefetch config
+            out["dispatch_efficiency"] = out["budget_prefetch2"][
+                "dispatch_efficiency"
+            ]
         # adaptive cost estimate for the rbg pass: one warm pass (includes
         # the typed-key retrace — bounded by the compile-inclusive first
         # pass) plus one timed pass
@@ -1061,6 +1087,9 @@ def _serve_measure(
         "decode_tokens_per_sec_chip": round(serve_tps_chip, 1),
         "ttft_p50_ms": round(ttft_p50 * 1e3, 1),
         "ttft_p95_ms": round(ttft_p95 * 1e3, 1),
+        # queue-wait vs prefill share of TTFT (serving request spans):
+        # the explainable-p95 fields the serve_summary event also carries
+        **stats.ttft_decomposition(),
         "slot_occupancy": round(stats.slot_occupancy, 4),
         "decode_steps": stats.decode_steps,
         "wall_s": round(serve_s, 2),

@@ -203,6 +203,16 @@ class TrainConfig:
     # heartbeat cadence in steps (0 = off).  Multi-host: every process
     # probes at the same global step, process 0 reports skew/laggards
     obs_heartbeat_steps: int = 0
+    # step-time budget accounting (obs/budget.py): each logging window's
+    # wall time decomposed into data_wait / dispatch / device_busy /
+    # sync_block / host_overhead (additive — the unattributed remainder
+    # is test-pinned under 5%) with a dispatch_efficiency gauge and the
+    # off-cadence host-transfer tripwire, emitted as step_budget events.
+    # "auto" = on whenever --obs is not off; under --obs jsonl the span
+    # instances are also captured for the Perfetto trace export
+    # (obs.report --trace).  Host-clock arithmetic only; the single
+    # device interaction is one timed block at the log cadence.
+    obs_budget: str = "auto"
     # MFU denominator: peak per-chip FLOP/s in TFLOP/s (v5e bf16 ≈ 197)
     obs_peak_tflops: float = 197.0
 
@@ -387,6 +397,16 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
              "collective-traffic account (auto = only under --obs jsonl)",
     )
     p.add_argument("--obs-heartbeat-steps", type=int, default=_D.obs_heartbeat_steps)
+    p.add_argument(
+        "--obs-budget", type=str, default=_D.obs_budget,
+        choices=("auto", "on", "off"),
+        help="step-time budget accounting: per-window wall time decomposed "
+             "into data_wait/dispatch/device_busy/sync_block/host_overhead "
+             "with a dispatch_efficiency gauge and the off-cadence "
+             "host-transfer tripwire (step_budget events; under --obs jsonl "
+             "also span capture for obs.report --trace).  auto = on "
+             "whenever --obs is not off",
+    )
     p.add_argument("--obs-peak-tflops", type=float, default=_D.obs_peak_tflops)
     p.add_argument(
         "--health", type=str, default=_D.health, choices=("auto", "on", "off"),

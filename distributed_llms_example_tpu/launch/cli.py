@@ -22,7 +22,14 @@ Observability: ``--obs jsonl`` tees every metric line into
 ``<output-dir>/obs/metrics-p*.jsonl`` and turns on the derived gauges
 (MFU, collective-traffic account); ``--obs-heartbeat-steps N`` adds the
 multi-host liveness probe; ``--profile-steps 100:105`` captures a
-jax.profiler trace for that step window (see README "Observability").
+jax.profiler trace for that step window; ``--obs-budget`` (auto-on)
+closes every logging window into a ``step_budget`` account — wall time
+decomposed into data_wait / dispatch / device_busy / sync_block /
+host_overhead, a ``dispatch_efficiency`` gauge, and a runtime tripwire
+for host-blocking transfers off the log cadence.  Post-run, ``python -m
+distributed_llms_example_tpu.obs.report <output-dir> --trace trace.json``
+merges every rank's spans, budget gauges and serving request lifecycles
+into one Perfetto-loadable timeline (see README "Observability").
 
 Dropout & RNG: ``--dropout-impl auto|fused|xla`` picks the dropout
 execution path (auto = the fused Pallas kernel on TPU — in-kernel RNG,

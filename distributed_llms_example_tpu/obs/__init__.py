@@ -32,9 +32,18 @@ one.  This package supplies those signals in four layers:
 - ``recorder``  the flight recorder: a bounded ring of the last N steps'
                 metrics + batch fingerprints, dumped as a schema-stamped
                 bundle on anomaly / SIGTERM / crash
+- ``budget``    step-time budget accounting: each window's wall time
+                decomposed into data_wait / dispatch / device_busy /
+                sync_block / host_overhead (additive, test-pinned), a
+                ``dispatch_efficiency`` gauge, and the runtime tripwire
+                for host-blocking transfers off the log cadence
+- ``trace``     span-instance capture + the Chrome-trace/Perfetto
+                exporter merging every rank's spans, budget gauges and
+                serving request lifecycles onto one timeline
 - ``report``    the offline consumer: merges the per-process JSONL into
                 a cross-host step timeline (``python -m
-                distributed_llms_example_tpu.obs.report <output_dir>``)
+                distributed_llms_example_tpu.obs.report <output_dir>``;
+                ``--trace out.json`` exports the merged Perfetto trace)
 
 Everything funnels through ``sink`` (stdout Valohai channel + optional
 JSONL file, same schema).  ``TrainerObs`` below is the one object the
@@ -49,6 +58,7 @@ from typing import Any, Iterable, Iterator
 
 from distributed_llms_example_tpu.obs import health as health_mod
 from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.budget import BudgetAccountant, budget_enabled
 from distributed_llms_example_tpu.obs.health import HealthWatchdog, health_enabled
 from distributed_llms_example_tpu.obs.heartbeat import Heartbeat
 from distributed_llms_example_tpu.obs.profile import ProfileController
@@ -62,6 +72,7 @@ __all__ = [
     "FlightRecorder",
     "batch_fingerprint",
     "health_enabled",
+    "budget_enabled",
 ]
 
 
@@ -127,6 +138,32 @@ class TrainerObs:
             else ""
         )
         self.profiler = self._build_profiler(start_step)
+        # step-time budget layer (obs/budget.py): host-clock arithmetic
+        # over the span recorder's per-step records, closed at the log
+        # cadence into a step_budget event; its ONE device interaction is
+        # the cadenced queue-drain probe (budget_probe below)
+        self.budget = None
+        if budget_enabled(cfg):
+            import jax
+
+            self.budget = BudgetAccountant(
+                self.spans,
+                # multi-device CPU dispatch runs the program inline: a
+                # blocked dispatch is that backend's normal mode, not a
+                # stray transfer — the tripwire verdict stands down there
+                async_dispatch=jax.default_backend() != "cpu",
+            )
+        # trace capture (obs/trace.py): individual span instances for the
+        # Perfetto export.  File-channel material (bulk records), so only
+        # worth collecting when a JSONL channel exists to receive them.
+        self.trace = None
+        if self.budget is not None and getattr(cfg, "obs", "") == "jsonl":
+            # imported here (not at module top) so `python -m ...obs.trace`
+            # runs the exporter without a double-import warning
+            from distributed_llms_example_tpu.obs.trace import TraceCollector
+
+            self.trace = TraceCollector()
+            self.spans.listener = self.trace
 
     def _build_profiler(self, start_step: int) -> ProfileController:
         return ProfileController(
@@ -206,6 +243,22 @@ class TrainerObs:
     def sync_span(self):
         return self.spans.span("device_sync")
 
+    def host_span(self):
+        """Host bookkeeping riding the step's wall (batch fingerprinting,
+        metric/recorder prep) — the budget account's ``host_overhead``."""
+        return self.spans.span("host_overhead")
+
+    def budget_probe(self, step: int, sync_leaf: Any) -> None:
+        """The budget layer's cadenced device timing: at the log cadence
+        ONLY, time the queue drain on the step output BEFORE the metric
+        logger's own fetch (so the logger's conversion lands on an idle
+        device and the measured block is the genuine un-overlapped device
+        tail).  Off-cadence steps return after two comparisons — zero
+        device syncs, the invariant the counting-leaf test pins."""
+        if self.budget is None or sync_leaf is None or step % self.every != 0:
+            return
+        self.budget.probe(sync_leaf)
+
     def eval_span(self):
         return self.spans.span("eval")
 
@@ -227,6 +280,9 @@ class TrainerObs:
         """
         self.profiler.after_step(step, metrics.get("loss"))
         self.spans.step_complete()
+        if self.trace is not None:
+            # the step-boundary mark the cross-host trace merge aligns on
+            self.trace.note_step(step)
         if self.recorder is not None:
             self.recorder.record(step, epoch, metrics, fingerprint)
         if self.watchdog is not None:
@@ -235,10 +291,21 @@ class TrainerObs:
             self.heartbeat.beat(step)
         action = "ok"
         if step % self.every == 0:
+            # budget first: it reads the window's per-step records, which
+            # emit_window's summary() resets
+            if self.budget is not None:
+                self.budget.close_window(step, epoch)
+            if self.trace is not None:
+                self.trace.flush(step)
             if self.watchdog is not None:
                 action = self._health_cadence(step)
             if self.enabled:
                 self.emit_window(step, epoch)
+            elif self.budget is not None:
+                # --obs off --obs-budget on: emit_window won't run, so
+                # consume the window here — otherwise every later account
+                # re-reads (and re-counts) the same ever-growing records
+                self.spans.summary()
         return action
 
     def _health_cadence(self, step: int) -> str:
@@ -333,9 +400,16 @@ class TrainerObs:
         already over)."""
         self.profiler.finalize(sync_leaf)
         action = "ok"
+        if self.budget is not None:
+            # the final partial window's account (before summary resets it)
+            self.budget.close_window(step, epoch)
+        if self.trace is not None:
+            self.trace.flush(step)
         if self.watchdog is not None and self._pending_health:
             action = self._health_cadence(step)
         if self.enabled:
             self.emit_window(step, epoch)
+        elif self.budget is not None:
+            self.spans.summary()  # consume the window the budget read
         sink_mod.flush(fsync=True)
         return action

@@ -9,6 +9,17 @@ and reconstructs the run:
   process 0's metric lines (loss / lr / tokens-per-sec), every process's
   ``obs_window`` span summaries, eval events (``val_loss`` — same
   ``step`` field as train events), heartbeat skew, and anomalies;
+- a **"Where did the time go" budget section** from the ``step_budget``
+  events (obs/budget.py): per-window additive component tables per rank,
+  the worst-offender ranking over the host-stall components, the
+  wall-weighted ``dispatch_efficiency``, and every off-cadence
+  host-blocking-dispatch incident the runtime tripwire flagged.
+  ``--min-dispatch-efficiency X`` + ``--strict`` turn a regressed
+  efficiency into a nonzero exit (the trainer-loop-gap CI gate);
+- ``--trace out.json`` additionally exports the merged **Perfetto /
+  Chrome trace** (obs/trace.py): every rank's span instances aligned on
+  shared step boundaries, budget counters, anomaly/chaos instants, and
+  serving request lifecycles — load at https://ui.perfetto.dev;
 - **window trends**: p50/p95 step time per process across the run (is it
   getting slower? did one host drift?);
 - **straggler attribution**: which ranks the heartbeat named laggards
@@ -249,6 +260,85 @@ def comm_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
     return None
 
 
+def budget_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
+    """The "Where did the time go" rollup over every rank's
+    ``step_budget`` events: per-rank component totals + efficiency (via
+    obs/budget.py's shared aggregation, so bench and the report cannot
+    disagree), the worst-offender ranking over host-stall components, and
+    the off-cadence host-blocking-dispatch incident list."""
+    from distributed_llms_example_tpu.obs.budget import (
+        COMPONENTS,
+        aggregate_accounts,
+    )
+
+    ranks: dict[str, Any] = {}
+    windows: dict[str, list[dict]] = {}
+    incidents: list[dict] = []
+    eff_wall: list[tuple[float, float]] = []
+    for proc, records in sorted(processes.items()):
+        accts = _by_event(records).get("step_budget", [])
+        if not accts:
+            continue
+        agg = aggregate_accounts(accts)
+        ranks[str(proc)] = agg
+        windows[str(proc)] = [
+            {
+                "step": a.get("step"),
+                "wall_ms": a.get("wall_ms"),
+                **{f"{c}_ms": a.get(f"{c}_ms") for c in COMPONENTS},
+                "dispatch_efficiency": a.get("dispatch_efficiency"),
+                "accounted_frac": a.get("accounted_frac"),
+                "offcadence_sync_steps": a.get("offcadence_sync_steps", 0),
+            }
+            for a in accts
+        ]
+        for a in accts:
+            # SUSPECT windows only: on a synchronous-dispatch backend
+            # (multi-device CPU) the raw count is that backend's normal
+            # mode, stamped sync_dispatch_backend — not an incident
+            if a.get("offcadence_sync_suspect"):
+                incidents.append({
+                    "rank": proc,
+                    "step": a.get("step"),
+                    "blocked_steps": int(a.get("offcadence_sync_steps", 0) or 0),
+                    "window_steps": a.get("window_steps"),
+                    "dispatch_ms": a.get("dispatch_ms"),
+                })
+        if agg and agg.get("wall_ms"):
+            eff_wall.append((agg["dispatch_efficiency"], agg["wall_ms"]))
+    if not ranks:
+        return None
+    total_wall = sum(w for _, w in eff_wall)
+    overall_eff = (
+        round(sum(e * w for e, w in eff_wall) / total_wall, 4)
+        if total_wall
+        else None
+    )
+    # worst offenders: the host-stall components (the time the device was
+    # NOT being fed), ranked by share of total wall across ranks
+    stall_components = ("data_wait", "host_overhead", "sync_block", "unattributed")
+    totals = {
+        c: sum(r.get(f"{c}_ms", 0.0) or 0.0 for r in ranks.values())
+        for c in stall_components
+    }
+    all_wall = sum(r.get("wall_ms", 0.0) or 0.0 for r in ranks.values())
+    offenders = sorted(
+        (
+            {"component": c, "total_ms": round(v, 3),
+             "share": round(v / all_wall, 4) if all_wall else 0.0}
+            for c, v in totals.items()
+        ),
+        key=lambda o: -o["total_ms"],
+    )
+    return {
+        "ranks": ranks,
+        "windows": windows,
+        "offenders": offenders,
+        "incidents": incidents,
+        "dispatch_efficiency": overall_eff,
+    }
+
+
 def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
     """The fault-tolerance timeline: chaos injections, recovery actions
     (rewinds / skip-batch / halts), quarantines, checkpoint-integrity
@@ -396,6 +486,7 @@ def build_report(output_dir: str) -> dict[str, Any]:
         "trends": window_trends(processes),
         "stragglers": straggler_attribution(processes),
         "comm": comm_report(processes),
+        "budget": budget_report(processes),
         "recovery": recovery_report(processes),
         "anomalies": anomalies,
         "recorders": {
@@ -480,6 +571,60 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
                 f"r{k}={_fmt(v)}ms" for k, v in s["mean_step_ms_p95_by_rank"].items()
             )
         )
+    budget = report.get("budget")
+    add("")
+    add("## Where did the time go")
+    if budget is None:
+        add("- no step_budget records (run without --obs-budget?)")
+    else:
+        from distributed_llms_example_tpu.obs.budget import COMPONENTS
+
+        add(
+            f"- dispatch efficiency (wall-weighted, all ranks): "
+            f"{_fmt(budget['dispatch_efficiency'])}"
+        )
+        add("")
+        header = " | ".join(c for c in COMPONENTS)
+        add(f"| rank | windows | wall ms | {header} | efficiency |")
+        add("|---" * (len(COMPONENTS) + 4) + "|")
+        for rank, agg in sorted(budget["ranks"].items()):
+            comps = " | ".join(_fmt(agg.get(f"{c}_ms")) for c in COMPONENTS)
+            add(
+                f"| {rank} | {agg['windows']} | {_fmt(agg['wall_ms'])} | "
+                f"{comps} | {_fmt(agg['dispatch_efficiency'])} |"
+            )
+        add("")
+        add("worst offenders (host-stall components, share of total wall):")
+        for o in budget["offenders"]:
+            add(
+                f"- {o['component']}: {_fmt(o['total_ms'])} ms "
+                f"({_fmt(o['share'] * 100)}% of wall)"
+            )
+        if budget["incidents"]:
+            add("")
+            add("**off-cadence host-blocking dispatch incidents** (the "
+                "runtime rule-4 tripwire — a transfer blocked the step "
+                "body outside the logging window):")
+            for inc in budget["incidents"]:
+                add(
+                    f"- rank {inc['rank']} window@step {inc['step']}: "
+                    f"{inc['blocked_steps']}/{inc['window_steps']} step(s) "
+                    f"blocked in dispatch ({_fmt(inc['dispatch_ms'])} ms total)"
+                )
+        else:
+            add("- no off-cadence host-blocking dispatch detected")
+        # per-window trend, most recent windows per rank
+        for rank, ws in sorted(budget["windows"].items()):
+            shown = ws[-last:]
+            if not shown:
+                continue
+            first, final = shown[0], shown[-1]
+            add(
+                f"- rank {rank} windows: efficiency "
+                f"{_fmt(first['dispatch_efficiency'])} → "
+                f"{_fmt(final['dispatch_efficiency'])}, accounted "
+                f"{_fmt(final['accounted_frac'])} of wall over {len(ws)} window(s)"
+            )
     comm = report["comm"]
     add("")
     add("## Comm account")
@@ -569,7 +714,22 @@ def main(argv: list[str] | None = None) -> int:
         "--strict", action="store_true",
         help="nonzero exit on any schema-invalid line OR any ORGANIC fault "
              "(one no chaos_injection event explains) — a chaos run is "
-             "green only when every fault it saw is one it caused",
+             "green only when every fault it saw is one it caused — OR a "
+             "wall-weighted dispatch_efficiency below "
+             "--min-dispatch-efficiency",
+    )
+    p.add_argument(
+        "--min-dispatch-efficiency", type=float, default=0.0,
+        help="with --strict: fail when the run's wall-weighted "
+             "dispatch_efficiency (step_budget events) falls below this "
+             "floor (0 = no floor) — the trainer-loop-gap CI gate",
+    )
+    p.add_argument(
+        "--trace", type=str, default="",
+        help="also export the merged Chrome-trace/Perfetto JSON here "
+             "(every rank's spans aligned on shared step boundaries, "
+             "budget counters, serving request lifecycles) — open at "
+             "ui.perfetto.dev",
     )
     args = p.parse_args(argv)
     if not os.path.isdir(os.path.join(args.output_dir, "obs")):
@@ -580,11 +740,36 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(report))
     else:
         print(render_markdown(report, last=args.last), end="")
-    if args.strict and (
-        report["schema_errors"] or report["recovery"]["organic_faults"]
-    ):
-        return 1
-    return 0
+    if args.trace:
+        from distributed_llms_example_tpu.obs.trace import export_chrome_trace
+
+        summary = export_chrome_trace(args.output_dir, args.trace)
+        print(
+            f"trace: {summary['events']} events from ranks "
+            f"{summary['ranks']} → {summary['path']}",
+            file=sys.stderr,
+        )
+    rc = 0
+    if args.strict:
+        if report["schema_errors"] or report["recovery"]["organic_faults"]:
+            rc = 1
+        floor = args.min_dispatch_efficiency
+        budget = report.get("budget")
+        if floor > 0:
+            eff = budget["dispatch_efficiency"] if budget else None
+            if eff is None:
+                print(
+                    "strict: --min-dispatch-efficiency set but no "
+                    "step_budget records found", file=sys.stderr,
+                )
+                rc = 1
+            elif eff < floor:
+                print(
+                    f"strict: dispatch_efficiency {eff} below the "
+                    f"{floor} floor", file=sys.stderr,
+                )
+                rc = 1
+    return rc
 
 
 if __name__ == "__main__":

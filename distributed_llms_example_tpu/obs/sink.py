@@ -41,10 +41,16 @@ class StdoutSink:
 
     ``local`` records (per-process telemetry: span windows, recorder
     events) do NOT widen the stdout gate — the platform channel stays
-    process-0-only; only file channels fan out per process."""
+    process-0-only; only file channels fan out per process.  ``bulk``
+    records (trace span dumps — hundreds of entries per line) never hit
+    stdout at all: the platform parser and every stdout-scraping consumer
+    see only the compact metric stream."""
 
-    def wants(self, *, all_processes: bool = False, local: bool = False) -> bool:
-        return all_processes or _process_index() == 0
+    def wants(
+        self, *, all_processes: bool = False, local: bool = False,
+        bulk: bool = False,
+    ) -> bool:
+        return not bulk and (all_processes or _process_index() == 0)
 
     def emit(
         self,
@@ -52,8 +58,9 @@ class StdoutSink:
         *,
         all_processes: bool = False,
         local: bool = False,
+        bulk: bool = False,
     ) -> None:
-        if not self.wants(all_processes=all_processes, local=local):
+        if not self.wants(all_processes=all_processes, local=local, bulk=bulk):
             return
         print(json.dumps(record), file=sys.stdout, flush=True)
 
@@ -75,11 +82,17 @@ class JsonlFileSink:
         self._f = None
         self._dead = False
 
-    def wants(self, *, all_processes: bool = False, local: bool = False) -> bool:
+    def wants(
+        self, *, all_processes: bool = False, local: bool = False,
+        bulk: bool = False,
+    ) -> bool:
         # ``local``: per-process telemetry (span windows, recorder events)
         # lands in every process's OWN file — cross-host timelines need
-        # every host's view, and the file is already per-process by path
-        return not self._dead and (all_processes or local or _process_index() == 0)
+        # every host's view, and the file is already per-process by path.
+        # ``bulk`` records are file-channel material by definition.
+        return not self._dead and (
+            all_processes or local or bulk or _process_index() == 0
+        )
 
     def emit(
         self,
@@ -87,8 +100,9 @@ class JsonlFileSink:
         *,
         all_processes: bool = False,
         local: bool = False,
+        bulk: bool = False,
     ) -> None:
-        if not self.wants(all_processes=all_processes, local=local):
+        if not self.wants(all_processes=all_processes, local=local, bulk=bulk):
             return
         try:
             if self._f is None:
@@ -129,9 +143,13 @@ class TeeSink:
     def __init__(self, sinks: list):
         self.sinks = list(sinks)
 
-    def wants(self, *, all_processes: bool = False, local: bool = False) -> bool:
+    def wants(
+        self, *, all_processes: bool = False, local: bool = False,
+        bulk: bool = False,
+    ) -> bool:
         return any(
-            s.wants(all_processes=all_processes, local=local) for s in self.sinks
+            s.wants(all_processes=all_processes, local=local, bulk=bulk)
+            for s in self.sinks
         )
 
     def emit(
@@ -140,9 +158,10 @@ class TeeSink:
         *,
         all_processes: bool = False,
         local: bool = False,
+        bulk: bool = False,
     ) -> None:
         for s in self.sinks:
-            s.emit(record, all_processes=all_processes, local=local)
+            s.emit(record, all_processes=all_processes, local=local, bulk=bulk)
 
     def flush(self, *, fsync: bool = False) -> None:
         for s in self.sinks:
@@ -183,14 +202,20 @@ def build_sink(mode: str, output_dir: str):
     return TeeSink([_DEFAULT, JsonlFileSink(path)])
 
 
-def wants(*, all_processes: bool = False, local: bool = False) -> bool:
-    return _SINK.wants(all_processes=all_processes, local=local)
+def wants(
+    *, all_processes: bool = False, local: bool = False, bulk: bool = False
+) -> bool:
+    return _SINK.wants(all_processes=all_processes, local=local, bulk=bulk)
 
 
 def emit(
-    record: Mapping[str, Any], *, all_processes: bool = False, local: bool = False
+    record: Mapping[str, Any],
+    *,
+    all_processes: bool = False,
+    local: bool = False,
+    bulk: bool = False,
 ) -> None:
-    _SINK.emit(record, all_processes=all_processes, local=local)
+    _SINK.emit(record, all_processes=all_processes, local=local, bulk=bulk)
 
 
 def flush(*, fsync: bool = False) -> None:

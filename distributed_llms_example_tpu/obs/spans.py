@@ -62,6 +62,17 @@ class SpanRecorder:
         self._window_steps = 0
         self._window_t0 = clock()
         self._step_t0: float | None = None
+        # per-step breakdown for the budget layer (obs/budget.py): the
+        # OUTERMOST spans closed since the step's anchor, keyed by name —
+        # a partition of the step's ring duration (nested spans would
+        # double-count, so only depth-0 exits land here)
+        self._step_spans: dict[str, float] = {}
+        self._step_records: list[dict] = []  # rings with _ring
+        # optional span-instance listener (obs/trace.py TraceCollector):
+        # called with (name, t0, dur) on every OUTERMOST span exit — a
+        # None check per span, nothing else, so the zero-cost-when-off
+        # property of the recorder is untouched
+        self.listener = None
 
     # -- recording -------------------------------------------------------
 
@@ -82,15 +93,23 @@ class SpanRecorder:
                 agg[1] += 1
                 if dt > agg[2]:
                     agg[2] = dt
+            if self._depth == 0:
+                self._step_spans[name] = self._step_spans.get(name, 0.0) + dt
+                if self.listener is not None:
+                    self.listener.on_span(name, t0, dt)
 
     def step_complete(self) -> None:
         """One train-loop iteration finished: record its wall duration
         (time since the previous ``step_complete`` / window start)."""
         now = self.clock()
         t0 = self._step_t0 if self._step_t0 is not None else self._window_t0
-        self._ring.append(now - t0)
+        dur = now - t0
+        self._ring.append(dur)
+        self._step_records.append({"dur": dur, "spans": self._step_spans})
+        self._step_spans = {}
         if len(self._ring) > self.ring_size:
             del self._ring[: len(self._ring) - self.ring_size]
+            del self._step_records[: len(self._step_records) - self.ring_size]
         self._step_t0 = now
         self._window_steps += 1
 
@@ -99,8 +118,13 @@ class SpanRecorder:
         cadenced non-step work (checkpoint save, eval) so that wall time
         — already tracked under its own span — is not also charged to
         the NEXT step's ring-buffer duration (which would fire the
-        straggler flag on every healthy eval cadence)."""
+        straggler flag on every healthy eval cadence).  The per-step span
+        breakdown is re-anchored with it: a span recorded between the
+        boundary and here (checkpoint/eval) is excluded from the next
+        step's duration, so charging it to that step's budget would break
+        the partition the budget account sums over."""
         self._step_t0 = self.clock()
+        self._step_spans = {}
 
     # -- reporting -------------------------------------------------------
 
@@ -108,6 +132,15 @@ class SpanRecorder:
         if self._window_steps == 0:
             return []
         return self._ring[-min(self._window_steps, len(self._ring)):]
+
+    def window_step_records(self) -> list[dict]:
+        """The current window's per-step ``{"dur": s, "spans": {name: s}}``
+        records (the budget account's raw material).  Read BEFORE
+        ``summary()`` — which resets the window counter this slices by."""
+        if self._window_steps == 0:
+            return []
+        n = min(self._window_steps, len(self._step_records))
+        return self._step_records[-n:]
 
     def summary(self) -> dict | None:
         """Close the window: step-time percentiles + span aggregates.

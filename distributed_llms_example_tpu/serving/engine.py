@@ -34,8 +34,15 @@ Single-controller: multi-process serving is a queueing layer above this,
 not a collective program.
 
 Obs events (utils/jsonlog → obs sink): ``serve_window`` at the log cadence
-(decode tokens/sec[/chip], slot occupancy) and a final ``serve_summary``
-(tokens/sec/chip, TTFT p50/p95, occupancy, evictions).
+(decode tokens/sec[/chip], slot occupancy, queue depth, the window's
+prefill-vs-decode time split), a ``serve_request`` lifecycle record per
+finished request (queue-wait → prefill → first-token → decode → evict,
+with times relative to the batch's submit instant so ``obs.report
+--trace`` can draw each request as a slot-track slice), and a final
+``serve_summary`` (tokens/sec/chip, TTFT p50/p95 **with its queue-vs-
+prefill decomposition**, occupancy, evictions) — TTFT p95 stops being one
+opaque aggregate and becomes "the tail waited in queue" vs "prefill is
+slow".
 """
 
 from __future__ import annotations
@@ -73,13 +80,16 @@ class ServeConfig:
     any mesh).  ``max_source_length``: fixed prompt width (prompts are
     padded to it; the serving twin of the trainer's bucketed max).
     ``max_new_tokens``: decode budget per sequence = the KV-cache length
-    (seq2seq) or its decode tail (causal)."""
+    (seq2seq) or its decode tail (causal).  ``request_spans``: emit one
+    ``serve_request`` lifecycle event per finished request (queue-wait /
+    prefill / ttft / decode breakdown — the trace exporter's feed)."""
 
     max_slots: int = 8
     prefill_batch: int = 0  # 0 = max_slots
     max_new_tokens: int = 128
     max_source_length: int = 1024
     log_every_steps: int = 50
+    request_spans: bool = True
 
 
 @dataclasses.dataclass
@@ -93,6 +103,10 @@ class ServeStats:
     prefill_seconds: float = 0.0
     slot_occupancy: float = 0.0
     ttft_s: list[float] = dataclasses.field(default_factory=list)
+    # per-request TTFT decomposition (same order as ttft_s): time spent
+    # waiting for a slot vs inside the request's prefill call
+    queue_wait_s: list[float] = dataclasses.field(default_factory=list)
+    prefill_share_s: list[float] = dataclasses.field(default_factory=list)
 
     def tokens_per_sec(self) -> float:
         return self.decode_tokens / max(self.decode_seconds, 1e-9)
@@ -104,6 +118,24 @@ class ServeStats:
             return 0.0, 0.0
         p50, p95 = percentiles(self.ttft_s, (0.50, 0.95))
         return p50, p95
+
+    def ttft_decomposition(self) -> dict:
+        """Queue-wait vs prefill share of TTFT over finished requests —
+        the serve_summary fields that make a fat TTFT p95 actionable
+        (admit more slots vs speed up prefill)."""
+        from distributed_llms_example_tpu.obs.spans import percentiles
+
+        q50, q95 = percentiles(self.queue_wait_s, (0.50, 0.95))
+        p50, p95 = percentiles(self.prefill_share_s, (0.50, 0.95))
+        total = sum(self.ttft_s)
+        return {
+            "ttft_queue_p50_ms": round(q50 * 1e3, 1),
+            "ttft_queue_p95_ms": round(q95 * 1e3, 1),
+            "ttft_prefill_p50_ms": round(p50 * 1e3, 1),
+            "ttft_prefill_p95_ms": round(p95 * 1e3, 1),
+            "ttft_queue_share": round(sum(self.queue_wait_s) / total, 4) if total else 0.0,
+            "ttft_prefill_share": round(sum(self.prefill_share_s) / total, 4) if total else 0.0,
+        }
 
 
 class ServingEngine:
@@ -359,6 +391,12 @@ class ServingEngine:
         stats = ServeStats(sequences=len(requests))
         outputs: list[list[int]] = [[] for _ in requests]
         ttft: list[float | None] = [None] * len(requests)
+        # per-request lifecycle (queue-wait → prefill → first-token →
+        # decode → evict): admit instant + this request's prefill-call
+        # duration, all relative to the batch's submit instant so the
+        # serve_request records line up on one timeline
+        admit_t: list[float | None] = [None] * len(requests)
+        prefill_dt = [0.0] * len(requests)
         pending = list(range(len(requests)))[::-1]  # pop() preserves order
         slot_req = np.full(S, -1, np.int64)  # request index per slot
         emitted = np.zeros(S, np.int64)
@@ -367,6 +405,29 @@ class ServingEngine:
         t_submit = time.perf_counter()
         state = self._init_state(params)
         win_tokens, win_t0, win_occ = 0, time.perf_counter(), 0.0
+        win_prefill, win_decode = 0.0, 0.0
+
+        def finish_request(req: int, slot: int, now: float) -> None:
+            """Evict-time lifecycle record — the trace exporter's feed and
+            the post-hoc 'why was THIS request's TTFT fat' answer."""
+            if not self.serve.request_spans:
+                return
+            t_admit = admit_t[req] if admit_t[req] is not None else t_submit
+            queue_wait = t_admit - t_submit
+            t = ttft[req]
+            log_json({
+                "event": "serve_request",
+                "request": int(req),
+                "slot": int(slot),
+                "queue_wait_ms": round(queue_wait * 1e3, 3),
+                "prefill_ms": round(prefill_dt[req] * 1e3, 3),
+                "ttft_ms": round(t * 1e3, 3) if t is not None else None,
+                "decode_ms": round((now - t_submit - (t or queue_wait)) * 1e3, 3),
+                "tokens": len(outputs[req]),
+                "t_admit_s": round(t_admit - t_submit, 6),
+                "t_done_s": round(now - t_submit, 6),
+                "finished_at_step": int(stats.decode_steps),
+            })
 
         def admit_now() -> None:
             nonlocal state
@@ -396,13 +457,18 @@ class ServingEngine:
                 state = self._admit(state, cache, full_mask, first, jnp.asarray(slot_idx))
                 plens_h = np.asarray(jax.device_get(plens))
                 first_h = np.asarray(jax.device_get(first))
-            stats.prefill_seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            stats.prefill_seconds += dt
+            nonlocal win_prefill
+            win_prefill += dt
             now = time.perf_counter()
             for r, req in enumerate(reqs):
                 slot = free[r]
                 slot_req[slot] = req
                 emitted[slot] = 0
                 active[slot] = True
+                admit_t[req] = t0
+                prefill_dt[req] = dt
                 if not self.is_seq2seq:
                     lengths[slot] = int(plens_h[r])
                     # the causal prefill already produced token #1
@@ -412,6 +478,7 @@ class ServingEngine:
                     if int(first_h[r]) == self.eos or emitted[slot] >= budgets[req]:
                         active[slot] = False
                         slot_req[slot] = -1
+                        finish_request(req, slot, now)
 
         while pending or active.any():
             admit_now()
@@ -437,6 +504,7 @@ class ServingEngine:
             dt = time.perf_counter() - t0
             stats.decode_seconds += dt
             stats.decode_steps += 1
+            win_decode += dt
             n_active = int(active.sum())
             stats.decode_tokens += n_active
             stats.slot_occupancy += n_active / S
@@ -453,6 +521,7 @@ class ServingEngine:
                 if tok == self.eos or emitted[slot] >= budgets[req]:
                     active[slot] = False  # evict: the slot is free NOW
                     slot_req[slot] = -1
+                    finish_request(req, slot, now)
             if (
                 self.serve.log_every_steps
                 and stats.decode_steps % self.serve.log_every_steps == 0
@@ -466,11 +535,24 @@ class ServingEngine:
                     "slot_occupancy": round(
                         win_occ / self.serve.log_every_steps, 4
                     ),
-                    "pending": len(pending),
+                    "queue_depth": len(pending),
+                    # the window's wall split: admission prefill vs decode
+                    # steps — a window whose prefill share balloons is
+                    # paying admission on the decode critical path
+                    "prefill_ms": round(win_prefill * 1e3, 1),
+                    "decode_ms": round(win_decode * 1e3, 1),
                 })
                 win_tokens, win_t0, win_occ = 0, now, 0.0
+                win_prefill, win_decode = 0.0, 0.0
 
         stats.ttft_s = [t for t in ttft if t is not None]
+        # TTFT decomposition rows, kept in ttft_s order (finished requests)
+        for req, t in enumerate(ttft):
+            if t is None:
+                continue
+            t_admit = admit_t[req] if admit_t[req] is not None else t_submit
+            stats.queue_wait_s.append(t_admit - t_submit)
+            stats.prefill_share_s.append(prefill_dt[req])
         stats.slot_occupancy = (
             stats.slot_occupancy / stats.decode_steps if stats.decode_steps else 0.0
         )
@@ -484,6 +566,7 @@ class ServingEngine:
             "decode_tokens_per_sec_chip": round(stats.tokens_per_sec() / n_chips, 1),
             "ttft_p50_ms": round(p50 * 1e3, 1),
             "ttft_p95_ms": round(p95 * 1e3, 1),
+            **stats.ttft_decomposition(),
             "slot_occupancy": round(stats.slot_occupancy, 4),
             "prefill_seconds": round(stats.prefill_seconds, 3),
             "slots": S,

@@ -1174,15 +1174,19 @@ class Trainer:
                         self.state = self.state.replace(
                             params=jax.tree.unflatten(treedef, flat)
                         )
-                    fingerprint = (
-                        batch_fingerprint(
-                            batch,
-                            epoch=epoch,
-                            epoch_step=pos - 1,
+                    with obs.host_span():
+                        # host bookkeeping charged to the budget account's
+                        # host_overhead component (the fingerprint's crc32
+                        # is the loop's main non-span host cost)
+                        fingerprint = (
+                            batch_fingerprint(
+                                batch,
+                                epoch=epoch,
+                                epoch_step=pos - 1,
+                            )
+                            if obs.recorder is not None
+                            else None
                         )
-                        if obs.recorder is not None
-                        else None
-                    )
                     with obs.step_span():
                         gb = put_batch(batch, self.mesh, sequence_sharded=self.sequence_sharded)
                         if self.use_dropout:
@@ -1194,6 +1198,12 @@ class Trainer:
                     self._last_step = step
                     last_metrics = metrics
                     tokens = self._batch_tokens(batch) * jax.process_count()
+                    # budget layer: at the log cadence ONLY, time the
+                    # device-queue drain before the logger's fetch — the
+                    # measured block is the un-overlapped device tail
+                    # (step_budget's device_busy); off-cadence this is two
+                    # comparisons and returns
+                    obs.budget_probe(step, metrics["loss"])
                     # pass DEVICE scalars: converting here (float(...)) would
                     # block on the step every iteration and serialize JAX's
                     # async dispatch — the logger converts only on emit (the

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Five rules, all born from real regressions at TPU scale:
+Seven rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -66,6 +66,16 @@ Five rules, all born from real regressions at TPU scale:
    unconditionally, the exact crash the integrity layer exists to
    prevent.  Everything goes through ``Checkpointer.save`` /
    ``restore_latest`` / ``restore_before``.
+
+7. **No Chrome-trace event emission outside ``obs/trace.py``.**  The
+   Perfetto export's value is being the ONE merged timeline: a module
+   that builds its own ``{"ph": ..., "ts": ...}`` event dicts (or a
+   ``"traceEvents"`` container) produces a rogue trace file with its own
+   clock epoch, no cross-rank step alignment, and no schema the report
+   CLI knows — the same fragmentation the sink-bypass rule (3) exists to
+   prevent on the metric channel.  Trace event construction lives in
+   ``obs/trace.py``; everyone else emits spans through the span recorder
+   and lets the exporter render them.
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -163,6 +173,32 @@ _GRAD_NAMES = ("grad", "grads", "gradient")
 # verify-with-fallback contracts a bare manager call would skip.
 CKPT_OWNER = os.path.join(PACKAGE, "io", "checkpoint.py")
 _MANAGER_NAMES = ("manager", "_manager", "checkpoint_manager", "ckpt_manager")
+
+# Rule 7: Chrome-trace/Perfetto event dicts are built only in the trace
+# exporter — a second producer means a second clock epoch and no
+# cross-rank alignment.
+TRACE_OWNER = os.path.join(PACKAGE, "obs", "trace.py")
+
+
+def _trace_emit_violations(tree: ast.AST, rel: str) -> list[str]:
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = {
+            k.value
+            for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+        if "traceEvents" in keys or {"ph", "ts"} <= keys:
+            violations.append(
+                f"{rel}:{node.lineno}: Chrome-trace event dict "
+                "('traceEvents' container or 'ph'+'ts' keys) outside "
+                "obs/trace.py — a rogue trace producer has its own clock "
+                "epoch and no cross-rank step alignment; record spans "
+                "through obs/spans.py and let obs/trace.py export them"
+            )
+    return violations
 
 
 def _ckpt_manager_violations(tree: ast.AST, rel: str) -> list[str]:
@@ -343,6 +379,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         violations.extend(_grad_accum_violations(tree, rel))
     if rel != CKPT_OWNER:
         violations.extend(_ckpt_manager_violations(tree, rel))
+    if rel != TRACE_OWNER:
+        violations.extend(_trace_emit_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)

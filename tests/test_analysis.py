@@ -776,3 +776,39 @@ def test_repo_lint_ckpt_manager_rule(tmp_path):
     # the owning module holds the one sanctioned call site
     rel = os.path.join("distributed_llms_example_tpu", "io", "checkpoint.py")
     assert repo_lint.lint_file(str(bad), rel) == []
+
+
+def test_repo_lint_chrome_trace_rule(tmp_path):
+    """Rule 7 (ISSUE 9): Chrome-trace event dicts (``"ph"``+``"ts"``
+    keys, or a ``"traceEvents"`` container) may only be built in
+    obs/trace.py — a second trace producer means a second clock epoch
+    and no cross-rank step alignment (the trace twin of the sink-bypass
+    rule)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    repo_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repo_lint)
+
+    bad = tmp_path / "rogue_trace.py"
+    bad.write_text(
+        "import json\n"
+        "ev = {'name': 'x', 'ph': 'X', 'ts': 12.5, 'dur': 3.0}\n"
+        "doc = {'traceEvents': [ev]}\n"
+        "ok = {'ph': 'X'}\n"              # ph alone is not a trace event
+        "ok2 = {'ts': 1.0, 'dur': 2.0}\n"  # ts without ph neither
+    )
+    for layer in ("models", "train", "obs", "serving"):
+        rel = os.path.join("distributed_llms_example_tpu", layer, "rogue_trace.py")
+        violations = repo_lint.lint_file(str(bad), rel)
+        assert len(violations) == 2, (layer, violations)
+        assert all("obs/trace.py" in v for v in violations)
+    # the exporter itself IS the owner
+    rel = os.path.join("distributed_llms_example_tpu", "obs", "trace.py")
+    assert repo_lint.lint_file(str(bad), rel) == []
+    # and the repo stays clean under the new rule
+    assert repo_lint.main([]) == 0

@@ -196,7 +196,49 @@ def test_multiprocess_loss_parity(tmp_path, single_reference, world):
 @pytest.mark.slow
 def test_two_process_preemption_and_resume(tmp_path):
     """SIGTERM on ONE rank must stop BOTH at an agreed step (the cadenced
-    allgather), checkpoint, exit cleanly — then a resumed run finishes."""
+    allgather), checkpoint, exit cleanly — then a resumed run finishes.
+
+    Bounded retry (4 attempts, fresh dirs/ports each), TARGETED: this
+    leg is ENVIRONMENT-flaky — on this container the gloo/coordination
+    layer dies in the rendezvous preamble ("op.preamble.length <=
+    op.nbytes"), with a mid-run "Connection closed by peer", or with the
+    coordination-service heartbeat timeout, at roughly every other
+    rendezvous (each cycle runs TWO: initial + resume), verified
+    identical at clean pre-change HEAD in a worktree.  Only failures
+    matching those infra signatures retry; anything else — a real
+    product regression — fails on the FIRST attempt."""
+    last: Exception | None = None
+    for attempt in range(4):
+        root = tmp_path / f"attempt{attempt}"
+        root.mkdir()
+        # pytest.fail raises Failed, a BaseException subclass Exception
+        # does NOT cover — name it explicitly so a deadline fail inside
+        # the cycle reaches the signature check instead of skipping it
+        try:
+            _preemption_and_resume_cycle(root)
+            return
+        except (Exception, pytest.fail.Exception) as e:
+            text = str(e)
+            if not any(sig in text for sig in _INFRA_FLAKE_SIGNATURES):
+                raise
+            last = e
+    assert last is not None
+    raise last
+
+
+# the gloo/coordination-service failure modes this container produces on
+# an otherwise-green run (see test docstring) — the ONLY failures the
+# bounded retry above absorbs
+_INFRA_FLAKE_SIGNATURES = (
+    "op.preamble",
+    "Connection closed by peer",
+    "heartbeat timeout",
+    "coordination service",
+    "CoordinationService",
+)
+
+
+def _preemption_and_resume_cycle(tmp_path):
     train, val = _write_dataset(tmp_path)
     outdir = str(tmp_path / "out")  # shared by both ranks (see above)
     port = _free_port()

@@ -341,10 +341,15 @@ def _requests(rng, n, lo=3, hi=20, vocab=200):
     return [list(rng.randint(4, vocab, rng.randint(lo, hi))) for _ in range(n)]
 
 
-def test_engine_matches_static_batching_seq2seq(mesh8):
+def test_engine_matches_static_batching_seq2seq(mesh8, capsys):
     """Determinism acceptance: an admit/evict schedule over reused slots
     produces EXACTLY the tokens static batching produces, per request —
-    with per-request budgets (the continuous-batching lever) exercised."""
+    with per-request budgets (the continuous-batching lever) exercised.
+    The per-request lifecycle spans (ISSUE 9) ride the same run: one
+    serve_request event per request with the queue-wait/prefill/decode
+    decomposition, and serve_summary's TTFT split accounts for them."""
+    import json as _json
+
     from distributed_llms_example_tpu.serving.engine import (
         ServeConfig,
         ServingEngine,
@@ -366,11 +371,37 @@ def test_engine_matches_static_batching_seq2seq(mesh8):
                     max_source_length=W, log_every_steps=0),
         is_seq2seq=True,
     )
+    capsys.readouterr()
     outs = eng.generate(params, reqs, max_new=budgets)
     assert eng.last_stats is not None and eng.last_stats.decode_steps > 0
     assert eng.last_stats.ttft_s and len(eng.last_stats.ttft_s) == len(reqs)
     # slot reuse genuinely happened: 10 requests through 4 slots
     assert eng.last_stats.sequences > eng.S
+    # per-request lifecycle spans: one serve_request per request, each
+    # decomposed (queue-wait + prefill <= ttft; decode + evict step), and
+    # the summary's TTFT split covers every finished request
+    events = [
+        _json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    spans = [e for e in events if e.get("event") == "serve_request"]
+    assert sorted(e["request"] for e in spans) == list(range(len(reqs)))
+    for e in spans:
+        assert {"slot", "queue_wait_ms", "prefill_ms", "ttft_ms",
+                "decode_ms", "tokens", "t_admit_s", "t_done_s",
+                "finished_at_step"} <= set(e)
+        assert e["tokens"] == len(outs[e["request"]])
+        # TTFT covers at least the queue-wait and this chunk's prefill
+        assert e["ttft_ms"] >= e["queue_wait_ms"] + e["prefill_ms"] - 0.5
+    # late-admitted requests (slot reuse) genuinely waited in queue
+    assert max(e["queue_wait_ms"] for e in spans) > 0
+    summary = next(e for e in events if e.get("event") == "serve_summary")
+    assert {"ttft_queue_p50_ms", "ttft_queue_p95_ms", "ttft_prefill_p50_ms",
+            "ttft_prefill_p95_ms", "ttft_queue_share",
+            "ttft_prefill_share"} <= set(summary)
+    assert 0.0 <= summary["ttft_queue_share"] <= 1.0
+    assert len(eng.last_stats.queue_wait_s) == len(reqs)
     ref = static_batch_generate(
         lm.module, lm.config, mesh8, params, reqs, max_new_tokens=L, width=W, batch=4
     )

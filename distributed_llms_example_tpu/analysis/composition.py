@@ -24,9 +24,12 @@ Known flags: ``pipelined`` (a stage>1 pipeline adapter is in play),
 ``seq2seq``/``causal`` (family shape), ``moe`` (config has routed
 experts), ``fused_ce`` (--fused-ce), ``ring`` (--attention-impl ring),
 ``forced_dense_attention`` (--attention-impl xla/flash), ``grad_accum``
-(--grad-accum-steps > 1 — the in-step scan accumulation), ``decode``
-(the KV-cache serving workload: prefill/decode split + continuous
-batching — serving/engine.py and the Evaluator's split path).
+(--grad-accum-steps > 1 — the in-step scan accumulation),
+``fused_optim`` (an EXPLICIT --optim-impl fused; ``auto`` never sets
+the flag because it resolves to xla wherever fused cannot run),
+``decode`` (the KV-cache serving workload: prefill/decode split +
+continuous batching — serving/engine.py and the Evaluator's split
+path).
 """
 
 from __future__ import annotations
@@ -102,6 +105,18 @@ KNOWN_BAD: tuple[BadCombo, ...] = (
             "memory trade for pure scan overhead; raise "
             "--pipeline-microbatches instead (the step owns accumulation "
             "on GSPMD meshes, the pipeline owns it under stage>1)"
+        ),
+    ),
+    BadCombo(
+        id="fused-optim-pipelined",
+        flags=("fused_optim", "pipelined"),
+        reason=(
+            "--optim-impl fused does not compose with stage>1 pipelines: "
+            "the fused apply dispatches its per-leaf shard_map from the "
+            "param PartitionSpecs, and the pipelined stacked-block layout "
+            "(stage-sharded leading layer dim, schedule-dependent storage "
+            "order) is unproven under it — use --optim-impl auto (which "
+            "resolves to the optax chain under a pipeline) or xla"
         ),
     ),
     BadCombo(
@@ -234,6 +249,17 @@ KNOWN_GOOD: tuple[GoodCombo, ...] = (
               "8-device mesh",
     ),
     GoodCombo(
+        id="fused-optim-gspmd",
+        flags=("fused_optim",),
+        axes=("data", "fsdp", "tensor", "expert"),
+        notes="fused clip+AdamW apply (ops/fused_optim.py): per-leaf "
+              "shard_map on the param specs, composes with in-step grad "
+              "accumulation (the apply consumes the scan's param-sharded "
+              "fp32 accumulators); pinned equivalent to the optax chain "
+              "(same op sequence, equal up to XLA float contraction) on "
+              "the 8-device mesh (tests/test_fused_optim.py)",
+    ),
+    GoodCombo(
         id="sequence-parallel-unpipelined",
         axes=("data", "fsdp", "sequence", "tensor"),
         notes="ring/context parallelism without stages (all families)",
@@ -277,6 +303,7 @@ def config_flags(
     attention_impl: str = "",
     num_experts: int = 0,
     grad_accum_steps: int = 1,
+    optim_impl: str = "",
 ) -> set[str]:
     """Derive the composition-matrix flags from run configuration — the
     ONE mapping from config knobs to table flags, shared by the Trainer's
@@ -291,6 +318,10 @@ def config_flags(
         flags.add("moe")
     if grad_accum_steps > 1:
         flags.add("grad_accum")
+    if optim_impl == "fused":
+        # ONLY the explicit force: "auto" resolves to xla wherever fused
+        # cannot run, so it must never trip the known-bad row
+        flags.add("fused_optim")
     if attention_impl == "ring":
         flags.add("ring")
     elif attention_impl in ("xla", "flash"):

@@ -305,8 +305,21 @@ def loop_body_computations(hlo_text: str) -> set[str]:
     return reachable
 
 
+# Copies below this element count are excluded from the in-place census:
+# XLA's layout assignment inserts small transpose-normalization copies
+# around fused elementwise ops (observed: 512-element relayouts on tiny
+# fallback leaves), which are noise next to the contract's target — a
+# param-scale second fp32 buffer.  256 KiB fp32; every 7B-class leaf
+# shard sits orders of magnitude above it.
+MIN_COPY_CENSUS_ELEMS = 1 << 16
+
+
 def once_per_step_placement(
-    hlo_text: str, spans: Iterable[tuple[str, int, int]]
+    hlo_text: str,
+    spans: Iterable[tuple[str, int, int]],
+    param_elems: Iterable[int] | None = None,
+    *,
+    min_copy_elems: int = MIN_COPY_CENSUS_ELEMS,
 ) -> dict[str, Any]:
     """Census of the optimizer/clip/health block's placement in the
     compiled program, from instruction source metadata.
@@ -319,10 +332,22 @@ def once_per_step_placement(
     the optimizer apply stayed OUT of the grad-accumulation scan — on
     the real compiled program, regardless of ``grad_accum_steps``.
 
-    Returns ``{"total": N, "in_loop": M, "in_loop_examples": [...]}``;
-    a healthy step has ``total > 0`` (the block exists) and
-    ``in_loop == 0`` (none of it slid into a loop body)."""
+    ``param_elems`` (full per-leaf element counts of the model's param
+    tree) extends the census with the IN-PLACE contract of the fused
+    optimizer apply: span-attributed f32 ``copy`` instructions whose
+    element count matches a parameter leaf are counted as
+    ``fp32_param_copies`` — a fused apply that genuinely updates in
+    place (``input_output_aliases``) shows zero; a copy there means the
+    compiler materialized a second fp32 param buffer the fusion exists
+    to avoid.
+
+    Returns ``{"total": N, "in_loop": M, "in_loop_examples": [...]}``
+    (plus ``fp32_param_copies``/``fp32_copy_examples`` when
+    ``param_elems`` is given); a healthy step has ``total > 0`` (the
+    block exists) and ``in_loop == 0`` (none of it slid into a loop
+    body)."""
     span_list = [(str(f), int(a), int(b)) for f, a, b in spans]
+    elem_set = {int(e) for e in param_elems} if param_elems is not None else None
 
     def in_spans(fname: str, line: int) -> bool:
         return any(fname.endswith(f) or f.endswith(fname) or fname == f for f, a, b in span_list if a <= line <= b)
@@ -332,20 +357,94 @@ def once_per_step_placement(
     total = 0
     in_loop = 0
     examples: list[str] = []
+    copies = 0
+    copy_examples: list[str] = []
     for cname, lines in comps.items():
         for line in lines:
             m = _SOURCE_LINE_RE.search(line)
             if not m or not in_spans(m.group("file"), int(m.group("line"))):
                 continue
             total += 1
+            d = _DEF_RE.match(line)
             if cname in loop_comps:
                 in_loop += 1
                 if len(examples) < 8:
-                    d = _DEF_RE.match(line)
                     examples.append(
                         f"{cname}:%{d.group('name')}" if d else cname
                     )
-    return {"total": total, "in_loop": in_loop, "in_loop_examples": examples}
+            if elem_set is None:
+                continue
+            # sync `copy` parses via _DEF_RE; the async `copy-start` form
+            # defines a TUPLE shape only _TUPLE_DEF_RE can read (largest
+            # element = the copied buffer)
+            name = op = dtype = None
+            elems = 0
+            if d is not None:
+                name, op = d.group("name"), d.group("op")
+                dtype, elems = d.group("dtype"), _elems_of(d.group("dims"))
+            else:
+                t = _TUPLE_DEF_RE.match(line)
+                if t is not None and t.group("op") == "copy-start":
+                    name, op = t.group("name"), "copy-start"
+                    pairs = _TUPLE_ELEM_RE.findall(t.group("elems"))
+                    if pairs:
+                        dtype, dims = max(pairs, key=lambda e: _bytes_of(*e))
+                        elems = _elems_of(dims)
+            if (
+                op in ("copy", "copy-start")
+                and dtype == "f32"
+                and elems >= min_copy_elems
+                and elems in elem_set
+            ):
+                copies += 1
+                if len(copy_examples) < 8:
+                    copy_examples.append(f"{cname}:%{name}")
+    out: dict[str, Any] = {
+        "total": total, "in_loop": in_loop, "in_loop_examples": examples,
+    }
+    if elem_set is not None:
+        out["fp32_param_copies"] = copies
+        out["fp32_copy_examples"] = copy_examples
+    return out
+
+
+def in_place_apply_finding(
+    hlo_text: str,
+    spans: Iterable[tuple[str, int, int]],
+    param_elems: Iterable[int],
+    *,
+    min_copy_elems: int = MIN_COPY_CENSUS_ELEMS,
+) -> Finding | None:
+    """The fused-apply in-place contract as a finding: warning when any
+    span-attributed f32 param-sized ``copy`` survived in the compiled
+    program — the buffer aliasing the fused kernel declares
+    (``input_output_aliases``) should leave none.  ``param_elems`` is
+    matched against the PER-DEVICE program's buffer sizes: multi-device
+    callers must pass ``model_tree_element_candidates(full_counts,
+    mesh_size)`` (as ``lint_train_step`` does), or sharded leaves'
+    copies are invisible.  A warning, not an error: XLA legitimately
+    inserts copies around donation on some backends, and a copy costs
+    bandwidth, not correctness.  Copies under ``min_copy_elems`` are
+    ignored — layout-normalization relayouts of small leaves are not the
+    bandwidth the contract protects."""
+    census = once_per_step_placement(
+        hlo_text, spans, param_elems, min_copy_elems=min_copy_elems
+    )
+    if not census.get("fp32_param_copies"):
+        return None
+    return Finding(
+        severity="warning",
+        pass_name="ir",
+        code="optimizer-param-copy",
+        message=(
+            f"{census['fp32_param_copies']} f32 param-sized copy "
+            f"instruction(s) in the optimizer-apply span (e.g. "
+            f"{census['fp32_copy_examples'][:3]}) — the fused apply "
+            "declares in-place aliasing precisely so no second fp32 "
+            "param buffer is materialized per step"
+        ),
+        context=census,
+    )
 
 
 def once_per_step_finding(
@@ -752,9 +851,16 @@ def lint_train_step(
     dtype: str = "bfloat16",
     remat: bool = False,
     grad_accum_steps: int = 1,
+    optim_impl: str = "",
     gather_bytes_threshold: int = 16 * 1024**2,
 ) -> list[Finding]:
     """AOT-compile the sharded train step from abstract args and scan it.
+
+    ``optim_impl`` builds the step with that optimizer apply (e.g.
+    ``"fused"`` — the Pallas clip+AdamW path); the fused program is
+    additionally checked against the IN-PLACE contract
+    (``in_place_apply_finding``: no f32 param-sized copies in the
+    apply's source spans).
 
     Needs a real device mesh (the SPMD partitioner inserts the collectives
     this pass looks for at compile time); callers skip the pass when the
@@ -776,6 +882,7 @@ def lint_train_step(
         model_name, mesh,
         global_batch=global_batch, src_len=src_len, tgt_len=tgt_len,
         dtype=dtype, remat=remat, grad_accum_steps=grad_accum_steps,
+        optim_impl=optim_impl,
     )
     text = compiled.as_text()
     leaves = jax.tree.leaves(a_params)
@@ -792,16 +899,42 @@ def lint_train_step(
         gather_bytes_threshold=gather_bytes_threshold,
         param_element_counts=[int(math.prod(x.shape)) for x in leaves],
     )
-    if grad_accum_steps > 1:
-        # grad accumulation adds its own compiled-program contract: the
-        # clip/AdamW/health tail must sit OUTSIDE the microbatch scan
+    if grad_accum_steps > 1 or optim_impl:
         from distributed_llms_example_tpu.train.step import (
             once_per_step_source_spans,
         )
 
-        placement = once_per_step_finding(text, once_per_step_source_spans())
-        if placement is not None:
-            findings.append(placement)
+        spans = once_per_step_source_spans()
+        if grad_accum_steps > 1:
+            # grad accumulation adds its own compiled-program contract:
+            # the clip/AdamW/health tail must sit OUTSIDE the microbatch
+            # scan
+            placement = once_per_step_finding(text, spans)
+            if placement is not None:
+                findings.append(placement)
+        from distributed_llms_example_tpu.ops.fused_optim import resolve_impl
+
+        if optim_impl and resolve_impl(optim_impl) == "fused":
+            # the fused apply's IN-PLACE contract: no f32 param-sized
+            # copy instruction in the apply's source spans (the xla path
+            # is not held to it — XLA legitimately copies around its
+            # unaliased buffers there).  The compiled text is the
+            # PER-DEVICE program, so a sharded leaf's buffers carry
+            # shard-sized element counts — expand the full counts with
+            # the same full-plus-even-shard candidate set the traffic
+            # classifier uses, or sharded-leaf copies are invisible on
+            # any multi-device mesh
+            mesh_size = 1
+            for v in dict(mesh.shape).values():
+                mesh_size *= max(1, int(v))
+            inplace = in_place_apply_finding(
+                text, spans,
+                model_tree_element_candidates(
+                    [int(math.prod(x.shape)) for x in leaves], mesh_size
+                ),
+            )
+            if inplace is not None:
+                findings.append(inplace)
     return findings
 
 

@@ -71,6 +71,7 @@ def run_passes(
     rules: Any = None,
     fused_ce: bool = False,
     attention_impl: str = "",
+    optim_impl: str = "",
     replicated_bytes_threshold: int = spec_lint.DEFAULT_REPLICATED_BYTES_THRESHOLD,
     run_ir: bool = True,
     global_batch: int = 8,
@@ -107,6 +108,12 @@ def run_passes(
     findings += spec_lint.lint_accumulator_mirror(
         a_params, rules if rules is not None else default_rules()
     )
+    # the fused-optimizer layout contract: the adam moments (whose paths
+    # END with the param path) resolve to the SAME specs as the params —
+    # the fused apply shard_maps all four trees with one spec per leaf
+    findings += spec_lint.lint_optimizer_moment_mirror(
+        a_params, rules if rules is not None else default_rules()
+    )
 
     # Serving passes (--serve): the KV-cache rule set validated like the
     # param rules, over the abstract decode cache — plus the decode rows
@@ -141,6 +148,7 @@ def run_passes(
             attention_impl=attention_impl,
             num_experts=int(getattr(lm.config, "num_experts", 0) or 0),
             grad_accum_steps=grad_accum_steps,
+            optim_impl=optim_impl,
         ) | set(serve_flags),
     )
 
@@ -177,6 +185,7 @@ def run_passes(
                 dtype=dtype,
                 remat=remat,
                 grad_accum_steps=grad_accum_steps,
+                optim_impl=optim_impl,
             )
             if serve:
                 # the compiled SERVING decode step: no encoder recompute,
@@ -201,6 +210,7 @@ def startup_lint(cfg: Any) -> list[Finding]:
         schedule=cfg.pipeline_schedule,
         fused_ce=cfg.fused_ce,
         attention_impl=cfg.attention_impl,
+        optim_impl=cfg.optim_impl,
         run_ir=False,
         dtype=cfg.compute_dtype,
         remat=cfg.remat,
@@ -221,6 +231,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused-ce", action="store_true")
     p.add_argument("--attention-impl", type=str, default="",
                    choices=("", "auto", "flash", "ring", "xla"))
+    p.add_argument("--optim-impl", type=str, default="",
+                   choices=("", "auto", "fused", "xla"),
+                   help="lint the step built with this optimizer apply; "
+                        "'fused' additionally checks the in-place contract "
+                        "(no f32 param-sized copies in the apply spans) on "
+                        "the compiled program")
     p.add_argument("--rules-json", type=str, default="",
                    help='lint this rule set instead of the defaults: '
                         '[["pattern", ["fsdp", null]], ...]')
@@ -271,6 +287,7 @@ def main(argv: list[str] | None = None) -> int:
             rules=rules,
             fused_ce=args.fused_ce,
             attention_impl=args.attention_impl,
+            optim_impl=args.optim_impl,
             replicated_bytes_threshold=args.replicated_bytes_threshold,
             run_ir=not args.no_ir,
             global_batch=args.batch,

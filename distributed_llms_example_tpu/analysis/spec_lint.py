@@ -120,6 +120,66 @@ def lint_accumulator_mirror(params: Any, rules: Any = None) -> list[Finding]:
     return findings
 
 
+def lint_optimizer_moment_mirror(params: Any, rules: Any = None) -> list[Finding]:
+    """The fused-optimizer layout contract (``ops/fused_optim.py``): the
+    AdamW moments' resolved specs must equal the param specs, leaf for
+    leaf.  The moments live in the optax chain state at paths ENDING
+    with the param path (``opt_state/1/0/mu/<param path>``), and
+    ``state_shardings`` resolves them through the same unanchored
+    path-regex rules — so mirroring normally holds by construction.
+    This pass errors when it does NOT (an anchored rule, a rule matching
+    'mu'/'nu' path segments): the fused apply shard_maps (param, mu, nu,
+    grad) with ONE spec per leaf, and a diverging moment spec would
+    force GSPMD to reshard the moments against the kernel's layout
+    every step.
+    Device-free: specs only, no mesh."""
+    import jax.tree_util as jtu
+
+    from distributed_llms_example_tpu.parallel.sharding import _path_str
+
+    if rules is None:
+        from distributed_llms_example_tpu.parallel.sharding import default_rules
+
+        rules = default_rules()
+
+    findings: list[Finding] = []
+    leaves: list[tuple[str, int]] = []
+    jtu.tree_map_with_path(
+        lambda path, x: leaves.append(
+            (_path_str(path), len(getattr(x, "shape", ())))
+        ),
+        params,
+    )
+    for path, ndim in leaves:
+        want = rules.spec_for(path, ndim)
+        for moment in ("mu", "nu"):
+            moment_path = f"opt_state/1/0/{moment}/{path}"
+            got = rules.spec_for(moment_path, ndim)
+            if got != want:
+                findings.append(
+                    Finding(
+                        severity="error",
+                        pass_name="spec",
+                        code="optimizer-moment-spec-mismatch",
+                        message=(
+                            f"{moment_path}: adam {moment} resolves to spec "
+                            f"{got} but its param resolves to {want} — the "
+                            "fused optimizer apply shard_maps (param, mu, "
+                            "nu, grad) with ONE spec per leaf; a rule that "
+                            "distinguishes the moment path breaks the "
+                            "mirror (and costs a GSPMD reshard per step on "
+                            "the xla path too)"
+                        ),
+                        context={
+                            "param": path,
+                            "param_spec": str(want),
+                            "moment_spec": str(got),
+                        },
+                    )
+                )
+    return findings
+
+
 def lint_cache_sharding(
     cache: Any,
     mesh_axes: Mapping[str, int],

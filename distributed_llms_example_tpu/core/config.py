@@ -152,6 +152,16 @@ class TrainConfig:
     # trades bit-reproducibility with the XLA mask stream for the removal
     # of threefry mask generation AND the mask's HBM round-trips
     dropout_impl: str = "auto"
+    # optimizer-apply implementation (ops/fused_optim.py): "auto" (default
+    # — fused Pallas clip+AdamW kernel on TPU: one in-place pass per
+    # leaf-shard with the health partial sums riding the same pass; the
+    # optax chain elsewhere), "fused" or "xla" to force.  The impls run
+    # the identical op sequence — equal up to XLA float contraction (a
+    # few ulp on rare elements; test-pinned) — and the opt-state pytree
+    # layout never changes, so checkpoints roam freely between impls.
+    # Pipelined (stage>1) runs always use xla; --optim-impl fused there
+    # is a composition-matrix error.
+    optim_impl: str = "auto"
     remat: bool = False  # jax.checkpoint the transformer blocks
     remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
     # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
@@ -350,6 +360,15 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
         help="dropout path: auto (fused Pallas kernel on TPU — in-kernel "
              "RNG, no mask in HBM, seed-recompute backward; XLA elsewhere), "
              "fused or xla to force",
+    )
+    p.add_argument(
+        "--optim-impl", type=str, default=_D.optim_impl,
+        choices=("auto", "fused", "xla"),
+        help="optimizer apply: auto (fused Pallas clip+AdamW kernel on TPU "
+             "— one in-place pass per leaf-shard, health stats from the "
+             "same pass; optax chain elsewhere), fused or xla to force. "
+             "Same op sequence either way (equal up to XLA float "
+             "contraction); checkpoints roam between impls",
     )
     p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)

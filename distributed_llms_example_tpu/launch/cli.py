@@ -38,6 +38,14 @@ performance") and ``--prng-impl auto|threefry|rbg`` the key stream
 (auto = TPU hardware RNG on TPU, bit-reproducible threefry elsewhere);
 the resolved pair is logged as an ``rng_config`` event at startup.
 
+Optimizer: ``--optim-impl auto|fused|xla`` picks the optimizer apply
+(auto = the fused Pallas clip+AdamW kernel on TPU — one in-place pass
+per leaf-shard, ``--health`` stats from the same pass; the optax chain
+elsewhere; see README "Optimizer & step overhead").  Both impls run the
+identical op sequence (equal up to XLA float contraction) and write the
+SAME optax opt-state pytree, so checkpoints roam between them; the
+resolved impl is logged as an ``optim_config`` event at startup.
+
 Training health: ``--health`` (auto under ``--obs jsonl``) makes the
 compiled step return in-graph numerics (param norm, per-bucket update
 ratios, non-finite counts — zero extra device syncs) and arms the

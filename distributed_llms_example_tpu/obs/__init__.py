@@ -259,6 +259,18 @@ class TrainerObs:
             return
         self.budget.probe(sync_leaf)
 
+    def optimizer_probe(self, step: int, fn_factory: Any) -> None:
+        """The budget layer's cadenced optimizer-apply wall sample: at
+        the log cadence ONLY (after the window closed — the trainer's
+        ``mark_step_start`` excludes the probe's wall from the step-time
+        partition like checkpoint/eval), run one stand-alone jitted
+        optimizer apply and time it (``optimizer_apply_ms`` on the next
+        ``step_budget`` account).  Off-cadence this is two comparisons
+        and returns — zero device syncs."""
+        if self.budget is None or step % self.every != 0:
+            return
+        self.budget.probe_optimizer(fn_factory)
+
     def eval_span(self):
         return self.spans.span("eval")
 

@@ -139,6 +139,9 @@ class BudgetAccountant:
         self.history_size = int(history_size)
         self.history: list[dict] = []
         self._closed = 0
+        # cadenced gauges riding the account (not partition components):
+        # currently the optimizer-apply wall sample (probe_optimizer)
+        self._gauges: dict[str, float] = {}
 
     # -- the one device interaction (log cadence only) -------------------
 
@@ -152,6 +155,46 @@ class BudgetAccountant:
 
         with self.spans.span("device_busy"):
             jax.block_until_ready(sync_leaf)
+
+    def probe_optimizer(self, fn: Any) -> None:
+        """Time one stand-alone optimizer apply (``fn`` runs the jitted
+        apply and returns its output to block on) — the satellite gauge
+        that lets the fused-vs-xla A/B read optimizer milliseconds
+        DIRECTLY from the ``step_budget`` account instead of inferring
+        them from step-time deltas.  Cadence-gated by the caller
+        (``TrainerObs.optimizer_probe``), and run AFTER the window
+        closes, alongside checkpoint/eval, so its wall is EXCLUDED from
+        the additive step-time partition (it is measurement, not step
+        work); the sample lands on the NEXT window's account as
+        ``optimizer_apply_ms``.  The FIRST invocation runs one untimed
+        warm call: the lazily-built probe program jit-compiles inside
+        ``fn`` and a compile is not an apply (the warm flag is set only
+        AFTER that call succeeds, so a transient failure cannot leave a
+        later compile mislabeled as the timed sample).
+
+        The probe is a GAUGE, never load-bearing: any failure (an OOM
+        compiling the stand-alone apply on a memory-tight config, a
+        transient backend error inside the blocking call) disables
+        further probes for this run with one logged event instead of
+        propagating into the training loop."""
+        import jax
+
+        if getattr(self, "_opt_probe_dead", False):
+            return
+        try:
+            if not getattr(self, "_opt_probe_warm", False):
+                jax.block_until_ready(fn())
+                self._opt_probe_warm = True
+            t0 = self.spans.clock()
+            jax.block_until_ready(fn())
+            self._gauges["optimizer_apply_ms"] = _ms(self.spans.clock() - t0)
+        except Exception as e:  # noqa: BLE001 — telemetry must not kill the run
+            self._opt_probe_dead = True
+            self._gauges.pop("optimizer_apply_ms", None)
+            sink_mod.emit({
+                "event": "optimizer_probe_disabled",
+                "reason": str(e)[:300],
+            }, local=True)
 
     # -- window close (log cadence only) ---------------------------------
 
@@ -208,6 +251,16 @@ class BudgetAccountant:
         acct["offcadence_sync_suspect"] = bool(
             offcadence > 0 and self.async_dispatch
         )
+        opt_ms = self._gauges.get("optimizer_apply_ms")
+        if opt_ms is not None:
+            # the newest cadenced optimizer-apply sample (probe_optimizer)
+            # + its share of the window's mean step wall — the direct
+            # "how much of each step is the optimizer" read the fused
+            # optimizer A/B consumes
+            acct["optimizer_apply_ms"] = opt_ms
+            acct["optimizer_share_of_step"] = round(
+                opt_ms / max(_ms(mean_step), 1e-9), 4
+            )
         if not self.async_dispatch:
             acct["sync_dispatch_backend"] = True
         if warmup:
@@ -252,6 +305,24 @@ def aggregate_accounts(accounts: list[dict]) -> dict | None:
     out["offcadence_sync_steps"] = sum(
         int(a.get("offcadence_sync_steps", 0) or 0) for a in accounts
     )
+    opt_samples = [
+        float(a["optimizer_apply_ms"])
+        for a in accounts
+        if a.get("optimizer_apply_ms") is not None
+    ]
+    if opt_samples:
+        out["optimizer_apply_ms"] = round(
+            sum(opt_samples) / len(opt_samples), 3
+        )
+        share_samples = [
+            float(a["optimizer_share_of_step"])
+            for a in accounts
+            if a.get("optimizer_share_of_step") is not None
+        ]
+        if share_samples:
+            out["optimizer_share_of_step"] = round(
+                sum(share_samples) / len(share_samples), 4
+            )
     return out
 
 

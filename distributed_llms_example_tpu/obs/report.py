@@ -593,6 +593,27 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
                 f"| {rank} | {agg['windows']} | {_fmt(agg['wall_ms'])} | "
                 f"{comps} | {_fmt(agg['dispatch_efficiency'])} |"
             )
+        opt_rows = [
+            (rank, agg)
+            for rank, agg in sorted(budget["ranks"].items())
+            if agg.get("optimizer_apply_ms") is not None
+        ]
+        if opt_rows:
+            # the cadenced stand-alone apply sample (obs/budget.py
+            # probe_optimizer) — the direct optimizer-ms read the
+            # fused-vs-xla --optim-impl A/B consumes
+            add(
+                "optimizer apply (cadenced stand-alone sample): "
+                + ", ".join(
+                    f"r{rank}={_fmt(agg['optimizer_apply_ms'])}ms"
+                    + (
+                        f" ({_fmt(agg['optimizer_share_of_step'] * 100)}% of step)"
+                        if agg.get("optimizer_share_of_step") is not None
+                        else ""
+                    )
+                    for rank, agg in opt_rows
+                )
+            )
         add("")
         add("worst offenders (host-stall components, share of total wall):")
         for o in budget["offenders"]:

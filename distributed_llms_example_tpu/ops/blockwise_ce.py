@@ -4,13 +4,34 @@ The standard causal-LM loss materializes the full (tokens, vocab) logits
 tensor — for the 7B recipe (batch 4 × seq 1024 × vocab 32000) that is a
 0.5 GB fp32 array written and re-read several times (logsumexp, gather,
 softmax in the backward), all pure HBM traffic.  This module fuses the
-LM-head matmul, the online softmax statistics, and the CE reduction into
-one ``lax.scan`` over vocab chunks: per chunk, a (tokens, block) tile is
-produced by the MXU, consumed by the running logsumexp / true-logit
-gather, and dropped — the only (tokens, vocab)-sized object that ever
-exists is conceptual.  The hand-written vjp recomputes each chunk's
-logits in the backward (flash-attention-style rematerialization) and
-accumulates dh / dW chunk by chunk.
+LM-head matmul, the softmax statistics, and the CE reduction into one
+``lax.scan`` over vocab chunks: per chunk, a (tokens, block) tile is
+produced by the MXU, reduced to per-row scalars, and dropped — the only
+(tokens, vocab)-sized object that ever exists is conceptual.
+
+The backward (second attack, after the r05 regression 99.3 → 111.5 ms):
+
+- **Residuals are per-chunk scalars.**  The ``custom_vjp`` saves only
+  the per-chunk logsumexp rows ``lse[(nc, N)]`` (and the function's own
+  inputs, which autodiff keeps alive anyway) — zero logits bytes
+  resident between forward and backward, so the op stays
+  remat-transparent and composes with activation checkpointing.
+- **One recompute feeding BOTH contractions.**  The backward scan
+  recomputes each chunk's logits once and immediately contracts them
+  into dh (``g @ w_cᵀ`` — the dlogits→dhidden contraction, fused per
+  chunk) and dw (``hᵀ @ g``) — the minimum possible: the softmax term
+  of the gradient needs the probabilities, and with no logits resident
+  they must be recomputed exactly once (~one extra head matmul pass vs
+  the materializing path; that pass IS the price of the 0.5 GB saving,
+  measured honestly in the bench A/B).
+- **A lean scan body.**  The r05 body built a (tokens, block) one-hot,
+  clip/compare target indexing, and a running dw carry updated with
+  ``dynamic_update_slice`` — a full (D, V) fp32 carry rewritten every
+  chunk when XLA fails to alias it.  Now the body is exactly matmul →
+  exp → scale → two contractions: dw chunks leave the scan as stacked
+  OUTPUTS (written once each), and the one-hot / label-smoothing
+  correction terms are applied OUTSIDE the loop as one gather
+  (``w[:, targets]``), one scatter-add, and a rank-1 term.
 
 Numerics: chunk logits are computed at fp32 accumulation
 (``preferred_element_type``) from the bf16 hidden/kernel — slightly
@@ -36,8 +57,6 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llms_example_tpu.data.batching import LABEL_PAD
-
-_NEG = -1.0e30  # finite stand-in for -inf: exp(_NEG - m) underflows to 0
 
 
 def pick_block(vocab: int, target: int = 4096) -> int:
@@ -74,11 +93,18 @@ def blockwise_cross_entropy_sums(
     int ids with ``LABEL_PAD`` marking masked positions.  Gradients flow
     to ``hidden`` and ``w``; the count output has zero gradient.
     """
-    lsum, tokens, _ = _forward(hidden, w, labels, label_smoothing, block)
+    lsum, tokens, _, _ = _forward(hidden, w, labels, label_smoothing, block)
     return lsum, tokens
 
 
 def _forward(hidden, w, labels, label_smoothing, block):
+    """Vocab-chunked forward: per chunk, (N, blk) logits reduce to the
+    per-row chunk-local logsumexp ``lse_c``, the correct-class logit
+    (one chunk holds each row's target), and — under label smoothing —
+    the chunk's logit sum.  No cross-chunk carry: the global logsumexp
+    is the (nc, N) → (N,) logsumexp of the per-chunk rows, exactly equal
+    to the online-softmax recurrence but leaving per-chunk scalars the
+    backward can be reconstructed from."""
     V = w.shape[1]
     blk = pick_block(V) if block is None else block
     if V % blk:
@@ -86,74 +112,88 @@ def _forward(hidden, w, labels, label_smoothing, block):
     nc = V // blk
     mask = (labels != LABEL_PAD)
     targets = jnp.where(mask, labels, 0)
+    smooth_on = label_smoothing > 0.0
 
-    def body(carry, i):
-        m, s, t_logit, sum_l = carry
+    def body(_, i):
         lg = _logits(hidden, _chunk(w, i, blk))  # (N, blk) fp32
-        m_new = jnp.maximum(m, jnp.max(lg, axis=-1))
-        s = s * jnp.exp(m - m_new) + jnp.sum(jnp.exp(lg - m_new[:, None]), axis=-1)
+        m_c = jnp.max(lg, axis=-1)
+        lse_c = m_c + jnp.log(jnp.sum(jnp.exp(lg - m_c[:, None]), axis=-1))
         c0 = i * blk
         in_chunk = (targets >= c0) & (targets < c0 + blk)
         idx = jnp.clip(targets - c0, 0, blk - 1)
         t = jnp.take_along_axis(lg, idx[:, None], axis=1)[:, 0]
-        t_logit = jnp.where(in_chunk, t, t_logit)
-        sum_l = sum_l + jnp.sum(lg, axis=-1)
-        return (m_new, s, t_logit, sum_l), None
+        t_part = jnp.where(in_chunk, t, 0.0)  # each target lives in ONE chunk
+        sum_part = jnp.sum(lg, axis=-1) if smooth_on else jnp.zeros(())
+        return 0, (lse_c, t_part, sum_part)
 
-    N = hidden.shape[0]
-    init = (
-        jnp.full((N,), _NEG, jnp.float32),
-        jnp.zeros((N,), jnp.float32),
-        jnp.full((N,), _NEG, jnp.float32),
-        jnp.zeros((N,), jnp.float32),
-    )
-    (m, s, t_logit, sum_l), _ = jax.lax.scan(body, init, jnp.arange(nc))
-    logz = m + jnp.log(s)
+    _, (lse, t_parts, sum_parts) = jax.lax.scan(body, 0, jnp.arange(nc))
+    m = jnp.max(lse, axis=0)
+    logz = m + jnp.log(jnp.sum(jnp.exp(lse - m[None, :]), axis=0))
+    t_logit = jnp.sum(t_parts, axis=0)
     loss = logz - t_logit
-    if label_smoothing > 0.0:
+    if smooth_on:
         # mean over vocab of -log_softmax = logz - mean(logits)
-        smooth = logz - sum_l / V
+        smooth = logz - jnp.sum(sum_parts, axis=0) / V
         loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth
     maskf = mask.astype(jnp.float32)
-    return jnp.sum(loss * maskf), jnp.sum(maskf), logz
+    return jnp.sum(loss * maskf), jnp.sum(maskf), logz, lse
 
 
 def _fwd(hidden, w, labels, label_smoothing, block):
-    lsum, tokens, logz = _forward(hidden, w, labels, label_smoothing, block)
-    return (lsum, tokens), (hidden, w, labels, logz)
+    lsum, tokens, _logz, lse = _forward(hidden, w, labels, label_smoothing, block)
+    # residuals: the inputs (alive under autodiff regardless) plus ONLY
+    # the per-chunk lse rows — (nc, N) fp32 scalars, no logits bytes
+    return (lsum, tokens), (hidden, w, labels, lse)
 
 
 def _bwd(label_smoothing, block, res, ct):
-    hidden, w, labels, logz = res
+    hidden, w, labels, lse = res
     d_lsum, _d_tokens = ct  # the count is a constant of the data: no grad
     V = w.shape[1]
     blk = pick_block(V) if block is None else block
     nc = V // blk
     mask = (labels != LABEL_PAD)
     targets = jnp.where(mask, labels, 0)
-    scale = (mask.astype(jnp.float32) * d_lsum)[:, None]  # (N, 1)
+    # global logsumexp reassembled from the saved per-chunk rows
+    m = jnp.max(lse, axis=0)
+    logz = m + jnp.log(jnp.sum(jnp.exp(lse - m[None, :]), axis=0))
+    scale = mask.astype(jnp.float32) * d_lsum  # (N,)
 
-    def body(carry, i):
-        dh, dw = carry
+    # The softmax term: one scan whose recomputed chunk logits feed BOTH
+    # contractions — dh += g @ w_cᵀ fused per chunk (the dlogits→dhidden
+    # contraction never materializes g beyond one (N, blk) tile), dw
+    # chunks leave as stacked scan OUTPUTS (each written exactly once; a
+    # dw carry + dynamic_update_slice rewrote the full (D, V) fp32
+    # buffer per chunk when XLA failed to alias it — the r05 regression's
+    # biggest slice)
+    def body(dh, i):
         w_c = _chunk(w, i, blk)
-        lg = _logits(hidden, w_c)  # recompute, flash-style
-        p = jnp.exp(lg - logz[:, None])
-        c0 = i * blk
-        in_chunk = (targets >= c0) & (targets < c0 + blk)
-        idx = jnp.clip(targets - c0, 0, blk - 1)
-        onehot = (
-            (jnp.arange(blk)[None, :] == idx[:, None]) & in_chunk[:, None]
-        ).astype(jnp.float32)
-        g = p - (1.0 - label_smoothing) * onehot - label_smoothing / V
-        g = g * scale  # (N, blk) fp32
+        lg = _logits(hidden, w_c)  # the one recompute, flash-style
+        g = jnp.exp(lg - logz[:, None]) * scale[:, None]  # (N, blk) fp32
         dh = dh + jnp.einsum("nv,dv->nd", g, w_c, preferred_element_type=jnp.float32)
         dw_c = jnp.einsum("nd,nv->dv", hidden, g, preferred_element_type=jnp.float32)
-        dw = jax.lax.dynamic_update_slice_in_dim(dw, dw_c, i * blk, axis=1)
-        return (dh, dw), None
+        return dh, dw_c
 
     dh0 = jnp.zeros(hidden.shape, jnp.float32)
-    dw0 = jnp.zeros(w.shape, jnp.float32)
-    (dh, dw), _ = jax.lax.scan(body, (dh0, dw0), jnp.arange(nc))
+    dh, dw_chunks = jax.lax.scan(body, dh0, jnp.arange(nc))
+    # (nc, D, blk) chunks are contiguous vocab slabs → (D, V)
+    dw = jnp.moveaxis(dw_chunks, 0, 1).reshape(w.shape)
+
+    # Correction terms OUTSIDE the hot loop (the r05 body rebuilt a
+    # (N, blk) one-hot every chunk): the correct-class term is one
+    # gather + one scatter-add, the label-smoothing term is rank-1.
+    onehot_coef = (1.0 - label_smoothing) * scale  # (N,)
+    w_y = jnp.take(w, targets, axis=1).astype(jnp.float32)  # (D, N)
+    dh = dh - onehot_coef[:, None] * w_y.T
+    h32 = hidden.astype(jnp.float32)
+    dw = dw.at[:, targets].add(
+        -(onehot_coef[:, None] * h32).T, mode="drop"
+    )
+    if label_smoothing > 0.0:
+        sm = label_smoothing / V
+        w_rowsum = jnp.sum(w, axis=1).astype(jnp.float32)  # (D,)
+        dh = dh - (sm * scale)[:, None] * w_rowsum[None, :]
+        dw = dw - sm * jnp.sum(scale[:, None] * h32, axis=0)[:, None]
     return dh.astype(hidden.dtype), dw.astype(w.dtype), None
 
 

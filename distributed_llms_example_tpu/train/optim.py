@@ -17,9 +17,11 @@ Parity with the reference, but with the dead knobs made live:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -65,6 +67,26 @@ def multisteps_reference(
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class OptimizerSpec:
+    """The clip+AdamW hyperparameters as DATA — ``make_optimizer`` turns
+    them into the opaque optax chain (the ``xla`` impl), and the fused
+    Pallas apply (``ops/fused_optim.py``, ``--optim-impl fused``) reads
+    them directly: an opaque ``GradientTransformation`` cannot be fused,
+    so the spec is the one description both impls derive from (pinned
+    against each other: identical op sequence, equal up to XLA float
+    contraction)."""
+
+    learning_rate: float = 5e-5
+    weight_decay: float = 0.01
+    warmup_steps: int = 500
+    total_steps: int = 10_000
+    max_grad_norm: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+
+
 def make_optimizer(
     *,
     learning_rate: float = 5e-5,
@@ -76,9 +98,258 @@ def make_optimizer(
     b2: float = 0.999,
     eps: float = 1e-8,
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
-    schedule = linear_schedule_with_warmup(learning_rate, warmup_steps, total_steps)
-    tx = optax.chain(
-        optax.clip_by_global_norm(max_grad_norm) if max_grad_norm > 0 else optax.identity(),
-        optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay, mask=decay_mask),
+    tx, schedule, _ = make_optimizer_bundle(
+        learning_rate=learning_rate, weight_decay=weight_decay,
+        warmup_steps=warmup_steps, total_steps=total_steps,
+        max_grad_norm=max_grad_norm, b1=b1, b2=b2, eps=eps,
     )
     return tx, schedule
+
+
+def make_optimizer_bundle(
+    **kw: Any,
+) -> tuple[optax.GradientTransformation, optax.Schedule, OptimizerSpec]:
+    """(tx, schedule, spec): the optax chain plus the :class:`OptimizerSpec`
+    it was built from — callers that want the fused apply
+    (``make_train_step(..., optim_spec=spec)``) use this form so the two
+    impls cannot be built from diverging hyperparameters."""
+    spec = OptimizerSpec(**kw)
+    schedule = linear_schedule_with_warmup(
+        spec.learning_rate, spec.warmup_steps, spec.total_steps
+    )
+    tx = optax.chain(
+        optax.clip_by_global_norm(spec.max_grad_norm)
+        if spec.max_grad_norm > 0
+        else optax.identity(),
+        optax.adamw(
+            schedule, b1=spec.b1, b2=spec.b2, eps=spec.eps,
+            weight_decay=spec.weight_decay, mask=decay_mask,
+        ),
+    )
+    return tx, schedule, spec
+
+
+def optimizer_update(
+    tx: optax.GradientTransformation, grads: Any, opt_state: Any, params: Any
+) -> tuple[Any, Any, Any]:
+    """THE ``xla``-impl apply: ``tx.update`` + ``optax.apply_updates`` —
+    the one home of the raw optax apply (scripts/repo_lint.py rule 8
+    forbids it elsewhere in models/ and train/, so no call site can
+    bypass the ``--optim-impl`` dispatch in ``optimizer_apply_block``).
+    Returns (new_params, new_opt_state, updates)."""
+    updates, new_opt = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), new_opt, updates
+
+
+# ---------------------------------------------------------------------------
+# The fused (--optim-impl fused) apply: same optax state pytree, same math,
+# one Pallas pass per leaf-shard (ops/fused_optim.py).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedOptimPlan:
+    """Everything ``optimizer_apply_block`` needs to run the fused apply:
+    the hyperparameter spec, the mesh (per-shard ``shard_map`` dispatch),
+    and the params' resolved PartitionSpecs (mu/nu/grad-accumulators all
+    mirror them — the PR 5 layout contract the spec lint checks)."""
+
+    spec: OptimizerSpec
+    mesh: Any = None
+    param_specs: Any = None
+
+
+def _safe_int32_increment(count: jnp.ndarray) -> jnp.ndarray:
+    # optax.numerics.safe_int32_increment, replicated so the fused count
+    # bits match the chain's
+    max_int32 = jnp.iinfo(jnp.int32).max
+    one = jnp.array(1, dtype=jnp.int32)
+    return jnp.where(count < max_int32, count + one, max_int32)
+
+
+def parse_adamw_state(opt_state: Any) -> tuple[Any, list[Any]]:
+    """Locate the single ``ScaleByAdamState`` (count/mu/nu) and every
+    ``ScaleByScheduleState`` inside the optax chain state, WITHOUT
+    assuming the exact chain nesting.  Raises ValueError when the
+    structure is not a recognizable single-AdamW chain — callers fall
+    back to the xla impl then."""
+    adams: list[Any] = []
+    scheds: list[Any] = []
+
+    def walk(node: Any) -> None:
+        if isinstance(node, optax.ScaleByAdamState):
+            adams.append(node)
+            return
+        if isinstance(node, optax.ScaleByScheduleState):
+            scheds.append(node)
+            return
+        if isinstance(node, tuple):  # chain tuples AND NamedTuple states
+            for child in node:
+                walk(child)
+
+    walk(opt_state)
+    if len(adams) != 1:
+        raise ValueError(
+            f"fused optimizer apply needs exactly one ScaleByAdamState in "
+            f"the chain state, found {len(adams)} — is this the "
+            "make_optimizer chain?"
+        )
+    return adams[0], scheds
+
+
+def rebuild_adamw_state(opt_state: Any, new_adam: Any) -> Any:
+    """The SAME optax pytree with the adam state replaced and every
+    schedule count incremented — checkpoints written by the fused impl
+    restore under xla (and vice versa) because the layout never forks."""
+
+    def walk(node: Any) -> Any:
+        if isinstance(node, optax.ScaleByAdamState):
+            return new_adam
+        if isinstance(node, optax.ScaleByScheduleState):
+            return optax.ScaleByScheduleState(
+                count=_safe_int32_increment(node.count)
+            )
+        if isinstance(node, tuple):
+            rebuilt = [walk(child) for child in node]
+            if hasattr(node, "_replace") and hasattr(node, "_fields"):
+                return type(node)(*rebuilt)
+            return tuple(rebuilt)
+        return node
+
+    return walk(opt_state)
+
+
+def validate_fused_chain(
+    tx: optax.GradientTransformation, abstract_params: Any
+) -> str | None:
+    """Build-time check that the chain state is fused-parseable (shape
+    only — ``eval_shape`` of ``tx.init``).  Returns None when OK, else
+    the reason string (the caller logs it and stays on xla)."""
+    try:
+        parse_adamw_state(jax.eval_shape(tx.init, abstract_params))
+        return None
+    except Exception as e:  # noqa: BLE001 — any parse failure means "not ours"
+        return str(e)[:300]
+
+
+def fused_optimizer_apply(
+    plan: FusedOptimPlan,
+    schedule: optax.Schedule,
+    params: Any,
+    opt_state: Any,
+    grads: Any,
+) -> tuple[Any, Any, jnp.ndarray, Any]:
+    """The fused clip+AdamW step on a whole tree: parse the optax state,
+    compute the step scalars with the chain's own expressions (global
+    grad-norm = the two-stage per-shard-sumsq + psum reduction GSPMD
+    inserts; clip trigger; bias corrections; -lr), run the per-leaf
+    Pallas apply in place, and rebuild the identical state pytree.
+
+    ``grads`` is the token-normalized fp32 tree (the
+    ``optimizer_apply_block`` contract).  Returns
+    (new_params, new_opt_state, grad_norm, stats_tree) where
+    ``stats_tree`` carries each leaf's (param_sumsq, update_sumsq,
+    nonfinite) partial sums from the kernel pass — the ``--health``
+    numerics source, no extra reduction pass."""
+    from distributed_llms_example_tpu.ops.fused_optim import (
+        SCALARS,
+        _S_BC1,
+        _S_BC2,
+        _S_GNORM,
+        _S_NEG_LR,
+        _S_TRIGGER,
+        adamw_tree_apply,
+    )
+
+    spec = plan.spec
+    adam, scheds = parse_adamw_state(opt_state)
+    # stage 1+2 of the global-norm reduction: optax.global_norm's exact
+    # expression — per-leaf sum of squares, summed across leaves; on a
+    # sharded tree the partitioner computes per-shard partials and psums
+    gnorm = optax.global_norm(grads)
+    count_inc = _safe_int32_increment(adam.count)
+    bc1 = (1 - spec.b1**count_inc).astype(jnp.float32)
+    bc2 = (1 - spec.b2**count_inc).astype(jnp.float32)
+    sched_count = scheds[0].count if scheds else adam.count
+    # optax scale_by_learning_rate: step_size = -1 * schedule(count)
+    neg_lr = jnp.asarray(-1 * schedule(sched_count), jnp.float32)
+    trigger = (
+        (gnorm < spec.max_grad_norm).astype(jnp.float32)
+        if spec.max_grad_norm > 0
+        else jnp.ones((), jnp.float32)
+    )
+    scal = jnp.zeros((SCALARS,), jnp.float32)
+    scal = scal.at[_S_GNORM].set(gnorm)
+    scal = scal.at[_S_TRIGGER].set(trigger)
+    scal = scal.at[_S_BC1].set(bc1)
+    scal = scal.at[_S_BC2].set(bc2)
+    scal = scal.at[_S_NEG_LR].set(neg_lr)
+    new_params, new_mu, new_nu, stats = adamw_tree_apply(
+        params, adam.mu, adam.nu, grads, scal,
+        b1=spec.b1, b2=spec.b2, eps=spec.eps,
+        max_norm=spec.max_grad_norm, weight_decay=spec.weight_decay,
+        decay_tree=decay_mask(params),
+        mesh=plan.mesh, param_specs=plan.param_specs,
+    )
+    new_adam = optax.ScaleByAdamState(count=count_inc, mu=new_mu, nu=new_nu)
+    return new_params, rebuild_adamw_state(opt_state, new_adam), gnorm, stats
+
+
+def resolve_fused_plan(
+    optim_spec: "OptimizerSpec | None",
+    optim_impl: str | None,
+    tx: optax.GradientTransformation,
+    state_sh: Any,
+    mesh: Any,
+    *,
+    abstract_params: Any = None,
+    pipelined: bool = False,
+) -> "FusedOptimPlan | None":
+    """THE ``--optim-impl`` dispatch, shared by ``make_train_step`` and
+    ``make_optimizer_probe`` so the step and the budget probe can never
+    resolve to different impls: a FusedOptimPlan when a spec was
+    supplied, the (process-default-resolved) impl is ``fused``, and the
+    caller is not pipelined (stage>1 adapters stay on xla); None
+    otherwise — including when the chain fails validation (logged
+    ``fused_optim_fallback``)."""
+    if optim_spec is None or pipelined:
+        return None
+    from distributed_llms_example_tpu.ops.fused_optim import resolve_impl
+
+    if resolve_impl(optim_impl) != "fused":
+        return None
+    return build_fused_plan(
+        optim_spec, tx, state_sh, mesh, abstract_params=abstract_params
+    )
+
+
+def build_fused_plan(
+    optim_spec: OptimizerSpec,
+    tx: optax.GradientTransformation,
+    state_sh: Any,
+    mesh: Any,
+    *,
+    abstract_params: Any = None,
+) -> FusedOptimPlan | None:
+    """Resolve the fused-apply plan at step-build time, or None (with a
+    logged reason) when the chain state is not fused-parseable — the
+    step then stays on the xla impl instead of failing at trace time."""
+    from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+    reason = (
+        validate_fused_chain(tx, abstract_params)
+        if abstract_params is not None
+        else None
+    )
+    if reason is not None:
+        log_json({
+            "event": "fused_optim_fallback",
+            "reason": reason,
+        })
+        return None
+    param_specs = None
+    if state_sh is not None:
+        param_specs = jax.tree.map(
+            lambda s: getattr(s, "spec", None), state_sh.params
+        )
+    return FusedOptimPlan(spec=optim_spec, mesh=mesh, param_specs=param_specs)

@@ -163,6 +163,38 @@ def accumulator_shardings(param_shardings: Any) -> Any:
     return jax.tree.map(lambda s: s, param_shardings)
 
 
+def health_metrics_from_stats(stats: Any) -> dict[str, jnp.ndarray]:
+    """The health bundle assembled from the fused optimizer kernel's
+    per-leaf partial sums (``ops/fused_optim.py`` — param/update
+    sum-of-squares and non-finite grad counts produced in the SAME
+    kernel pass as the update) instead of a separate reduction pass.
+    Same keys and semantics as :func:`health_metrics`; per-bucket sums
+    may differ from it in fp reduction order only."""
+    from distributed_llms_example_tpu.ops.fused_optim import (
+        STAT_NONFINITE,
+        STAT_P_SUMSQ,
+        STAT_U_SUMSQ,
+    )
+
+    p_sq = {b: jnp.zeros((), jnp.float32) for b in HEALTH_BUCKETS}
+    u_sq = {b: jnp.zeros((), jnp.float32) for b in HEALTH_BUCKETS}
+    nonfinite = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(stats):
+        b = bucket_of_path(path)
+        p_sq[b] = p_sq[b] + leaf[STAT_P_SUMSQ]
+        u_sq[b] = u_sq[b] + leaf[STAT_U_SUMSQ]
+        nonfinite = nonfinite + leaf[STAT_NONFINITE]
+    out: dict[str, jnp.ndarray] = {
+        "param_norm": jnp.sqrt(sum(p_sq.values())),
+        "nonfinite_count": nonfinite,
+    }
+    for b in HEALTH_BUCKETS:
+        out[f"update_ratio_{b}"] = jnp.sqrt(u_sq[b]) / jnp.maximum(
+            jnp.sqrt(p_sq[b]), 1e-12
+        )
+    return out
+
+
 def optimizer_apply_block(
     state: TrainState,
     tx: optax.GradientTransformation,
@@ -172,9 +204,19 @@ def optimizer_apply_block(
     grads: Any,
     *,
     health: bool,
+    fused: Any = None,
 ) -> tuple[TrainState, dict]:
     """The once-per-optimizer-step tail: normalize the token-weighted
     sums, clip + AdamW, and the health numerics.
+
+    ``fused`` (a ``train.optim.FusedOptimPlan``, or None) selects the
+    impl: None runs the optax chain through ``train.optim
+    .optimizer_update`` (the ``xla`` impl — the one owner of the raw
+    apply, repo-lint rule 8); a plan runs the Pallas fused
+    clip+AdamW(+health) apply in place (``--optim-impl fused``), with
+    the health numerics sourced from the kernel's partial sums.  The
+    impls run the identical op sequence — equal up to XLA float
+    contraction (test-pinned), same opt-state pytree.
 
     A NAMED function on purpose: jax stamps each HLO instruction with the
     first non-library source frame, so everything traced here (including
@@ -184,20 +226,36 @@ def optimizer_apply_block(
     compiled program that none of it was scheduled inside the
     grad-accumulation scan body, i.e. the optimizer genuinely runs once
     per step regardless of ``accum_steps``."""
+    from distributed_llms_example_tpu.train.optim import (
+        fused_optimizer_apply,
+        optimizer_update,
+    )
+
     tokens = jnp.maximum(tokens, 1.0)
     loss = lsum / tokens
     grads = jax.tree.map(lambda g: (g / tokens).astype(jnp.float32), grads)
-    updates, new_opt = tx.update(grads, state.opt_state, state.params)
-    new_params = optax.apply_updates(state.params, updates)
+    if fused is not None:
+        new_params, new_opt, grad_norm, stats = fused_optimizer_apply(
+            fused, schedule, state.params, state.opt_state, grads
+        )
+        health_vals = health_metrics_from_stats(stats) if health else None
+    else:
+        new_params, new_opt, updates = optimizer_update(
+            tx, grads, state.opt_state, state.params
+        )
+        grad_norm = optax.global_norm(grads)
+        health_vals = (
+            health_metrics(state.params, grads, updates) if health else None
+        )
     new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
     metrics = {
         "loss": loss,
         "learning_rate": schedule(state.step),
-        "grad_norm": optax.global_norm(grads),
+        "grad_norm": grad_norm,
         "target_tokens": tokens,
     }
-    if health:
-        metrics.update(health_metrics(state.params, grads, updates))
+    if health_vals is not None:
+        metrics.update(health_vals)
     return new_state, metrics
 
 
@@ -206,12 +264,32 @@ def once_per_step_source_spans() -> list[tuple[str, int, int]]:
     must execute exactly once per optimizer step — ``optimizer_apply_block``
     plus the health-numerics helpers it calls (their bodies are user code,
     so jax attributes their instructions to these lines, not to the apply
-    block's call site).  Computed from the live source so the spans track
-    edits; consumed by ``ir_lint.once_per_step_placement``."""
+    block's call site), plus the fused-apply implementation layer
+    (``train/optim.py`` orchestration and the ``ops/fused_optim.py``
+    kernel dispatch — under ``--optim-impl fused`` the apply's
+    instructions carry THOSE frames).  Computed from the live source so
+    the spans track edits; consumed by
+    ``ir_lint.once_per_step_placement``."""
     import inspect
 
+    from distributed_llms_example_tpu.ops import fused_optim
+    from distributed_llms_example_tpu.train import optim as optim_mod
+
     spans = []
-    for fn in (optimizer_apply_block, health_metrics, _bucket_sumsq):
+    fns = (
+        optimizer_apply_block,
+        health_metrics,
+        _bucket_sumsq,
+        health_metrics_from_stats,
+        optim_mod.optimizer_update,
+        optim_mod.fused_optimizer_apply,
+        fused_optim.adamw_tree_apply,
+        fused_optim.fused_adamw_leaf,
+        fused_optim.adamw_leaf_reference,
+        fused_optim._adamw_kernel,
+        fused_optim._sharded_leaf,
+    )
+    for fn in fns:
         lines, first = inspect.getsourcelines(fn)
         spans.append((inspect.getsourcefile(fn), first, first + len(lines) - 1))
     return spans
@@ -341,8 +419,19 @@ def make_train_step(
     is_seq2seq: bool = True,
     sequence_sharded: bool | None = None,
     health: bool = False,
+    optim_spec: Any = None,
+    optim_impl: str | None = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch[, rng]) → (state, metrics).
+
+    ``optim_spec`` (a ``train.optim.OptimizerSpec`` describing ``tx``)
+    plus ``optim_impl`` (``--optim-impl``; None follows the process
+    default, ``auto`` = fused on TPU) select the optimizer apply: the
+    fused Pallas clip+AdamW kernel (in place on the param/accumulator
+    shardings, health sourced from its partial sums) or the optax chain.
+    Without a spec the step always runs the optax (``xla``) impl.
+    Pipelined adapters always run xla (composition row
+    ``fused-optim-pipelined`` guards the explicit flag).
 
     ``health=True`` additionally computes the in-graph numerics bundle
     (``HEALTH_METRIC_KEYS``: param norm, non-finite grad count, per-bucket
@@ -396,14 +485,15 @@ def make_train_step(
             (lsum, tokens), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
             return lsum, tokens, grads
 
-    def make_step_fn(accum_sh: Any) -> Callable:
+    def make_step_fn(accum_sh: Any, fused_plan: Any = None) -> Callable:
         """The step body, closed over the accumulator shardings (the
         mirror of the param shardings — ``accumulator_shardings``) so the
         scan carry is PINNED to the param layout: under FSDP each
         device's accumulator holds exactly its gradient shard, gradients
         reduce-scatter straight into it, and the fp32 tree never
         replicates.  ``accum_sh=None`` (abstract callers without resolved
-        shardings) leaves the layout to GSPMD."""
+        shardings) leaves the layout to GSPMD.  ``fused_plan`` routes the
+        optimizer tail to the fused Pallas apply (None = optax chain)."""
 
         def step_fn(state: TrainState, batch: dict, rng: jax.Array | None = None) -> tuple[TrainState, dict]:
             if grad_accum_steps > 1:
@@ -462,7 +552,8 @@ def make_train_step(
             else:
                 lsum, tokens, grads = value_and_grad_sums(state.params, batch, rng)
             return optimizer_apply_block(
-                state, tx, schedule, lsum, tokens, grads, health=health
+                state, tx, schedule, lsum, tokens, grads, health=health,
+                fused=fused_plan,
             )
 
         return step_fn
@@ -477,13 +568,23 @@ def make_train_step(
         HEALTH_METRIC_KEYS if health else ()
     )
 
-    def jit_it(state_sh: Any) -> Callable:
+    def jit_it(state_sh: Any, abstract_params: Any = None) -> Callable:
+        from distributed_llms_example_tpu.train.optim import resolve_fused_plan
+
         metrics_sh = {k: repl for k in metric_keys}
         # the fp32 gradient accumulators mirror the param shardings leaf
         # for leaf — the weight-update-sharding contract the spec lint
-        # checks and the compiled-carry test pins
+        # checks and the compiled-carry test pins; the fused-plan
+        # resolution (the --optim-impl dispatch) is the SHARED
+        # train/optim.py resolver so the step and the budget probe can
+        # never pick different impls
         step_fn = make_step_fn(
-            accumulator_shardings(state_sh.params) if grad_accum_steps > 1 else None
+            accumulator_shardings(state_sh.params) if grad_accum_steps > 1 else None,
+            resolve_fused_plan(
+                optim_spec, optim_impl, tx, state_sh, mesh,
+                abstract_params=abstract_params,
+                pipelined=hasattr(model, "num_microbatches"),
+            ),
         )
         in_shardings = (state_sh, {"input_ids": bsh, "attention_mask": bsh, "labels": bsh})
         if with_dropout:
@@ -513,9 +614,71 @@ def make_train_step(
 
     def build(state: TrainState) -> tuple[Callable, Any]:
         sh = state_shardings(state, mesh, rules)
-        return jit_it(sh), sh
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state.params
+        )
+        return jit_it(sh, abstract), sh
 
     return build
+
+
+def make_optimizer_probe(
+    tx: optax.GradientTransformation,
+    schedule: optax.Schedule,
+    state_sh: Any,
+    mesh: Mesh,
+    *,
+    optim_spec: Any = None,
+    optim_impl: str | None = None,
+    health: bool = False,
+    abstract_params: Any = None,
+) -> Callable[[TrainState], Any]:
+    """A jitted stand-alone run of ``optimizer_apply_block`` for the
+    budget layer's cadenced optimizer-apply timing (obs/budget.py
+    ``probe_optimizer``): the SAME impl dispatch as the train step
+    (``train.optim.resolve_fused_plan`` — one resolver, so the probe can
+    never stamp a fused sample for a step that actually ran xla; pass
+    ``abstract_params`` so an unparseable chain falls back with the same
+    logged ``fused_optim_fallback`` instead of raising at the first
+    cadence), fed a zeros gradient tree built in-program, with the
+    outputs reduced to one replicated scalar so XLA must execute the
+    full elementwise update (returning the new state would allocate a
+    second full state per probe).  The output writes fuse into the
+    reductions, so the sample reads as the apply's arithmetic + operand
+    traffic — a slightly write-light but componentwise-faithful wall
+    sample.  The caller times it at the LOG CADENCE only; nothing here
+    runs on non-cadence steps."""
+    from distributed_llms_example_tpu.train.optim import resolve_fused_plan
+
+    plan = resolve_fused_plan(
+        optim_spec, optim_impl, tx, state_sh, mesh,
+        abstract_params=abstract_params,
+    )
+
+    def probe(state: TrainState):
+        grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        new_state, _metrics = optimizer_apply_block(
+            state, tx, schedule, jnp.zeros((), jnp.float32),
+            jnp.ones((), jnp.float32), grads, health=health, fused=plan,
+        )
+        total = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree.leaves(new_state):
+            total = total + jnp.sum(leaf).astype(jnp.float32)
+        return total
+
+    jitted = jax.jit(
+        probe,
+        in_shardings=(state_sh,),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+    def run(state: TrainState):
+        with activation_mesh(mesh):
+            return jitted(state)
+
+    return run
 
 
 def put_batch(batch: dict, mesh: Mesh, *, sequence_sharded: bool = False) -> dict:

@@ -52,7 +52,7 @@ from distributed_llms_example_tpu.io.checkpoint import Checkpointer, abstract_li
 from distributed_llms_example_tpu.io.valohai_meta import save_valohai_metadata
 from distributed_llms_example_tpu.models.registry import load_model
 from distributed_llms_example_tpu.parallel.sharding import shard_params
-from distributed_llms_example_tpu.train.optim import make_optimizer
+from distributed_llms_example_tpu.train.optim import make_optimizer_bundle
 from distributed_llms_example_tpu.train.step import (
     create_train_state,
     make_train_step,
@@ -133,7 +133,7 @@ class Trainer:
             )
         self.total_steps = steps_per_epoch * cfg.num_epochs
 
-        self.tx, self.schedule = make_optimizer(
+        self.tx, self.schedule, self.optim_spec = make_optimizer_bundle(
             learning_rate=cfg.learning_rate,
             weight_decay=cfg.weight_decay,
             warmup_steps=cfg.warmup_steps,
@@ -273,6 +273,7 @@ class Trainer:
                 attention_impl=cfg.attention_impl,
                 num_experts=int(getattr(self.config, "num_experts", 0) or 0),
                 grad_accum_steps=cfg.grad_accum_steps,
+                optim_impl=cfg.optim_impl,
             ),
         )
 
@@ -316,6 +317,22 @@ class Trainer:
         )
 
         set_default_impl(cfg.dropout_impl)
+        # optimizer-apply path (--optim-impl): process default for the
+        # fused Pallas clip+AdamW kernel (ops/fused_optim.py) — "auto" =
+        # fused on TPU, optax chain elsewhere; the resolved value is
+        # logged below so post-hoc analysis knows which path ran
+        from distributed_llms_example_tpu.ops.fused_optim import (
+            resolve_impl as resolve_optim_impl,
+            set_default_impl as set_optim_impl,
+        )
+
+        set_optim_impl(cfg.optim_impl)
+        # pipelined runs stay on the optax chain (make_train_step gates
+        # the fused plan on the adapter; log the EFFECTIVE impl)
+        self.optim_impl = (
+            "xla" if self.pipelined else resolve_optim_impl(cfg.optim_impl)
+        )
+        log_json({"event": "optim_config", "optim_impl": self.optim_impl})
         # training health: the in-graph numerics ride the compiled step
         # itself (extra metrics entries, no extra syncs) when the
         # watchdog will consume them
@@ -335,8 +352,13 @@ class Trainer:
             sequence_sharded=self.sequence_sharded,
             rules=self._rules,
             health=self.health_on,
+            optim_spec=self.optim_spec,
+            optim_impl=cfg.optim_impl,
         )
         self.train_step, _ = build(self.state)
+        # lazily-built jitted optimizer-apply probe (budget layer): the
+        # cadenced optimizer_apply_ms sample — see _optimizer_probe_output
+        self._opt_probe = None
         # deterministic fault injection (obs/chaos.py --chaos): the ONE
         # injection point for faulted numerics, checkpoint corruption,
         # transient data errors and signals; the legacy
@@ -914,6 +936,32 @@ class Trainer:
             tokens += int(np.sum(batch["labels"] != LABEL_PAD))
         return tokens
 
+    def _optimizer_probe_output(self):
+        """The budget layer's cadenced optimizer-apply sample: run a
+        stand-alone jitted ``optimizer_apply_block`` (same impl dispatch
+        as the train step, zeros gradients built in-program) on the live
+        state and return its reduction scalar for the caller to block
+        on.  Built LAZILY at the first log cadence so runs that never
+        reach a cadence pay no extra compile; only ever invoked by
+        ``TrainerObs.optimizer_probe`` at the log cadence — zero new
+        off-cadence syncs."""
+        if self._opt_probe is None:
+            from distributed_llms_example_tpu.train.step import (
+                make_optimizer_probe,
+            )
+
+            self._opt_probe = make_optimizer_probe(
+                self.tx, self.schedule, self.state_sh, self.mesh,
+                optim_spec=self.optim_spec,
+                optim_impl="xla" if self.pipelined else self.cfg.optim_impl,
+                health=self.health_on,
+                abstract_params=jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    self.state.params,
+                ),
+            )
+        return self._opt_probe(self.state)
+
     def _install_preemption_handler(self) -> None:
         """SIGTERM/SIGINT → finish the in-flight step, checkpoint, exit
         cleanly.  TPU pods get preempted; the reference's answer is losing
@@ -1235,6 +1283,12 @@ class Trainer:
                         # same cursor (or the same halt)
                         rewind_cursor = self._handle_rewind(step, epoch, pos)
                         break
+                    # cadenced optimizer-apply wall sample (budget layer:
+                    # optimizer_apply_ms in the step_budget account) —
+                    # runs AFTER the window closed, alongside ckpt/eval,
+                    # so mark_step_start below excludes its wall from the
+                    # next step's duration like theirs
+                    obs.optimizer_probe(step, self._optimizer_probe_output)
                     if self.checkpointer.should_save(step):
                         with obs.checkpoint_span():
                             self._save_checkpoint(step, epoch=epoch, pos=pos)

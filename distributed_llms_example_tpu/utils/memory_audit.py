@@ -147,6 +147,7 @@ def aot_compile_train_step(
     remat: bool = True,
     remat_policy: str = "full",
     grad_accum_steps: int = 1,
+    optim_impl: str = "",
 ):
     """AOT-lower and compile the sharded train step from abstract args
     (no parameter is ever materialized).  Returns ``(compiled, lm,
@@ -171,6 +172,14 @@ def aot_compile_train_step(
     a_batch = {
         k: jax.ShapeDtypeStruct(v, jnp.int32, sharding=bsh) for k, v in shapes.items()
     }
+    optim_spec = None
+    if optim_impl:
+        # rebuild the SAME chain with its spec so the compiled program
+        # runs the requested --optim-impl apply (the IR lint proves the
+        # fused in-place/once-per-step contracts on this program)
+        from distributed_llms_example_tpu.train.optim import make_optimizer_bundle
+
+        tx, schedule, optim_spec = make_optimizer_bundle(total_steps=1000)
     build = make_train_step(
         lm.module,
         lm.config,
@@ -179,6 +188,8 @@ def aot_compile_train_step(
         mesh,
         grad_accum_steps=grad_accum_steps,
         is_seq2seq=lm.is_seq2seq,
+        optim_spec=optim_spec,
+        optim_impl=optim_impl or None,
     )
     step_fn, _ = build(a_state)
     with activation_mesh(mesh):

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Tier-1-adjacent dispatch-efficiency gate over a run's obs JSONL.
+
+Thin, pinned-flags wrapper around ``obs.report --strict
+--min-dispatch-efficiency`` so CI (and the bench driver) gate the
+trainer-loop dispatch efficiency with ONE command whose floor is
+recorded here instead of re-typed per pipeline:
+
+    python scripts/obs_gate.py <output_dir> [--min-dispatch-efficiency 0.90]
+
+Exit 0 when the run's wall-weighted ``dispatch_efficiency`` (from its
+``step_budget`` events) meets the floor AND the report is otherwise
+strict-clean (valid schema, no organic faults); nonzero otherwise —
+including when NO step_budget records exist (a missing measurement must
+never read as a pass).  The default floor 0.90 is the ROADMAP
+trainer-loop attack's bar rounded down one notch: ``vs_synthetic_step
+>= 0.95`` needs the host-stall share (1 − efficiency) under ~10% on the
+measured configs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+DEFAULT_FLOOR = 0.90
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/obs_gate.py", description=__doc__
+    )
+    p.add_argument("output_dir", help="a run's --output-dir (containing obs/)")
+    p.add_argument(
+        "--min-dispatch-efficiency", type=float, default=DEFAULT_FLOOR,
+        help=f"wall-weighted dispatch_efficiency floor (default {DEFAULT_FLOOR})",
+    )
+    args = p.parse_args(argv)
+    from distributed_llms_example_tpu.obs.report import main as report_main
+
+    return report_main([
+        args.output_dir,
+        "--strict",
+        "--min-dispatch-efficiency", str(args.min_dispatch_efficiency),
+        "--json",
+    ])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,16 @@ Seven rules, all born from real regressions at TPU scale:
    ``obs/trace.py``; everyone else emits spans through the span recorder
    and lets the exporter render them.
 
+8. **No raw optimizer apply in models/ and train/ outside
+   ``train/optim.py``.**  ``optax.apply_updates`` (or a hand-rolled
+   ``p - lr*u`` tree-map) anywhere else bypasses the ``--optim-impl``
+   dispatch in ``optimizer_apply_block``: the call site would silently
+   miss the fused Pallas clip+AdamW path, its update would not ride the
+   in-place/aliasing contract the IR census checks, and the fused-vs-xla
+   bit-equivalence pin would no longer cover it — the optimizer twin of
+   rules 5/5a.  The apply is owned by ``train.optim.optimizer_update``
+   (xla impl) and ``fused_optimizer_apply`` (fused impl).
+
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
 tests/test_health.py) next to the analysis-CLI smoke run.
@@ -178,6 +188,74 @@ _MANAGER_NAMES = ("manager", "_manager", "checkpoint_manager", "ckpt_manager")
 # exporter — a second producer means a second clock epoch and no
 # cross-rank alignment.
 TRACE_OWNER = os.path.join(PACKAGE, "obs", "trace.py")
+
+# Rule 8: the optimizer apply is owned by train/optim.py — raw
+# optax.apply_updates / manual p - lr*u tree-maps elsewhere in models/
+# and train/ bypass the --optim-impl dispatch (fused Pallas apply,
+# in-place contract, bit-equivalence pin).
+OPTIM_RULE_DIRS = DROPOUT_RULE_DIRS
+OPTIM_OWNER = os.path.join(PACKAGE, "train", "optim.py")
+_LR_NAMES = ("lr", "learning_rate", "step_size")
+
+
+def _names_contain_lr(node: ast.AST) -> bool:
+    return any(
+        any(t == name or name.endswith("_" + t) or name.startswith(t + "_")
+            for t in _LR_NAMES)
+        for name in _names_in(node)
+    )
+
+
+def _optim_apply_violations(tree: ast.AST, rel: str) -> list[str]:
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and (
+                (isinstance(node.func, ast.Attribute) and node.func.attr == "apply_updates")
+                or (isinstance(node.func, ast.Name) and node.func.id == "apply_updates")
+            )
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: raw apply_updates(...) outside "
+                "train/optim.py bypasses the --optim-impl dispatch (fused "
+                "Pallas clip+AdamW, in-place aliasing, bit-equivalence pin) "
+                "— route through train.optim.optimizer_update / "
+                "optimizer_apply_block"
+            )
+        elif (
+            isinstance(node, ast.Call)
+            and (
+                (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("map", "tree_map", "tree_multimap")
+                )
+                or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("tree_map", "tree_multimap")
+                )
+            )
+            and node.args
+            and isinstance(node.args[0], ast.Lambda)
+            and any(
+                isinstance(n, ast.BinOp)
+                and isinstance(n.op, (ast.Sub, ast.Add))
+                and any(
+                    isinstance(side, ast.BinOp)
+                    and isinstance(side.op, ast.Mult)
+                    and _names_contain_lr(side)
+                    for side in (n.left, n.right)
+                )
+                for n in ast.walk(node.args[0].body)
+            )
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: manual 'p - lr*u' tree-map optimizer "
+                "apply outside train/optim.py — a hand-rolled update skips "
+                "clip/AdamW/health AND the --optim-impl dispatch; use "
+                "optimizer_apply_block (train/step.py)"
+            )
+    return violations
 
 
 def _trace_emit_violations(tree: ast.AST, rel: str) -> list[str]:
@@ -377,6 +455,10 @@ def lint_file(path: str, rel: str) -> list[str]:
         rel.startswith(d + os.sep) for d in GRAD_ACCUM_RULE_DIRS
     ):
         violations.extend(_grad_accum_violations(tree, rel))
+    if rel != OPTIM_OWNER and any(
+        rel.startswith(d + os.sep) for d in OPTIM_RULE_DIRS
+    ):
+        violations.extend(_optim_apply_violations(tree, rel))
     if rel != CKPT_OWNER:
         violations.extend(_ckpt_manager_violations(tree, rel))
     if rel != TRACE_OWNER:

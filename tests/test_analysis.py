@@ -812,3 +812,118 @@ def test_repo_lint_chrome_trace_rule(tmp_path):
     assert repo_lint.lint_file(str(bad), rel) == []
     # and the repo stays clean under the new rule
     assert repo_lint.main([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer apply (ISSUE 10): the moment-mirror spec lint, the
+# fp32-param-copy census extension, repo-lint rule 8
+# ---------------------------------------------------------------------------
+
+
+def test_spec_lint_optimizer_moment_mirror_clean_and_catches_anchor():
+    """The adam moments resolve to the param specs under the stock rules
+    (their paths END with the param path and the regexes are unanchored);
+    an anchored rule that matches the param but not its moment path is
+    exactly the drift this pass exists to catch."""
+    from distributed_llms_example_tpu.analysis.spec_lint import (
+        lint_optimizer_moment_mirror,
+    )
+    from distributed_llms_example_tpu.parallel.sharding import ShardingRules
+
+    a_params = _abstract_llama_params()
+    assert lint_optimizer_moment_mirror(a_params) == []
+
+    anchored = ShardingRules(rules=[(r"^block_0/self_attn", P("fsdp", "tensor"))])
+    findings = lint_optimizer_moment_mirror(a_params, anchored)
+    assert findings and all(f.severity == "error" for f in findings)
+    assert {f.code for f in findings} == {"optimizer-moment-spec-mismatch"}
+    assert any("mu" in f.message for f in findings)
+
+
+def test_ir_census_counts_fp32_param_copies():
+    """The in-place contract extension: span-attributed f32 copy
+    instructions whose element count matches a param leaf are counted
+    (and the finding fires) only when param_elems is supplied — the
+    legacy census dict shape is untouched otherwise."""
+    from distributed_llms_example_tpu.analysis.ir_lint import (
+        in_place_apply_finding,
+        once_per_step_placement,
+    )
+    from distributed_llms_example_tpu.train.step import once_per_step_source_spans
+
+    spans = once_per_step_source_spans()
+    f, first, _last = spans[0]
+    meta = f'metadata={{op_name="adamw" source_file="{f}" source_line={first}}}'
+    text = f"""HloModule fixture
+
+ENTRY %main.1 (a.1: f32[128]) -> f32[128] {{
+  %c.1 = f32[128]{{0}} parameter(0)
+  %cp.1 = f32[128]{{0}} copy(f32[128]{{0}} %c.1), {meta}
+  %cp.2 = f32[64]{{0}} copy(f32[64]{{0}} %c.1), {meta}
+  %cp.3 = s32[128]{{0}} copy(s32[128]{{0}} %c.1), {meta}
+  %cp.4 = f32[128]{{0}} copy(f32[128]{{0}} %c.1)
+  %cp.5 = (f32[128]{{0}}, f32[128]{{0}}, u32[]) copy-start(f32[128]{{0}} %c.1), {meta}
+  ROOT %r.1 = f32[128]{{0}} add(f32[128]{{0}} %cp.1, f32[128]{{0}} %cp.1), {meta}
+}}
+"""
+    # legacy shape: no param_elems, no copy keys
+    census = once_per_step_placement(text, spans)
+    assert census == {"total": 5, "in_loop": 0, "in_loop_examples": []}
+    # with param elems: the f32[128] span-attributed copies count — incl.
+    # the ASYNC copy-start tuple form (its largest tuple element is the
+    # copied buffer); the wrong-size (64), wrong-dtype (s32), and
+    # unattributed copies do not
+    census = once_per_step_placement(
+        text, spans, param_elems=[128], min_copy_elems=0
+    )
+    assert census["fp32_param_copies"] == 2
+    assert census["fp32_copy_examples"] == ["main.1:%cp.1", "main.1:%cp.5"]
+    finding = in_place_apply_finding(text, spans, [128], min_copy_elems=0)
+    assert finding is not None and finding.severity == "warning"
+    assert finding.code == "optimizer-param-copy"
+    # no matching copies → no finding
+    assert in_place_apply_finding(text, spans, [999], min_copy_elems=0) is None
+    # the default floor excludes small layout-normalization relayouts:
+    # the same program is clean without the explicit floor override
+    assert in_place_apply_finding(text, spans, [128]) is None
+
+
+def test_repo_lint_optim_apply_rule(tmp_path):
+    """Rule 8: raw apply_updates / manual p - lr*u tree-maps are
+    forbidden in models/ and train/ outside train/optim.py (the
+    --optim-impl dispatch owner)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    repo_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repo_lint)
+
+    bad = tmp_path / "rogue_optim.py"
+    bad.write_text(
+        "import jax, optax\n"
+        "def apply(params, updates, lr, learning_rate):\n"
+        "    p1 = optax.apply_updates(params, updates)\n"          # 1
+        "    p2 = apply_updates(params, updates)\n"                # 2
+        "    p3 = jax.tree.map(lambda p, u: p - lr * u, params, updates)\n"  # 3
+        "    p4 = jax.tree_util.tree_map(\n"                       # 4
+        "        lambda p, u: p + (-learning_rate) * u, params, updates)\n"
+        "    ok = jax.tree.map(lambda a, b: a + b, params, updates)\n"
+        "    return p1, p2, p3, p4, ok\n"
+    )
+    for layer in ("models", "train"):
+        rel = os.path.join("distributed_llms_example_tpu", layer, "rogue_optim.py")
+        violations = repo_lint.lint_file(str(bad), rel)
+        assert len(violations) == 4, (layer, violations)
+        assert sum("apply_updates" in v for v in violations) == 2
+        assert sum("p - lr*u" in v for v in violations) == 2
+    # train/optim.py owns the apply; other layers are out of scope
+    rel = os.path.join("distributed_llms_example_tpu", "train", "optim.py")
+    assert repo_lint.lint_file(str(bad), rel) == []
+    rel = os.path.join("distributed_llms_example_tpu", "serving", "rogue_optim.py")
+    assert repo_lint.lint_file(str(bad), rel) == []
+    # and the live tree stays clean under the new rule
+    assert repo_lint.main([]) == 0

@@ -575,3 +575,155 @@ def test_trace_includes_serving_request_lifecycles(tmp_path):
     p = next(e for e in trace["traceEvents"] if e.get("name") == "req 3 prefill")
     # the queue slice ends where prefill begins
     assert q["ts"] + q["dur"] == pytest.approx(p["ts"], abs=1.0)
+
+
+# ---------------------------------------------------------------------------
+# the cadenced optimizer-apply gauge (ISSUE 10 satellite) + the gate script
+# ---------------------------------------------------------------------------
+
+
+def test_probe_optimizer_gauge_lands_on_account():
+    """probe_optimizer: the first call warms (a lazily-built probe
+    jit-compiles inside fn — a compile is not an apply), subsequent calls
+    time fn and the newest sample rides the next account as
+    optimizer_apply_ms + optimizer_share_of_step."""
+    import jax.numpy as jnp
+
+    clock = FakeClock()
+    rec = SpanRecorder(clock=clock)
+    bud = BudgetAccountant(rec)
+    calls = []
+
+    def fn():
+        # "compile" costs 1.0s, later applies 0.007s — the fake clock
+        # advances inside the timed region exactly like a real block
+        calls.append(1)
+        clock.advance(1.0 if len(calls) == 1 else 0.007)
+        return jnp.zeros(())
+
+    _drive_step(rec, clock, dispatch=0.05, untracked=0.05)
+    bud.probe_optimizer(fn)
+    # warm + timed: two calls, and the SAMPLE is the second (7 ms)
+    assert len(calls) == 2
+    acct = bud.close_window(step=1, emit=False)
+    assert acct["optimizer_apply_ms"] == pytest.approx(7.0)
+    # share: 7 ms of a 100 ms mean step wall
+    assert acct["optimizer_share_of_step"] == pytest.approx(0.07, abs=1e-3)
+    # next window: one timed call only, sample refreshed
+    _drive_step(rec, clock, dispatch=0.05, untracked=0.05)
+    bud.probe_optimizer(fn)
+    assert len(calls) == 3
+    acct = bud.close_window(step=2, emit=False)
+    assert acct["optimizer_apply_ms"] == pytest.approx(7.0)
+
+
+def test_trainer_obs_optimizer_probe_cadence_gated(tmp_path):
+    """TrainerObs.optimizer_probe runs the factory at the log cadence
+    only — off-cadence steps never touch it (zero new syncs)."""
+    import jax.numpy as jnp
+
+    cfg = TrainConfig(output_dir=str(tmp_path), obs="off", obs_budget="on",
+                      log_every_steps=3, health="off")
+    obs = TrainerObs(cfg, start_step=0)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return jnp.zeros(())
+
+    for step in range(1, 7):
+        with obs.step_span():
+            pass
+        obs.optimizer_probe(step, fn)
+        obs.on_step(step, 0, {})
+    # cadence steps 3 and 6: warm+timed at 3, timed at 6
+    assert len(calls) == 3
+    assert obs.budget.history[-1].get("optimizer_apply_ms") is not None
+    sink_mod.current_sink().close()
+
+
+def test_aggregate_accounts_carries_optimizer_gauge():
+    base = {
+        "wall_ms": 100.0, "window_steps": 2, "dispatch_efficiency": 1.0,
+        **{f"{c}_ms": 0.0 for c in COMPONENTS},
+    }
+    a = dict(base, optimizer_apply_ms=10.0, optimizer_share_of_step=0.2)
+    b = dict(base, optimizer_apply_ms=20.0, optimizer_share_of_step=0.4)
+    c = dict(base)  # a window without a sample must not poison the mean
+    agg = aggregate_accounts([a, b, c])
+    assert agg["optimizer_apply_ms"] == pytest.approx(15.0)
+    assert agg["optimizer_share_of_step"] == pytest.approx(0.3)
+    assert "optimizer_apply_ms" not in (aggregate_accounts([c]) or {})
+
+
+def test_obs_gate_script(tmp_path, capsys):
+    """scripts/obs_gate.py: the pinned-flags wrapper fails a run whose
+    wall-weighted dispatch_efficiency sits under the floor, passes one
+    above it, and fails when NO step_budget records exist (a missing
+    measurement is never a pass)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_gate",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "obs_gate.py"),
+    )
+    obs_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_gate)
+
+    good = tmp_path / "good"
+    _write_rank(good, 0, [_budget_event(2, eff=0.97), _budget_event(4, eff=0.95)])
+    assert obs_gate.main([str(good)]) == 0
+
+    bad = tmp_path / "bad"
+    _write_rank(bad, 0, [_budget_event(2, eff=0.5)])
+    assert obs_gate.main([str(bad)]) == 1
+    assert obs_gate.main([str(bad), "--min-dispatch-efficiency", "0.4"]) == 0
+
+    empty = tmp_path / "empty"
+    _write_rank(empty, 0, [_stamp({"step": 1, "loss": 1.0})])
+    assert obs_gate.main([str(empty)]) == 1
+    capsys.readouterr()
+
+
+def test_report_renders_optimizer_gauge(tmp_path):
+    ev = _budget_event(2)
+    ev["optimizer_apply_ms"] = 12.5
+    ev["optimizer_share_of_step"] = 0.05
+    _write_rank(tmp_path, 0, [ev])
+    report = build_report(str(tmp_path))
+    assert report["budget"]["ranks"]["0"]["optimizer_apply_ms"] == pytest.approx(12.5)
+    md = render_markdown(report)
+    assert "optimizer apply (cadenced stand-alone sample)" in md
+    # absent gauge → no line (and no crash)
+    plain = tmp_path / "plain"
+    _write_rank(plain, 0, [_budget_event(2)])
+    assert "optimizer apply (cadenced" not in render_markdown(build_report(str(plain)))
+
+
+def test_probe_optimizer_failure_disables_gauge_not_run(capsys):
+    """A failing probe (OOM compiling the stand-alone apply, transient
+    backend error) must disable the gauge with one logged event — never
+    propagate into the training loop — and a failed WARM call must not
+    leave a later compile mislabeled as the timed sample."""
+    import jax.numpy as jnp
+
+    clock = FakeClock()
+    rec = SpanRecorder(clock=clock)
+    bud = BudgetAccountant(rec)
+    calls = []
+
+    def failing_then_fine():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: probe compile OOM")
+        clock.advance(0.007)
+        return jnp.zeros(())
+
+    _drive_step(rec, clock, dispatch=0.05, untracked=0.05)
+    bud.probe_optimizer(failing_then_fine)  # swallowed, probe disabled
+    assert len(calls) == 1
+    bud.probe_optimizer(failing_then_fine)  # dead: never calls fn again
+    assert len(calls) == 1
+    acct = bud.close_window(step=1, emit=False)
+    assert "optimizer_apply_ms" not in acct
+    assert "optimizer_probe_disabled" in capsys.readouterr().out

@@ -1,0 +1,657 @@
+"""Fused Pallas clip+AdamW apply (ops/fused_optim.py, --optim-impl).
+
+The contract under test: given identical (params, opt_state, grads), the
+fused apply reproduces the optax chain EXACTLY up to XLA's float
+contraction — the op sequence is identical, so every element matches
+bit-for-bit except where the backend fuses a multiply-add into an FMA in
+one compilation and not the other (measured: ≤1 element per few
+thousand, ≤1 intermediate ulp, amplified only through cancellation in
+``p + u``).  The tests therefore pin floats with
+``assert_array_max_ulp`` at single-digit-ulp bounds, and pin EXACTLY:
+the opt-state pytree structure (byte-for-byte optax's — checkpoints
+roam between impls), integer counts, and every within-one-program
+comparison (donation on/off, checkpoint-vs-no-checkpoint), where no
+recompilation exists to re-roll the contraction dice.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_llms_example_tpu.core.config import MeshConfig
+from distributed_llms_example_tpu.core.mesh import build_mesh
+from distributed_llms_example_tpu.data.batching import LABEL_PAD
+from distributed_llms_example_tpu.models.registry import load_model
+from distributed_llms_example_tpu.ops.fused_optim import (
+    STAT_NONFINITE,
+    STAT_P_SUMSQ,
+    STAT_U_SUMSQ,
+    adamw_leaf_reference,
+    default_impl,
+    fused_adamw_leaf,
+    fused_adamw_supported,
+    resolve_impl,
+    set_default_impl,
+)
+from distributed_llms_example_tpu.parallel.sharding import shard_params
+from distributed_llms_example_tpu.train.optim import (
+    build_fused_plan,
+    fused_optimizer_apply,
+    make_optimizer_bundle,
+    optimizer_update,
+    parse_adamw_state,
+    rebuild_adamw_state,
+)
+from distributed_llms_example_tpu.train.step import (
+    create_train_state,
+    make_train_step,
+    optimizer_apply_block,
+    put_batch,
+    state_shardings,
+)
+
+
+def _toy_batch(b=8, src=16, tgt=8, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    input_ids = rng.randint(2, vocab, (b, src)).astype(np.int32)
+    attn = np.ones((b, src), np.int32)
+    labels = rng.randint(2, vocab, (b, tgt)).astype(np.int32)
+    labels[:, -2:] = LABEL_PAD
+    return {"input_ids": input_ids, "attention_mask": attn, "labels": labels}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    lm = load_model("t5-test")
+    params = jax.device_get(lm.init_params(0))
+    return lm, params
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return build_mesh(
+        MeshConfig(data=1, fsdp=1, sequence=1, tensor=1), devices=jax.devices()[:1]
+    )
+
+
+def _sharded_state(params, tx, mesh):
+    state = create_train_state(shard_params(params, mesh), tx)
+    sh = state_shardings(state, mesh)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh), sh
+
+
+def _synthetic_grads(params, sh=None, scale=0.05):
+    rng = np.random.RandomState(7)
+    g = jax.tree.map(
+        lambda p: jnp.asarray(rng.randn(*p.shape), jnp.float32) * scale, params
+    )
+    if sh is not None:
+        g = jax.tree.map(lambda x, s: jax.device_put(x, s), g, sh.params)
+    return g
+
+
+def _plan(spec, tx, sh, mesh, params):
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+    )
+    plan = build_fused_plan(spec, tx, sh, mesh, abstract_params=abstract)
+    assert plan is not None
+    return plan
+
+
+def _assert_trees_bit_equal(a, b, what=""):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb), err_msg=what)
+
+
+def _assert_trees_equal_mod_fma(a, b, what="", atol=2e-7, rtol=1e-6):
+    """Exact for integer leaves; floats within the residue XLA's
+    per-compilation FMA contraction can leave between two runs of the
+    identical op sequence: a 1-ulp intermediate difference amplified
+    through Adam's divide-by-sqrt and the ``p + (-lr·u)`` cancellation
+    stays under ~lr·1e-4 absolute (measured 6e-8 at lr=1e-3)."""
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        la, lb = np.asarray(la), np.asarray(lb)
+        if np.issubdtype(la.dtype, np.integer):
+            np.testing.assert_array_equal(la, lb, err_msg=what)
+        else:
+            np.testing.assert_allclose(la, lb, atol=atol, rtol=rtol, err_msg=what)
+
+
+# ---------------------------------------------------------------- impl knob
+
+
+def test_resolve_impl_and_default_knob():
+    assert resolve_impl("xla") == "xla"
+    assert resolve_impl("fused") == "fused"
+    # auto on this (CPU) suite resolves to the optax chain
+    assert resolve_impl("auto") == "xla"
+    assert resolve_impl("auto", backend="tpu") == "fused"
+    prev = default_impl()
+    try:
+        set_default_impl("fused")
+        assert resolve_impl(None) == "fused"
+    finally:
+        set_default_impl(prev)
+    with pytest.raises(ValueError, match="optim impl"):
+        set_default_impl("nope")
+    with pytest.raises(ValueError, match="optim impl"):
+        resolve_impl("nope")
+
+
+def test_fused_supported_gate():
+    assert fused_adamw_supported(16 * 256)  # flattens to 8-aligned x 128k
+    assert fused_adamw_supported(1024)
+    assert not fused_adamw_supported(64)  # sub-tile leaf (norm scale)
+    assert not fused_adamw_supported(1000)  # not a multiple of 8*128
+    assert not fused_adamw_supported(1024, dtype=jnp.bfloat16)  # f32 only
+
+
+# ------------------------------------------------- kernel vs reference leaf
+
+
+@pytest.mark.parametrize("trigger", [0.0, 1.0])
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_kernel_leaf_bit_equal_vs_reference(trigger, wd):
+    """The Pallas kernel (interpret mode) reproduces the jnp reference
+    leaf bit-for-bit for both clip branches and both decay settings, and
+    its health partial sums match the reference's reductions."""
+    rng = np.random.RandomState(0)
+    shape = (16, 256)
+    p = jnp.asarray(rng.randn(*shape), jnp.float32)
+    mu = jnp.asarray(rng.randn(*shape) * 0.01, jnp.float32)
+    nu = jnp.asarray(np.abs(rng.randn(*shape)) * 1e-3, jnp.float32)
+    g = jnp.asarray(rng.randn(*shape), jnp.float32)
+    # scalars as the tree apply computes them (gnorm/bias corrections/lr)
+    scal = jnp.asarray(
+        [3.7, trigger, 0.1, 0.001, -1e-3, 0.0, 0.0, 0.0], jnp.float32
+    )
+    hyper = dict(b1=0.9, b2=0.999, eps=1e-8, max_norm=1.0, wd=wd)
+    k = jax.jit(
+        lambda *a: fused_adamw_leaf(*a, interpret=True, **hyper)
+    )(p, mu, nu, g, scal)
+    r = jax.jit(lambda *a: adamw_leaf_reference(*a, **hyper))(p, mu, nu, g, scal)
+    for i, name in enumerate(("params", "mu", "nu")):
+        _assert_trees_equal_mod_fma(k[i], r[i], what=name)
+    # stats: sums over different tile orders — equal to float tolerance
+    np.testing.assert_allclose(
+        np.asarray(k[3][:3]), np.asarray(r[3][:3]), rtol=1e-6
+    )
+
+
+def test_kernel_counts_nonfinite():
+    shape = (8, 128)
+    p = jnp.ones(shape, jnp.float32)
+    mu = jnp.zeros(shape, jnp.float32)
+    nu = jnp.zeros(shape, jnp.float32)
+    g = jnp.ones(shape, jnp.float32).at[0, 0].set(jnp.nan).at[1, 1].set(jnp.inf)
+    scal = jnp.asarray([1.0, 1.0, 0.1, 0.001, -1e-3, 0, 0, 0], jnp.float32)
+    out = fused_adamw_leaf(
+        p, mu, nu, g, scal, b1=0.9, b2=0.999, eps=1e-8, max_norm=0.0, wd=0.0,
+        interpret=True,
+    )
+    assert float(out[3][STAT_NONFINITE]) == 2.0
+    assert float(out[3][STAT_P_SUMSQ]) == float(np.prod(shape))
+    # non-finite grads poison the update itself — its sumsq goes NaN, and
+    # the watchdog's tripwire reads the COUNT, which stays exact
+    assert not np.isfinite(float(out[3][STAT_U_SUMSQ]))
+    # the reference path must count the PRE-clip stream too: with clip ON
+    # a NaN gradient makes the global norm NaN and the clip branch
+    # NaN-floods the whole leaf — counting post-clip would report
+    # leaf-size instead of the true 2 (the tripwire's only signal)
+    nan_scal = jnp.asarray(
+        [jnp.nan, 0.0, 0.1, 0.001, -1e-3, 0, 0, 0], jnp.float32
+    )
+    for fn in (fused_adamw_leaf, adamw_leaf_reference):
+        kw = {"interpret": True} if fn is fused_adamw_leaf else {}
+        r = fn(p, mu, nu, g, nan_scal, b1=0.9, b2=0.999, eps=1e-8,
+               max_norm=1.0, wd=0.0, **kw)
+        assert float(r[3][STAT_NONFINITE]) == 2.0, fn.__name__
+
+
+# ----------------------------------------- tree apply vs the optax chain
+
+
+def test_apply_bit_equal_vs_optax_single_device(setup, mesh1):
+    """Identical (params, opt_state, grads) → the fused tree apply and
+    the optax chain produce bit-equal params and opt_state (and the same
+    grad-norm scalar) — kernel leaves and jnp-fallback leaves alike."""
+    lm, params = setup
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    state, sh = _sharded_state(params, tx, mesh1)
+    grads = _synthetic_grads(state.params, sh)
+    plan = _plan(spec, tx, sh, mesh1, state.params)
+
+    def apply_xla(state, grads):
+        new_p, new_opt, _u = optimizer_update(tx, grads, state.opt_state, state.params)
+        return new_p, new_opt, optax.global_norm(grads)
+
+    def apply_fused(state, grads):
+        new_p, new_opt, gnorm, _stats = fused_optimizer_apply(
+            plan, schedule, state.params, state.opt_state, grads
+        )
+        return new_p, new_opt, gnorm
+
+    ax = jax.jit(apply_xla)(state, grads)
+    af = jax.jit(apply_fused)(state, grads)
+    _assert_trees_equal_mod_fma(ax[0], af[0], "params")
+    _assert_trees_equal_mod_fma(ax[1], af[1], "opt_state")
+    assert float(ax[2]) == float(af[2])
+    # the rebuilt opt_state is the SAME optax pytree, not a private format
+    assert jax.tree_util.tree_structure(ax[1]) == jax.tree_util.tree_structure(af[1])
+
+
+def test_apply_bit_equal_on_mesh8(setup, mesh8):
+    """The per-shard shard_map kernel path (8-device mesh, fsdp+tensor
+    sharded leaves) stays bit-equal to the optax chain — the elementwise
+    update is shard-local and the two-stage grad-norm psum matches
+    GSPMD's reduction for the chain."""
+    lm, params = setup
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    state, sh = _sharded_state(params, tx, mesh8)
+    grads = _synthetic_grads(state.params, sh)
+    plan = _plan(spec, tx, sh, mesh8, state.params)
+    from distributed_llms_example_tpu.parallel.activation import activation_mesh
+
+    ax = jax.jit(
+        lambda s, g: optimizer_update(tx, g, s.opt_state, s.params)[:2]
+    )(state, grads)
+    with activation_mesh(mesh8):
+        af = jax.jit(
+            lambda s, g: fused_optimizer_apply(
+                plan, schedule, s.params, s.opt_state, g
+            )[:2]
+        )(state, grads)
+    _assert_trees_equal_mod_fma(ax[0], af[0], "params")
+    _assert_trees_equal_mod_fma(ax[1], af[1], "opt_state")
+
+
+def test_one_program_step_bit_equal_with_accum(setup, mesh1):
+    """The strongest cross-impl pin: ONE compiled program computes the
+    grad-accumulation scan once (accum=2, uneven token counts) and feeds
+    the identical sums to BOTH optimizer_apply_block impls — outputs are
+    bit-equal, so the fused apply transitively satisfies every oracle
+    the xla path is pinned against (optax.MultiSteps, PR 5)."""
+    from distributed_llms_example_tpu.train.step import make_loss_fn
+
+    lm, params = setup
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    state, sh = _sharded_state(params, tx, mesh1)
+    plan = _plan(spec, tx, sh, mesh1, state.params)
+    loss_sums = make_loss_fn(lm.module, lm.config, 0.0, is_seq2seq=True)
+    batch = _toy_batch(b=8)
+    batch["labels"][0:2, 3:] = LABEL_PAD  # uneven tokens across microbatches
+    N = 2
+
+    def both(state, batch):
+        micro = jax.tree.map(
+            lambda x: jnp.swapaxes(
+                x.reshape(x.shape[0] // N, N, *x.shape[1:]), 0, 1
+            ),
+            batch,
+        )
+
+        def body(carry, mb):
+            lsum_a, tok_a, g_a = carry
+            (lsum, tokens), g = jax.value_and_grad(
+                lambda p: loss_sums(p, mb, None), has_aux=True
+            )(state.params)
+            g_a = jax.tree.map(lambda a, gg: a + gg.astype(jnp.float32), g_a, g)
+            return (lsum_a + lsum, tok_a + tokens, g_a), None
+
+        zero = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (lsum, tokens, grads), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zero), micro
+        )
+        s_x, m_x = optimizer_apply_block(
+            state, tx, schedule, lsum, tokens, grads, health=False
+        )
+        s_f, m_f = optimizer_apply_block(
+            state, tx, schedule, lsum, tokens, grads, health=False, fused=plan
+        )
+        return s_x, s_f, m_x, m_f
+
+    s_x, s_f, m_x, m_f = jax.jit(both)(state, put_batch(batch, mesh1))
+    _assert_trees_equal_mod_fma(s_x.params, s_f.params, "params")
+    _assert_trees_equal_mod_fma(s_x.opt_state, s_f.opt_state, "opt_state")
+    assert float(m_x["loss"]) == float(m_f["loss"])
+    assert float(m_x["grad_norm"]) == float(m_f["grad_norm"])
+    assert int(jax.device_get(s_f.step)) == 1
+
+
+def test_state_parse_and_rebuild_roundtrip(setup):
+    """parse/rebuild preserve the optax chain's pytree structure exactly
+    and advance every count by one — the layout contract checkpoints
+    depend on."""
+    lm, params = setup
+    tx, _, _ = make_optimizer_bundle()
+    st = tx.init(params)
+    adam, scheds = parse_adamw_state(st)
+    assert int(adam.count) == 0 and len(scheds) == 1
+    new_adam = optax.ScaleByAdamState(
+        count=adam.count + 1, mu=adam.mu, nu=adam.nu
+    )
+    rebuilt = rebuild_adamw_state(st, new_adam)
+    assert jax.tree_util.tree_structure(rebuilt) == jax.tree_util.tree_structure(st)
+    adam2, scheds2 = parse_adamw_state(rebuilt)
+    assert int(adam2.count) == 1 and int(scheds2[0].count) == 1
+    # a non-adamw chain is refused (callers fall back to xla)
+    with pytest.raises(ValueError, match="ScaleByAdamState"):
+        parse_adamw_state(optax.sgd(1e-2).init(params))
+
+
+def test_build_fused_plan_falls_back_on_foreign_chain(setup, mesh1, capsys):
+    """An opt chain the fused path cannot parse (plain SGD) yields None
+    (with a logged reason) instead of a trace-time crash — the step then
+    runs the xla impl."""
+    lm, params = setup
+    _, _, spec = make_optimizer_bundle()
+    tx = optax.sgd(1e-2)
+    state = create_train_state(shard_params(params, mesh1), tx)
+    sh = state_shardings(state, mesh1)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state.params
+    )
+    plan = build_fused_plan(spec, tx, sh, mesh1, abstract_params=abstract)
+    assert plan is None
+    assert "fused_optim_fallback" in capsys.readouterr().out
+
+
+# ------------------------------------------------- full train-step coverage
+
+
+def test_full_step_fused_runs_and_matches_loss(setup, mesh8):
+    """--optim-impl fused through the real make_train_step on the 8-device
+    mesh: the forward is untouched (loss bit-equal to the xla step), the
+    trajectory stays within ulp-accumulation distance (separately
+    compiled programs may fuse the backward differently — the one-program
+    test above pins the apply math bitwise), and the state's step counter
+    advances once per step."""
+    lm, params = setup
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    batch = _toy_batch()
+    outs = {}
+    for impl in ("xla", "fused"):
+        build = make_train_step(
+            lm.module, lm.config, tx, schedule, mesh8, donate=False,
+            optim_spec=spec, optim_impl=impl,
+        )
+        state, sh = _sharded_state(params, tx, mesh8)
+        step, _ = build(state)
+        gb = put_batch(batch, mesh8)
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, gb)
+            losses.append(float(metrics["loss"]))
+        outs[impl] = (losses, jax.device_get(state.params))
+    # first-step loss depends only on the (identical) forward
+    assert outs["xla"][0][0] == outs["fused"][0][0]
+    assert outs["fused"][0][-1] < outs["fused"][0][0]
+    for a, b in zip(jax.tree.leaves(outs["xla"][1]), jax.tree.leaves(outs["fused"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.slow  # two extra full-step compiles (donate on/off): slow tier
+def test_fused_step_donation_safe(setup, mesh8):
+    """donate=True with the fused in-place apply must not corrupt the
+    trajectory: a 3-step donated run equals the non-donated one exactly
+    (buffer aliasing is a memory optimization, never a value change)."""
+    lm, params = setup
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    batch = _toy_batch()
+    trajectories = {}
+    for donate in (False, True):
+        build = make_train_step(
+            lm.module, lm.config, tx, schedule, mesh8, donate=donate,
+            grad_accum_steps=2, optim_spec=spec, optim_impl="fused",
+        )
+        state, _ = _sharded_state(params, tx, mesh8)
+        step, _ = build(state)
+        gb = put_batch(batch, mesh8)
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, gb)
+            losses.append(float(metrics["loss"]))
+        trajectories[donate] = (losses, jax.device_get(state.params))
+    l_no, p_no = trajectories[False]
+    l_yes, p_yes = trajectories[True]
+    assert l_yes == l_no
+    _assert_trees_bit_equal(p_no, p_yes, "donated params")
+
+
+@pytest.mark.slow  # a health-enabled fused compile: slow tier
+def test_fused_health_from_kernel_stats(setup, mesh8):
+    """health=True under the fused impl sources the numerics from the
+    kernel's partial sums: same keys, values matching the xla health
+    bundle to reduction-order tolerance, nonfinite exact."""
+    from distributed_llms_example_tpu.train.step import HEALTH_METRIC_KEYS
+
+    lm, params = setup
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    batch = _toy_batch()
+    metrics_by_impl = {}
+    for impl in ("xla", "fused"):
+        build = make_train_step(
+            lm.module, lm.config, tx, schedule, mesh8, donate=False,
+            health=True, optim_spec=spec, optim_impl=impl,
+        )
+        state, _ = _sharded_state(params, tx, mesh8)
+        step, _ = build(state)
+        _, metrics = step(state, put_batch(batch, mesh8))
+        metrics_by_impl[impl] = {k: float(metrics[k]) for k in HEALTH_METRIC_KEYS}
+    mx, mf = metrics_by_impl["xla"], metrics_by_impl["fused"]
+    assert mf["nonfinite_count"] == 0.0 == mx["nonfinite_count"]
+    for k in HEALTH_METRIC_KEYS:
+        np.testing.assert_allclose(mf[k], mx[k], rtol=1e-4, atol=1e-9, err_msg=k)
+
+
+@pytest.mark.slow  # two step compiles + orbax round-trips: slow tier
+def test_checkpoint_roundtrip_across_impls(setup, mesh8, tmp_path):
+    """The satellite pin: a checkpoint SAVED under --optim-impl fused
+    restores and continues under xla (and vice versa) with a trajectory
+    BIT-EQUAL to the same impl switch without any checkpoint — the fused
+    kernel's mu/nu ride the standard optax pytree, so the save/restore
+    is a pure pass-through, not a format translation."""
+    from distributed_llms_example_tpu.io.checkpoint import Checkpointer, abstract_like
+
+    lm, params = setup
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    batch = _toy_batch()
+    steps = {}
+    for impl in ("fused", "xla"):
+        build = make_train_step(
+            lm.module, lm.config, tx, schedule, mesh8, donate=False,
+            optim_spec=spec, optim_impl=impl,
+        )
+        state, sh = _sharded_state(params, tx, mesh8)
+        steps[impl] = (build(state)[0], sh)
+    gb = put_batch(batch, mesh8)
+
+    for first, then in (("fused", "xla"), ("xla", "fused")):
+        # reference: impl switch mid-run, no checkpoint
+        state, sh = _sharded_state(params, tx, mesh8)
+        for _ in range(2):
+            state, _m = steps[first][0](state, gb)
+        mid_ref = state
+        for _ in range(2):
+            state, _m = steps[then][0](state, gb)
+        ref = jax.device_get(state)
+
+        # the same switch THROUGH a checkpoint
+        state, sh = _sharded_state(params, tx, mesh8)
+        for _ in range(2):
+            state, _m = steps[first][0](state, gb)
+        ckpt = Checkpointer(
+            str(tmp_path / f"ckpt-{first}"), save_every_steps=1, async_save=False
+        )
+        assert ckpt.save(2, state, force=True)
+        ckpt.wait()
+        restored = ckpt.restore_latest(abstract_like(state, sh))
+        assert restored is not None
+        state, step_no = restored
+        assert step_no == 2
+        _assert_trees_bit_equal(state, mid_ref, "restored state")
+        for _ in range(2):
+            state, _m = steps[then][0](state, gb)
+        got = jax.device_get(state)
+        _assert_trees_bit_equal(ref.params, got.params, f"{first}->{then} params")
+        _assert_trees_bit_equal(
+            ref.opt_state, got.opt_state, f"{first}->{then} opt_state"
+        )
+
+
+# ----------------------------------------------------- composition / spans
+
+
+def test_composition_row_fused_optim_pipelined():
+    from distributed_llms_example_tpu.analysis.composition import (
+        config_flags,
+        failing_combos,
+        validate_composition,
+    )
+
+    # auto NEVER sets the flag (it resolves to xla under a pipeline)
+    assert "fused_optim" not in config_flags(pipelined=True, optim_impl="auto")
+    flags = config_flags(pipelined=True, optim_impl="fused")
+    assert "fused_optim" in flags
+    bad = failing_combos(
+        family="llama", schedule="gpipe",
+        mesh_axes={"stage": 2, "data": 4}, flags=flags,
+    )
+    assert any(row.id == "fused-optim-pipelined" for row in bad)
+    with pytest.raises(ValueError, match="optim-impl fused"):
+        validate_composition(
+            family="llama", schedule="gpipe",
+            mesh_axes={"stage": 2, "data": 4}, flags=flags,
+        )
+    # without a pipeline the combo is clean
+    assert not failing_combos(
+        family="llama", mesh_axes={"data": 8},
+        flags=config_flags(pipelined=False, optim_impl="fused"),
+    )
+
+
+def test_once_per_step_spans_cover_fused_layer():
+    """The IR census's source spans include the fused-apply layer, so the
+    once-per-step placement proof keeps working when --optim-impl fused
+    moves the apply's instructions into ops/fused_optim.py frames."""
+    from distributed_llms_example_tpu.train.step import once_per_step_source_spans
+
+    spans = once_per_step_source_spans()
+    files = {f for f, _a, _b in spans}
+    assert any(f.endswith("ops/fused_optim.py") for f in files)
+    assert any(f.endswith("train/optim.py") for f in files)
+    assert any(f.endswith("train/step.py") for f in files)
+
+
+@pytest.mark.slow  # an AOT fsdp=8 fused-step compile + HLO text scan: slow tier
+def test_fused_step_once_per_step_and_in_place_on_compiled_hlo(setup):
+    """The two compiled-program contracts for --optim-impl fused, pinned
+    on a pure-FSDP accum=2 step's real HLO: (1) the once-per-step census
+    still attributes the apply (now in ops/fused_optim.py frames) and
+    finds NONE of it inside the grad-accumulation scan body; (2) the
+    in-place contract — zero span-attributed f32 param-sized copy
+    instructions survive (input_output_aliases did its job)."""
+    import math
+
+    from distributed_llms_example_tpu.analysis.ir_lint import (
+        in_place_apply_finding,
+        once_per_step_finding,
+        once_per_step_placement,
+    )
+    from distributed_llms_example_tpu.train.step import once_per_step_source_spans
+
+    lm, params = setup
+    mesh = build_mesh(MeshConfig(data=1, fsdp=8, sequence=1, tensor=1))
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    build = make_train_step(
+        lm.module, lm.config, tx, schedule, mesh, grad_accum_steps=2,
+        donate=False, optim_spec=spec, optim_impl="fused",
+    )
+    state, _sh = _sharded_state(params, tx, mesh)
+    step, _ = build(state)
+    batch = _toy_batch(b=16)
+    text = step.jitted.lower(state, put_batch(batch, mesh)).compile().as_text()
+    spans = once_per_step_source_spans()
+    # the compiled text is the PER-DEVICE program: match shard counts too
+    # (the same candidate expansion lint_train_step applies)
+    from distributed_llms_example_tpu.analysis.ir_lint import (
+        model_tree_element_candidates,
+    )
+
+    elems = model_tree_element_candidates(
+        [int(math.prod(x.shape)) for x in jax.tree.leaves(state.params)], 8
+    )
+    # floor just above the known tiny layout-relayout noise (512-elem
+    # transpose copies on sub-tile fallback leaves) so embedding-scale
+    # copies of this toy model would still be caught; production uses
+    # MIN_COPY_CENSUS_ELEMS, far under any 7B leaf shard
+    census = once_per_step_placement(
+        text, spans, param_elems=elems, min_copy_elems=1024
+    )
+    assert census["total"] > 0, "fused-apply source spans missing from HLO"
+    assert census["in_loop"] == 0, census
+    assert census["fp32_param_copies"] == 0, census["fp32_copy_examples"]
+    assert once_per_step_finding(text, spans) is None
+    assert in_place_apply_finding(text, spans, elems, min_copy_elems=1024) is None
+
+
+def test_ragged_sharded_leaf_falls_back_to_reference(setup, mesh8):
+    """A leaf whose spec'd dim does NOT divide its mesh axes must take
+    the (GSPMD-padded) reference path — the total element count can be
+    kernel-tileable while shard_map would reject the ragged split."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llms_example_tpu.ops.fused_optim import _spec_divides
+
+    # mesh8: data=2, fsdp=2, tensor=2 — dim 0 of 6 over 4 shards is ragged
+    assert not _spec_divides((6, 4096), P(("data", "fsdp")), mesh8)
+    assert _spec_divides((8, 4096), P(("data", "fsdp")), mesh8)
+    assert _spec_divides((6, 4096), P(None, "tensor"), mesh8)
+
+    # end to end: a hand-built tree with one ragged-but-tileable leaf
+    # (6*4096 elems pass fused_adamw_supported) runs through the fused
+    # apply on the mesh without tripping shard_map, matching the chain
+    from distributed_llms_example_tpu.train.optim import FusedOptimPlan
+
+    tx, schedule, spec = make_optimizer_bundle(
+        learning_rate=1e-3, warmup_steps=0, total_steps=100
+    )
+    params = {"w": jnp.asarray(np.random.RandomState(0).randn(6, 4096), jnp.float32)}
+    state = create_train_state(params, tx)
+    grads = {"w": jnp.full((6, 4096), 0.01, jnp.float32)}
+    plan = FusedOptimPlan(
+        spec=spec, mesh=mesh8, param_specs={"w": P(("data", "fsdp"))}
+    )
+    from distributed_llms_example_tpu.parallel.activation import activation_mesh
+
+    with activation_mesh(mesh8):
+        new_p, new_opt, gnorm, _stats = jax.jit(
+            lambda s, g: fused_optimizer_apply(
+                plan, schedule, s.params, s.opt_state, g
+            )
+        )(state, grads)
+    ax = jax.jit(
+        lambda s, g: optimizer_update(tx, g, s.opt_state, s.params)[:2]
+    )(state, grads)
+    _assert_trees_equal_mod_fma(ax[0], new_p, "ragged params")

@@ -434,10 +434,24 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
         return " ".join(words)[:n_chars]
 
     records = [{"dialogue": text(1016), "summary": text(120)} for _ in range(batch * steps)]
+    # device-time attribution: one PROFILED (untimed) pass captures a
+    # 1-step jax.profiler window, parsed into the device_account that is
+    # stamped below — the gauges compile supplies the instruction→bucket
+    # index and the byte account the bandwidth join needs.  "auto" =
+    # accelerators only: the CPU thunk-runtime profiler multiplies a
+    # bench-sized (src 1024) step's wall ~20× and overflows the session
+    # into an EMPTY trace (measured on this container); the CPU parse
+    # path is pinned by tests/test_devprof.py on CLI-sized windows
+    # instead.  BENCH_DEVICE_PROFILE=1 forces it anywhere, 0 disables.
+    dev_profile_env = os.environ.get("BENCH_DEVICE_PROFILE", "auto")
+    dev_profile = dev_profile_env != "0" and (
+        dev_profile_env == "1" or jax.default_backend() != "cpu"
+    )
     with tempfile.TemporaryDirectory() as tmp:
         cfg = TrainConfig(
             model_ckpt=model_name,
             output_dir=tmp,
+            obs_gauges="on" if dev_profile else "auto",
             batch_size=batch,
             num_epochs=1,
             warmup_steps=0,
@@ -542,6 +556,36 @@ def _trainer_loop_bench(model_name: str, n_chips: int, *, remat: bool,
             out["dispatch_efficiency"] = out["budget_prefetch2"][
                 "dispatch_efficiency"
             ]
+        if dev_profile and rbg_ok(dt + 25.0):
+            # one profiled (UNTIMED — the profiler start/stop syncs would
+            # pollute a timed window) pass: touch the trainer's own
+            # profile trigger, let the capture land mid-pass, and read
+            # back the parsed device account (per-bucket device time,
+            # achieved collective bandwidth, overlap) the capture emitted
+            try:
+                trainer.cfg = cfg.replace(prefetch_batches=2)
+                trigger = trainer.obs._trigger
+                os.makedirs(os.path.dirname(trigger), exist_ok=True)
+                with open(trigger, "w") as f:
+                    f.write("1")  # one profiled step bounds the overhead
+                trainer.train_ds.clear_cache()
+                trainer.train()
+                acct = (
+                    trainer.obs.budget.last_device_account
+                    if trainer.obs.budget is not None
+                    else None
+                )
+                if acct is not None:
+                    out["device_account"] = {
+                        k: v for k, v in acct.items()
+                        if k not in ("lanes", "lane_slices_dropped", "event")
+                    }
+                else:
+                    out["device_account"] = {"error": "no capture landed"}
+            except Exception as e:
+                out["device_account"] = {"error": str(e)[:300]}
+            captured_windows.clear()
+            pass_budget()  # drop the profiled pass's accounts
         # adaptive cost estimate for the rbg pass: one warm pass (includes
         # the typed-key retrace — bounded by the compile-inclusive first
         # pass) plus one timed pass
@@ -1167,12 +1211,16 @@ def _serve_measure(
     # static path's pay-max-L-per-row cost is visible
     budgets = [int(b) for b in rng.randint(max(new_tokens // 4, 1), new_tokens + 1, n_req)]
 
+    # the goodput SLO the router tier dispatches on: useful tokens/sec +
+    # attainment at this first-token threshold ride the serve block (and
+    # the serve_summary event) — BENCH_TTFT_SLO_MS overrides per round
+    ttft_slo_ms = float(os.environ.get("BENCH_TTFT_SLO_MS", "500"))
     engine = ServingEngine(
         lm.module, lm.config, mesh,
         ServeConfig(
             max_slots=slots, prefill_batch=slots,
             max_new_tokens=new_tokens, max_source_length=src,
-            log_every_steps=0,
+            log_every_steps=0, ttft_slo_ms=ttft_slo_ms,
         ),
         is_seq2seq=lm.is_seq2seq,
     )
@@ -1285,6 +1333,9 @@ def _serve_measure(
         # queue-wait vs prefill share of TTFT (serving request spans):
         # the explainable-p95 fields the serve_summary event also carries
         **stats.ttft_decomposition(),
+        # goodput at the TTFT SLO (useful tokens/sec + attainment) — the
+        # serve_summary fields the router open item dispatches on
+        **stats.goodput,
         "slot_occupancy": round(stats.slot_occupancy, 4),
         "decode_steps": stats.decode_steps,
         "wall_s": round(serve_s, 2),

@@ -65,6 +65,9 @@ _TUPLE_DEF_RE = re.compile(
 )
 _TUPLE_ELEM_RE = re.compile(r"([a-z]\w*)\[([0-9,]*)\]")
 _OPERAND_RE = re.compile(r"%([\w.\-]+)")
+# jax stamps every lowered instruction with the originating scope path:
+# metadata={op_name="jit(f)/jit(main)/Model/encoder/block_0/self_attn/..."}
+_OP_NAME_RE = re.compile(r'op_name="(?P<op_name>[^"]*)"')
 _REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\s*\{[^}]*\})*)\}")
 _SOURCE_TARGET_RE = re.compile(r"source_target_pairs=\{(\{[^}]*\}(?:,\s*\{[^}]*\})*)\}")
 
@@ -113,19 +116,22 @@ class HloInstr:
     elems: int
     operands: tuple[str, ...]
     line: str
+    op_name: str = ""  # metadata scope path ("" when the text carries none)
 
 
 def parse_hlo_instructions(hlo_text: str) -> dict[str, HloInstr]:
     """Instruction-name → parsed def, for every definition in the text.
 
-    THE one HLO text parser: the lint passes below and the obs collective
-    -traffic account (obs/gauges.py) both consume it, so their byte
-    arithmetic cannot drift."""
+    THE one HLO text parser: the lint passes below, the obs collective
+    -traffic account (obs/gauges.py) and the device-time attribution
+    index (obs/devprof.py via ``op_bucket_index``) all consume it, so
+    their byte/bucket arithmetic cannot drift."""
     out: dict[str, HloInstr] = {}
     for line in hlo_text.splitlines():
         m = _DEF_RE.match(line)
         if m:
             name = m.group("name")
+            meta = _OP_NAME_RE.search(line)
             out[name] = HloInstr(
                 name=name,
                 dtype=m.group("dtype"),
@@ -135,6 +141,7 @@ def parse_hlo_instructions(hlo_text: str) -> dict[str, HloInstr]:
                 elems=_elems_of(m.group("dims")),
                 operands=tuple(_OPERAND_RE.findall(line[m.end():])),
                 line=line,
+                op_name=meta.group("op_name") if meta else "",
             )
             continue
         t = _TUPLE_DEF_RE.match(line)
@@ -145,6 +152,7 @@ def parse_hlo_instructions(hlo_text: str) -> dict[str, HloInstr]:
                 dt, dims = max(elems, key=lambda e: _bytes_of(*e))
             else:
                 dt, dims = "f32", ""
+            meta = _OP_NAME_RE.search(line)
             out[name] = HloInstr(
                 name=name,
                 dtype=dt,
@@ -154,7 +162,98 @@ def parse_hlo_instructions(hlo_text: str) -> dict[str, HloInstr]:
                 elems=_elems_of(dims),
                 operands=tuple(_OPERAND_RE.findall(line[t.end():])),
                 line=line,
+                op_name=meta.group("op_name") if meta else "",
             )
+    return out
+
+
+# --------------------------------------------------------------------------
+# op_name scope → module bucket (shared with train/step.py's bucket_of_path
+# and obs/devprof.py's device-time attribution — ONE name-matching table, so
+# the health telemetry's param buckets and the profiler's device buckets can
+# never disagree on what "attn" means).
+# --------------------------------------------------------------------------
+
+# Ordered: first match wins.  head before embed (an "lm_head" tied to the
+# embedding table must not read as embed), embed before attn/mlp.
+MODULE_BUCKET_PATTERNS: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("head", ("lm_head", "logits")),
+    ("embed", ("embed", "shared", "wte", "wpe")),
+    ("attn", ("attn", "attention")),
+    ("mlp", ("mlp", "ffn", "feed_forward", "densereludense", "fc1", "fc2")),
+)
+
+# scope substrings that mark the optimizer/clip/health tail (optax traces
+# carry no flax module scope, so these name fragments are the signal)
+_OPTIMIZER_SCOPE_HINTS = (
+    "adam", "optax", "optimizer", "opt_state", "fused_optim",
+    "apply_updates", "clip_by_global_norm", "weight_decay",
+)
+
+
+def module_bucket_of(scope: str) -> str | None:
+    """The coarse model-module bucket a scope/path string names, or None
+    when it carries no module signal.  ``train.step.bucket_of_path``
+    (param paths, falls back to "mlp" — a param bucket must be total) and
+    ``obs/devprof`` (device op_name scopes, falls back to "other") both
+    route through this table."""
+    p = scope.lower()
+    for bucket, needles in MODULE_BUCKET_PATTERNS:
+        if any(n in p for n in needles):
+            return bucket
+    return None
+
+
+def classify_op_scope(scope: str) -> str | None:
+    """Device-account class for one HLO ``op_name`` scope: "optimizer"
+    for the clip/AdamW/health tail, else the module bucket, else None
+    (loss arithmetic, layout ops, scan plumbing — "other")."""
+    p = scope.lower()
+    if any(h in p for h in _OPTIMIZER_SCOPE_HINTS):
+        return "optimizer"
+    return module_bucket_of(p)
+
+
+def base_collective_op(op: str) -> str | None:
+    """"all-reduce-start.1" → "all-reduce"; None for non-collectives.
+    Accepts instruction NAMES (trailing ".N" / ".clone" suffixes) as well
+    as opcodes — trace events name device ops by instruction name."""
+    base = op.split(".", 1)[0]
+    for suffix in ("-start", "-done"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+    return base if base in (
+        "all-gather", "all-reduce", "reduce-scatter", "all-to-all",
+        "collective-permute", "collective-broadcast",
+    ) else None
+
+
+# host↔device transfer opcodes — the "infeed" class of the device account
+_INFEED_OPS = ("infeed", "outfeed", "send", "send-done", "recv", "recv-done")
+
+
+def op_bucket_index(
+    hlo: "str | Mapping[str, HloInstr]",
+) -> dict[str, str]:
+    """Instruction name → device-account bucket, from compiled HLO text
+    (or an already-parsed instruction dict — a large model's HLO text is
+    tens of MB and callers holding a parse must not pay it twice).
+
+    The join key for backends whose profiler traces name device events by
+    HLO *instruction* (CPU thunk runtime: ``args.hlo_op = "fusion.3"``)
+    rather than by op_name scope: classify each instruction once —
+    collective and infeed by opcode, everything else by its ``op_name``
+    scope metadata."""
+    instrs = parse_hlo_instructions(hlo) if isinstance(hlo, str) else hlo
+    out: dict[str, str] = {}
+    for name, instr in instrs.items():
+        if base_collective_op(instr.op) is not None:
+            out[name] = "collective"
+        elif instr.op in _INFEED_OPS:
+            out[name] = "infeed"
+        else:
+            bucket = classify_op_scope(instr.op_name) if instr.op_name else None
+            out[name] = bucket or "other"
     return out
 
 

@@ -273,6 +273,10 @@ class TrainConfig:
     # trigger file polled at step cadence for on-demand capture;
     # "" = <output_dir>/obs/profile.trigger when obs is enabled
     profile_trigger: str = ""
+    # arm the trigger automatically when the health watchdog agrees an
+    # anomaly: the next steps are profiled, so the post-mortem carries a
+    # device timeline (device_account) next to the flight recorder
+    profile_on_anomaly: bool = False
 
     # --- nested ---
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -403,6 +407,14 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
         "--profile-trigger", type=str, default=_D.profile_trigger,
         help="trigger-file path polled every step for on-demand capture "
              "(default: <output-dir>/obs/profile.trigger when --obs is on)",
+    )
+    p.add_argument(
+        "--profile-on-anomaly", action="store_true",
+        default=_D.profile_on_anomaly,
+        help="arm the profile trigger automatically when the health "
+             "watchdog agrees an anomaly: the following steps are "
+             "captured and parsed into a device_account, so the "
+             "post-mortem carries a device timeline",
     )
     p.add_argument(
         "--obs", type=str, default=_D.obs, choices=("off", "stdout", "jsonl"),

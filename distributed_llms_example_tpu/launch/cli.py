@@ -134,6 +134,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--max-source-length", type=int, default=1024)
     p.add_argument("--log-every-steps", type=int, default=50)
+    p.add_argument("--ttft-slo-ms", type=float, default=0.0,
+                   help="first-token SLO for the serve_summary goodput "
+                        "fields (useful tokens/sec + slo_attainment); "
+                        "0 = no SLO")
     p.add_argument("--mesh", type=str, default="data=-1")
     p.add_argument("--compute-dtype", type=str, default="bfloat16")
     p.add_argument("--attention-impl", type=str, default="",
@@ -237,6 +241,7 @@ def serve_main(argv: list[str] | None = None) -> int:
             max_new_tokens=args.max_new_tokens,
             max_source_length=args.max_source_length,
             log_every_steps=args.log_every_steps,
+            ttft_slo_ms=args.ttft_slo_ms,
         ),
         is_seq2seq=lm.is_seq2seq,
     )

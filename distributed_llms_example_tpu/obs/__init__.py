@@ -19,8 +19,16 @@ one.  This package supplies those signals in four layers:
                 and a static per-step collective-traffic account scanned
                 from the same HLO the IR lint parses
 - ``profile``   on-demand ``jax.profiler`` capture for a step window
-                (``--profile-steps 100:105``) or a trigger file polled at
-                step cadence
+                (``--profile-steps 100:105``), a trigger file polled at
+                step cadence, or an agreed anomaly
+                (``--profile-on-anomaly``); captures land in
+                step-window-stamped dirs and announce themselves with
+                ``profile_captured`` events
+- ``devprof``   device-time attribution: the jax-free trace parser that
+                reduces a landed capture into the ``device_account`` —
+                per-module-bucket device time (op_name scopes through
+                the same table as the health param buckets), achieved
+                bytes/sec per collective, compute↔comm overlap
 - ``heartbeat`` multi-host liveness/step-skew probe so process 0 reports
                 laggards before a collective hangs silently
 - ``health``    the training-signal watchdog: consumes the in-graph
@@ -57,6 +65,7 @@ import os
 from typing import Any, Iterable, Iterator
 
 from distributed_llms_example_tpu.obs import health as health_mod
+from distributed_llms_example_tpu.obs import profile as profile_mod
 from distributed_llms_example_tpu.obs import sink as sink_mod
 from distributed_llms_example_tpu.obs.budget import BudgetAccountant, budget_enabled
 from distributed_llms_example_tpu.obs.health import HealthWatchdog, health_enabled
@@ -137,6 +146,15 @@ class TrainerObs:
             if self.enabled
             else ""
         )
+        # device-time attribution (obs/devprof.py) inputs, filled by
+        # startup_gauges: the instruction→bucket index of the compiled
+        # step and the static per-step collective byte account
+        self._op_buckets: dict[str, str] | None = None
+        self._comm_account: dict | None = None
+        # --profile-on-anomaly: an agreed anomaly arms the profiler's own
+        # trigger file, so the NEXT steps are captured and the post-mortem
+        # carries a device timeline next to the flight recorder
+        self.profile_on_anomaly = bool(getattr(cfg, "profile_on_anomaly", False))
         self.profiler = self._build_profiler(start_step)
         # step-time budget layer (obs/budget.py): host-clock arithmetic
         # over the span recorder's per-step records, closed at the log
@@ -166,13 +184,15 @@ class TrainerObs:
             self.spans.listener = self.trace
 
     def _build_profiler(self, start_step: int) -> ProfileController:
-        return ProfileController(
+        ctl = ProfileController(
             profile_dir=self.cfg.profile_dir,
             steps_spec=self.cfg.profile_steps,
             trigger_path=self._trigger,
             start_step=start_step,
             output_dir=self.cfg.output_dir,
         )
+        ctl.on_capture = self._on_profile_captured
+        return ctl
 
     def set_start_step(self, start_step: int) -> None:
         """Re-anchor the legacy relative profile window once the Trainer
@@ -216,6 +236,11 @@ class TrainerObs:
             })
             return
         self.flops_per_step = report["flops_per_step"]
+        # devprof inputs stay in-process: the instruction→bucket index is
+        # thousands of entries (no place on a metric line) and the byte
+        # account is re-read from the emitted record at report time
+        self._op_buckets = report.pop("op_bucket_index", None)
+        self._comm_account = report.get("comm")
         sink_mod.emit({
             "event": "obs_gauges",
             "peak_flops_per_chip": self.peak_flops_per_chip,
@@ -270,6 +295,44 @@ class TrainerObs:
         if self.budget is None or step % self.every != 0:
             return
         self.budget.probe_optimizer(fn_factory)
+
+    def _on_profile_captured(
+        self, trace_dir: str, window: tuple[int, int], truncated: bool = False
+    ) -> None:
+        """A profile window landed: parse the capture into the device
+        account (obs/devprof.py — host-side file IO on the capture's
+        closing step only) and emit it through the budget layer.  A GAUGE,
+        never load-bearing: any parse failure logs one event and the run
+        continues.  Truncated captures carry the clamped (honest) window
+        and a ``truncated`` stamp."""
+        if self.budget is None:
+            return
+        try:
+            from distributed_llms_example_tpu.obs.devprof import (
+                device_account_from_dir,
+                join_collective_bandwidth,
+            )
+
+            acct = device_account_from_dir(trace_dir, op_buckets=self._op_buckets)
+            if acct is None:
+                sink_mod.emit({
+                    "event": "device_account_skipped",
+                    "reason": f"no device op events under {trace_dir}",
+                }, local=True)
+                return
+            steps = int(window[1] - window[0] + 1)
+            acct["step"] = int(window[1])
+            acct["window"] = [int(window[0]), int(window[1])]
+            acct["window_steps"] = steps
+            if truncated:
+                acct["truncated"] = True
+            join_collective_bandwidth(acct, self._comm_account, steps)
+            self.budget.attach_device_account(acct)
+        except Exception as e:  # noqa: BLE001 — telemetry must not kill the run
+            sink_mod.emit({
+                "event": "device_account_skipped",
+                "reason": str(e)[:300],
+            }, local=True)
 
     def eval_span(self):
         return self.spans.span("eval")
@@ -350,6 +413,27 @@ class TrainerObs:
         if event is None:
             return "ok"
         self.last_anomaly = event
+        if (
+            self.profile_on_anomaly
+            and self._trigger
+            and not self.profiler.active
+        ):
+            # arm the profiler's OWN trigger-file machinery: the next
+            # step opens a capture, so the post-mortem carries a device
+            # timeline next to the flight-recorder bundle.  Every rank
+            # writes the same path (the schedule is pod-agreed); the
+            # controller consumes it exactly like an operator touch.
+            try:
+                os.makedirs(os.path.dirname(self._trigger), exist_ok=True)
+                with open(self._trigger, "w") as f:
+                    f.write(str(profile_mod.DEFAULT_TRIGGER_STEPS))
+                sink_mod.emit({
+                    "event": "profile_trigger_armed",
+                    "step": step,
+                    "reason": f"anomaly:{event['code']}",
+                }, local=True)
+            except OSError:
+                pass  # a failed arm must not change the policy action
         if self.recorder is not None:
             self.recorder.dump(
                 self.cfg.output_dir,
@@ -410,7 +494,7 @@ class TrainerObs:
         emit the final span window, and push the file channel to disk.
         Returns the final health action (informational — the loop is
         already over)."""
-        self.profiler.finalize(sync_leaf)
+        self.profiler.finalize(sync_leaf, last_step=step)
         action = "ok"
         if self.budget is not None:
             # the final partial window's account (before summary resets it)

@@ -138,6 +138,10 @@ class BudgetAccountant:
         self.warmup_windows = int(warmup_windows)
         self.history_size = int(history_size)
         self.history: list[dict] = []
+        # the newest device-side decomposition of device_busy (a parsed
+        # profile capture — obs/devprof.py via attach_device_account);
+        # bench reads it after a profiled trainer-loop pass
+        self.last_device_account: dict | None = None
         self._closed = 0
         # cadenced gauges riding the account (not partition components):
         # currently the optimizer-apply wall sample (probe_optimizer)
@@ -195,6 +199,26 @@ class BudgetAccountant:
                 "event": "optimizer_probe_disabled",
                 "reason": str(e)[:300],
             }, local=True)
+
+    # -- the device-side decomposition (profile windows only) ------------
+
+    def attach_device_account(self, account: dict) -> dict:
+        """Emit one parsed profile capture (obs/devprof.py) as a
+        ``device_account`` event — the device-side decomposition of the
+        host account's ``device_busy`` blob: per-module-bucket device
+        time, per-collective time (+ achieved bandwidth when the byte
+        account joined), and the overlap/exposed-idle metrics.  Same
+        sink rules as ``trace_spans``: bulk (file channel only — the
+        lanes payload has no place on the Valohai stdout contract) and
+        local (every capturing rank's file carries its own account).
+        Retained as ``last_device_account`` for in-process consumers
+        (bench)."""
+        record = {"event": "device_account", **{
+            k: v for k, v in account.items() if k != "event"
+        }}
+        self.last_device_account = record
+        sink_mod.emit(record, local=True, bulk=True)
+        return record
 
     # -- window close (log cadence only) ---------------------------------
 
@@ -343,3 +367,6 @@ __all__ = [
     "aggregate_accounts",
     "budget_enabled",
 ]
+# NOTE: the device-side decomposition of device_busy is emitted through
+# BudgetAccountant.attach_device_account (device_account events) — parsed
+# by obs/devprof.py from profile captures, rendered by obs/report.py.

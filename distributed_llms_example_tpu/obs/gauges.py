@@ -35,6 +35,7 @@ from typing import Any, Iterable
 
 from distributed_llms_example_tpu.analysis.ir_lint import (
     model_tree_element_candidates,
+    op_bucket_index,
     parse_hlo_instructions,
 )
 
@@ -91,11 +92,12 @@ def hbm_stats() -> list[dict] | None:
 
 
 def collective_traffic(
-    hlo_text: str,
+    hlo_text,
     param_element_counts: Iterable[int],
     mesh_size: int,
 ) -> dict:
-    """Static per-step collective-traffic account from compiled HLO text.
+    """Static per-step collective-traffic account from compiled HLO text
+    (or an already-parsed instruction dict — see ``op_bucket_index``).
 
     Returns ``{op: {count, gradient_bytes, activation_bytes}, ...}`` plus
     ``total_bytes``/``gradient_bytes``/``activation_bytes`` rollups.
@@ -103,7 +105,11 @@ def collective_traffic(
     tuple element for async starts) — the same sizing the IR lint census
     reports, via the same parser.
     """
-    instrs = parse_hlo_instructions(hlo_text)
+    instrs = (
+        parse_hlo_instructions(hlo_text)
+        if isinstance(hlo_text, str)
+        else hlo_text
+    )
     candidates = model_tree_element_candidates(param_element_counts, mesh_size)
     account: dict[str, dict[str, int]] = {}
     total = grad_total = 0
@@ -194,8 +200,11 @@ def train_step_static_gauges(
     if flops <= 0.0:
         flops = training_flops_estimate(n_params, tokens_per_step)
         flops_source = "6N_tokens_estimate"
+    # ONE parse of the (potentially tens-of-MB) compiled text feeds both
+    # the traffic account and the device-attribution index
+    instrs = parse_hlo_instructions(compiled.as_text())
     comm = collective_traffic(
-        compiled.as_text(),
+        instrs,
         [int(math.prod(x.shape)) for x in leaves],
         mesh_size,
     )
@@ -209,4 +218,10 @@ def train_step_static_gauges(
         "flops_per_step": flops,
         "flops_source": flops_source,
         "comm": comm,
+        # instruction→bucket index for the device-time attribution
+        # (obs/devprof.py): CPU-backend traces name device events by HLO
+        # instruction, and this program is the same lowering the runtime
+        # executes.  Popped off before the obs_gauges record is emitted —
+        # thousands of entries have no place on a metric line.
+        "op_bucket_index": op_bucket_index(instrs),
     }

@@ -15,9 +15,17 @@ Two triggers, one controller:
   device.  The file is consumed (removed) when the capture starts so a
   shared filesystem does not re-trigger every host forever.
 
-Traces land under ``<trace_dir>/proc{process_index:03d}`` — every process
-captures its own host's view (jax.profiler traces are process-local), and
-the index keeps a shared output dir collision-free.
+Traces land under
+``<trace_dir>/proc{process_index:03d}-s{start:06d}-{stop:06d}-{wallclock}``
+— every process captures its own host's view (jax.profiler traces are
+process-local), the index keeps a shared output dir collision-free, and
+the step window + wall clock in the name let obs/report.py and
+obs/devprof.py locate a specific capture without globbing timestamps out
+of jax's internal session layout.  Each landed capture additionally
+announces itself with a ``profile_captured`` event (path + step window),
+and an ``on_capture`` hook hands the capture to the device-time
+attribution (obs/devprof.py via TrainerObs) so a ``device_account``
+rides the same window.
 
 The stop path syncs on the step's loss before ``stop_trace`` so the
 traced window contains completed steps — the one deliberate device sync,
@@ -27,7 +35,8 @@ and it only ever happens on the window's closing step.
 from __future__ import annotations
 
 import os
-from typing import Any
+import time
+from typing import Any, Callable
 
 from distributed_llms_example_tpu.obs import sink as sink_mod
 
@@ -84,7 +93,13 @@ class ProfileController:
             self.profile_dir = os.path.join(output_dir, "obs", "profile")
         self.active = False
         self._stop_step = 0
+        self._start_step = 0
         self._trace_dir = ""
+        # called as on_capture(trace_dir, (start, stop), truncated) after
+        # each landed capture — TrainerObs hangs the device-account parse
+        # here.  On truncated stops the window is clamped to the last
+        # completed step.
+        self.on_capture: Callable[[str, tuple[int, int], bool], None] | None = None
 
     # -- loop hooks ------------------------------------------------------
 
@@ -97,7 +112,7 @@ class ProfileController:
         # range, not equality: a run that resumes INSIDE the window (the
         # preempt-at-102-of-100:105 case) still captures the remainder
         if self.window and self.window[0] <= next_step <= self.window[1]:
-            self._start(self.window[1])
+            self._start(next_step, self.window[1])
             return
         if self.trigger_path and os.path.exists(self.trigger_path):
             steps = DEFAULT_TRIGGER_STEPS
@@ -112,32 +127,45 @@ class ProfileController:
                 os.remove(self.trigger_path)
             except OSError:
                 pass
-            self._start(next_step + steps - 1)
+            self._start(next_step, next_step + steps - 1)
 
     def after_step(self, step: int, sync_leaf: Any = None) -> None:
         if self.active and step >= self._stop_step:
             self._stop(sync_leaf, truncated=False)
 
-    def finalize(self, sync_leaf: Any = None) -> None:
+    def finalize(self, sync_leaf: Any = None, last_step: int | None = None) -> None:
         """Training ended inside an open window: flush the (short) trace
-        rather than losing it."""
+        rather than losing it.  ``last_step`` (the run's final completed
+        step) clamps the reported window so downstream per-step
+        arithmetic — the bandwidth join multiplies bytes/step by window
+        steps — is not inflated by steps that never ran."""
         if self.active:
-            self._stop(sync_leaf, truncated=True)
+            self._stop(sync_leaf, truncated=True, last_step=last_step)
 
     # -- internals -------------------------------------------------------
 
-    def _start(self, stop_step: int) -> None:
+    def _start(self, start_step: int, stop_step: int) -> None:
         import jax
 
+        # step window + wall clock in the dir name: a run that captures
+        # twice (trigger, then --profile-on-anomaly) writes two
+        # self-describing dirs, and the profile_captured event's path is
+        # enough to find THIS capture's files without globbing
         self._trace_dir = os.path.join(
-            self.profile_dir or ".", f"proc{jax.process_index():03d}"
+            self.profile_dir or ".",
+            f"proc{jax.process_index():03d}"
+            f"-s{start_step:06d}-{stop_step:06d}"
+            f"-{time.strftime('%Y%m%d-%H%M%S')}",
         )
         os.makedirs(self._trace_dir, exist_ok=True)
         jax.profiler.start_trace(self._trace_dir)
         self.active = True
+        self._start_step = start_step
         self._stop_step = stop_step
 
-    def _stop(self, sync_leaf: Any, *, truncated: bool) -> None:
+    def _stop(
+        self, sync_leaf: Any, *, truncated: bool, last_step: int | None = None
+    ) -> None:
         import jax
 
         if sync_leaf is not None:
@@ -154,3 +182,22 @@ class ProfileController:
         # every capturing process announces its own trace (all_processes:
         # a trigger may fire on one non-zero host only)
         sink_mod.emit(record, all_processes=True)
+        # a truncated capture's REAL window ends at the last completed
+        # step, not the scheduled stop — report the honest step count or
+        # every per-step consumer (achieved bytes/sec = bytes/step ×
+        # steps / time) overstates
+        stop = self._stop_step
+        if truncated and last_step is not None:
+            stop = max(self._start_step, min(stop, int(last_step)))
+        window = (self._start_step, stop)
+        captured: dict[str, Any] = {
+            "event": "profile_captured",
+            "path": self._trace_dir,
+            "window": [int(window[0]), int(window[1])],
+            "steps": int(window[1] - window[0] + 1),
+        }
+        if truncated:
+            captured["truncated"] = True
+        sink_mod.emit(captured, all_processes=True)
+        if self.on_capture is not None:
+            self.on_capture(self._trace_dir, window, truncated)

@@ -16,6 +16,13 @@ and reconstructs the run:
   host-blocking-dispatch incident the runtime tripwire flagged.
   ``--min-dispatch-efficiency X`` + ``--strict`` turn a regressed
   efficiency into a nonzero exit (the trainer-loop-gap CI gate);
+- a **device account section** from the ``device_account`` events
+  (obs/devprof.py — profile captures parsed at runtime): per-module-
+  bucket device time, per-collective achieved bandwidth (measured device
+  time joined with the gauges' static byte account), and the compute↔comm
+  overlap / exposed-idle metrics, all from the JSONL alone (no trace
+  files needed at report time).  ``--min-overlap-frac X`` + ``--strict``
+  gate on exposed collectives and on captures that produced no account;
 - ``--trace out.json`` additionally exports the merged **Perfetto /
   Chrome trace** (obs/trace.py): every rank's span instances aligned on
   shared step boundaries, budget counters, anomaly/chaos instants, and
@@ -339,6 +346,58 @@ def budget_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
     }
 
 
+def device_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
+    """The device-time attribution rollup: each rank's NEWEST
+    ``device_account`` (a parsed profile capture — obs/devprof.py), the
+    ``profile_captured`` inventory, and the achieved-bandwidth join
+    against the startup gauges' byte account for any account the runtime
+    emitted without one (e.g. gauges landed after the capture).  Renders
+    from the JSONL alone — no trace files are read here."""
+    from distributed_llms_example_tpu.obs.devprof import (
+        join_collective_bandwidth,
+    )
+
+    comm = None
+    for records in processes.values():
+        for r in _by_event(records).get("obs_gauges", []):
+            if isinstance(r.get("comm"), dict):
+                comm = r["comm"]
+                break
+        if comm:
+            break
+    ranks: dict[str, dict] = {}
+    captures: list[dict] = []
+    n_accounts = 0
+    for proc, records in sorted(processes.items()):
+        ev = _by_event(records)
+        for r in ev.get("profile_captured", []):
+            captures.append({
+                "rank": proc,
+                "path": r.get("path"),
+                "window": r.get("window"),
+                "steps": r.get("steps"),
+                **({"truncated": True} if r.get("truncated") else {}),
+            })
+        accts = ev.get("device_account", [])
+        n_accounts += len(accts)
+        if not accts:
+            continue
+        acct = dict(accts[-1])  # newest capture is the rank's account
+        acct.pop("lanes", None)  # exporter payload, not report material
+        needs_join = any(
+            "achieved_bytes_per_sec" not in slot
+            for slot in (acct.get("collectives") or {}).values()
+        )
+        if needs_join and comm:
+            join_collective_bandwidth(
+                acct, comm, int(acct.get("window_steps", 0) or 0)
+            )
+        ranks[str(proc)] = acct
+    if not ranks and not captures:
+        return None
+    return {"ranks": ranks, "captures": captures, "accounts": n_accounts}
+
+
 def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
     """The fault-tolerance timeline: chaos injections, recovery actions
     (rewinds / skip-batch / halts), quarantines, checkpoint-integrity
@@ -487,6 +546,7 @@ def build_report(output_dir: str) -> dict[str, Any]:
         "stragglers": straggler_attribution(processes),
         "comm": comm_report(processes),
         "budget": budget_report(processes),
+        "device": device_report(processes),
         "recovery": recovery_report(processes),
         "anomalies": anomalies,
         "recorders": {
@@ -646,6 +706,75 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
                 f"{_fmt(final['dispatch_efficiency'])}, accounted "
                 f"{_fmt(final['accounted_frac'])} of wall over {len(ws)} window(s)"
             )
+    device = report.get("device")
+    add("")
+    add("## Device account (profiled windows)")
+    if device is None:
+        add("- no device_account records (no profile window landed — "
+            "touch the profile trigger or pass --profile-steps)")
+    else:
+        from distributed_llms_example_tpu.obs.devprof import DEVICE_BUCKETS
+
+        for cap in device["captures"]:
+            add(
+                f"- capture r{cap['rank']}: steps {cap.get('window')} → "
+                f"`{cap.get('path')}`"
+                + (" (truncated)" if cap.get("truncated") else "")
+            )
+        if not device["ranks"]:
+            add("- captures exist but no device_account parsed — run with "
+                "--obs-budget on, or parse offline: python -m "
+                "distributed_llms_example_tpu.obs.devprof <capture_dir>")
+        else:
+            add("")
+            add("| rank | window | span ms | busy ms | idle ms | "
+                + " | ".join(DEVICE_BUCKETS) + " |")
+            add("|---" * (len(DEVICE_BUCKETS) + 5) + "|")
+            for rank, acct in sorted(device["ranks"].items()):
+                b = acct.get("buckets_ms", {})
+                cells = " | ".join(_fmt(b.get(k)) for k in DEVICE_BUCKETS)
+                add(
+                    f"| {rank} | {acct.get('window')} | "
+                    f"{_fmt(acct.get('span_ms'))} | {_fmt(acct.get('busy_ms'))} | "
+                    f"{_fmt(acct.get('exposed_idle_ms'))} | {cells} |"
+                )
+            add("")
+            add("collective bandwidth (measured device time × static "
+                "byte account):")
+            any_coll = False
+            for rank, acct in sorted(device["ranks"].items()):
+                for op, slot in sorted((acct.get("collectives") or {}).items()):
+                    any_coll = True
+                    bw = slot.get("achieved_bytes_per_sec")
+                    add(
+                        f"- r{rank} {op}: ×{slot.get('count')} — "
+                        f"{_fmt(slot.get('time_ms'))} ms"
+                        + (
+                            f", {slot.get('bytes_per_step', 0):,} B/step → "
+                            f"{bw / 1e6:.1f} MB/s achieved"
+                            if isinstance(bw, (int, float))
+                            else ""
+                        )
+                    )
+            if not any_coll:
+                add("- no collective device time in the captured window")
+            for rank, acct in sorted(device["ranks"].items()):
+                ov = acct.get("overlap") or {}
+                if not ov:
+                    continue
+                frac = ov.get("overlap_frac")
+                add(
+                    f"- r{rank} overlap: collective {_fmt(ov.get('collective_ms'))} ms, "
+                    f"compute {_fmt(ov.get('compute_ms'))} ms, "
+                    f"overlapped {_fmt(ov.get('overlapped_ms'))} ms"
+                    + (
+                        f" (overlap_frac {_fmt(frac)})"
+                        if frac is not None
+                        else ""
+                    )
+                    + f", exposed collective {_fmt(ov.get('exposed_collective_ms'))} ms, "
+                    f"exposed idle {_fmt(acct.get('exposed_idle_ms'))} ms"
+                )
     comm = report["comm"]
     add("")
     add("## Comm account")
@@ -746,6 +875,14 @@ def main(argv: list[str] | None = None) -> int:
              "floor (0 = no floor) — the trainer-loop-gap CI gate",
     )
     p.add_argument(
+        "--min-overlap-frac", type=float, default=0.0,
+        help="with --strict: fail when any rank's device_account shows "
+             "collective device time with overlap_frac below this floor "
+             "(0 = no floor), and fail when a profile was captured but NO "
+             "device_account was emitted — a missing device measurement "
+             "must never read as a pass",
+    )
+    p.add_argument(
         "--trace", type=str, default="",
         help="also export the merged Chrome-trace/Perfetto JSON here "
              "(every rank's spans aligned on shared step boundaries, "
@@ -790,6 +927,35 @@ def main(argv: list[str] | None = None) -> int:
                     f"{floor} floor", file=sys.stderr,
                 )
                 rc = 1
+        ov_floor = args.min_overlap_frac
+        if ov_floor > 0:
+            device = report.get("device")
+            if device is None or not device["ranks"]:
+                # a capture with no parsed account is a broken pipeline;
+                # no capture at all is a missing measurement — both fail
+                # a gate that was explicitly asked to look at overlap
+                print(
+                    "strict: --min-overlap-frac set but no device_account "
+                    "records found"
+                    + (
+                        f" ({len(device['captures'])} profile capture(s) "
+                        "landed without one)"
+                        if device is not None
+                        else ""
+                    ),
+                    file=sys.stderr,
+                )
+                rc = 1
+            else:
+                for rank, acct in sorted(device["ranks"].items()):
+                    frac = (acct.get("overlap") or {}).get("overlap_frac")
+                    if frac is not None and frac < ov_floor:
+                        print(
+                            f"strict: rank {rank} overlap_frac {frac} below "
+                            f"the {ov_floor} floor (exposed collective time)",
+                            file=sys.stderr,
+                        )
+                        rc = 1
     return rc
 
 

@@ -16,8 +16,11 @@ Two halves:
   ``python -m distributed_llms_example_tpu.obs.report <dir> --trace
   out.json`` (or this module's own CLI) merges every rank's spans,
   step-budget gauges, heartbeats, anomalies, chaos injections, recovery
-  actions and serving request lifecycles into ONE Chrome-trace JSON —
-  load it at https://ui.perfetto.dev (or chrome://tracing).
+  actions, serving request lifecycles AND the device lanes of any
+  profiled window (``device_account`` events — per-bucket device slices
+  drawn beside the host spans, end-aligned on the window's closing step)
+  into ONE Chrome-trace JSON — load it at https://ui.perfetto.dev (or
+  chrome://tracing).
 
 Cross-host alignment: each rank's span clocks are host-monotonic with an
 arbitrary epoch, but synchronous SPMD gives a shared ordinal axis — every
@@ -54,6 +57,7 @@ MAX_SPANS_PER_WINDOW = 8192
 TID_SPANS = 0      # the train-loop spans (data_wait / dispatch / ...)
 TID_STEPS = 1      # step-boundary slices + instant events
 TID_COUNTERS = 2   # dispatch_efficiency counter track
+TID_DEVICE = 3     # device lanes: per-bucket slices from device_account
 TID_REQUESTS = 10  # serving: request lifecycles, one track per slot offset
 
 
@@ -174,7 +178,7 @@ def build_trace(output_dir: str) -> dict[str, Any]:
         })
         for tid, label in (
             (TID_SPANS, "loop spans"), (TID_STEPS, "steps"),
-            (TID_COUNTERS, "gauges"),
+            (TID_COUNTERS, "gauges"), (TID_DEVICE, "device (profiled)"),
         ):
             events.append({
                 "ph": "M", "name": "thread_name", "pid": rank, "tid": tid,
@@ -234,6 +238,8 @@ def build_trace(output_dir: str) -> dict[str, Any]:
                     "ph": "i", "s": "p", "pid": rank, "tid": TID_STEPS,
                     "ts": _us(t),
                 })
+            elif ev == "device_account":
+                events.extend(_device_lane_events(rank, r, marks, off))
             elif ev == "serve_request":
                 events.extend(_request_events(rank, r))
     return {
@@ -245,6 +251,40 @@ def build_trace(output_dir: str) -> dict[str, Any]:
             "ranks": sorted(spans_by_rank) or sorted(processes),
         },
     }
+
+
+def _device_lane_events(
+    rank: int, r: dict, marks: dict[int, float], off: float
+) -> list[dict]:
+    """One ``device_account``'s bounded per-bucket lane slices →
+    device-track slices BESIDE the host spans, aligned on the shared step
+    ordinals: the capture's device span ends when its window's closing
+    step completes on the host clock, so the device lane sits under
+    exactly the host steps it profiled."""
+    window = r.get("window") or []
+    lanes = r.get("lanes") or []
+    if len(window) != 2 or not lanes:
+        return []
+    stop = int(window[1])
+    # anchor: prefer the window's closing step mark; fall back to any
+    # recorded mark at/after it (a truncated capture may stop early)
+    t_end = marks.get(stop)
+    if t_end is None:
+        later = [t for s, t in marks.items() if s >= stop]
+        if not later:
+            return []
+        t_end = min(later)
+    span_s = float(r.get("span_ms", 0.0) or 0.0) / 1e3
+    t0 = t_end - span_s + off
+    out: list[dict] = []
+    for bucket, rel_ms, dur_ms in lanes:
+        out.append({
+            "name": f"dev:{bucket}", "ph": "X", "pid": rank,
+            "tid": TID_DEVICE,
+            "ts": _us(t0 + float(rel_ms) / 1e3),
+            "dur": _us(float(dur_ms) / 1e3),
+        })
+    return out
 
 
 def _request_events(rank: int, r: dict) -> list[dict]:

@@ -40,9 +40,11 @@ finished request (queue-wait → prefill → first-token → decode → evict,
 with times relative to the batch's submit instant so ``obs.report
 --trace`` can draw each request as a slot-track slice), and a final
 ``serve_summary`` (tokens/sec/chip, TTFT p50/p95 **with its queue-vs-
-prefill decomposition**, occupancy, evictions) — TTFT p95 stops being one
-opaque aggregate and becomes "the tail waited in queue" vs "prefill is
-slow".
+prefill decomposition**, occupancy, evictions, and the **goodput
+fields** — useful tokens/sec and the SLO-attainment fraction at the
+configured ``ttft_slo_ms``, the router tier's dispatch inputs) — TTFT
+p95 stops being one opaque aggregate and becomes "the tail waited in
+queue" vs "prefill is slow".
 """
 
 from __future__ import annotations
@@ -82,7 +84,11 @@ class ServeConfig:
     ``max_new_tokens``: decode budget per sequence = the KV-cache length
     (seq2seq) or its decode tail (causal).  ``request_spans``: emit one
     ``serve_request`` lifecycle event per finished request (queue-wait /
-    prefill / ttft / decode breakdown — the trace exporter's feed)."""
+    prefill / ttft / decode breakdown — the trace exporter's feed).
+    ``ttft_slo_ms``: the first-token SLO the goodput fields are judged
+    against (0 = no SLO: every finished request's tokens are useful) —
+    the router tier's dispatch inputs (``serve_summary``:
+    ``goodput_tokens_per_sec`` + ``slo_attainment``)."""
 
     max_slots: int = 8
     prefill_batch: int = 0  # 0 = max_slots
@@ -90,6 +96,7 @@ class ServeConfig:
     max_source_length: int = 1024
     log_every_steps: int = 50
     request_spans: bool = True
+    ttft_slo_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -107,6 +114,10 @@ class ServeStats:
     # waiting for a slot vs inside the request's prefill call
     queue_wait_s: list[float] = dataclasses.field(default_factory=list)
     prefill_share_s: list[float] = dataclasses.field(default_factory=list)
+    # goodput fields (filled by generate): useful tokens/sec at the
+    # configured TTFT SLO + the attainment fraction — the router tier's
+    # dispatch inputs
+    goodput: dict = dataclasses.field(default_factory=dict)
 
     def tokens_per_sec(self) -> float:
         return self.decode_tokens / max(self.decode_seconds, 1e-9)
@@ -136,6 +147,43 @@ class ServeStats:
             "ttft_queue_share": round(sum(self.queue_wait_s) / total, 4) if total else 0.0,
             "ttft_prefill_share": round(sum(self.prefill_share_s) / total, 4) if total else 0.0,
         }
+
+
+def compute_goodput(
+    ttft_s: Sequence[float | None],
+    tokens_out: Sequence[int],
+    *,
+    wall_s: float,
+    ttft_slo_ms: float,
+    n_chips: int,
+) -> dict:
+    """Goodput: USEFUL tokens per wall second, + SLO attainment.
+
+    Useful = tokens of requests whose first token met the TTFT SLO (all
+    FINISHED requests when no SLO is set — ``ttft_s[i] is None`` marks an
+    unfinished request); wall = submit → batch done, so queue-wait and
+    prefill stalls cost goodput the way they cost a user.
+    ``slo_attainment`` is the fraction of finished requests served within
+    the SLO — the router tier's per-replica health signal.  Pure
+    host-float arithmetic; shared by the engine summary and tests so the
+    numbers are pinnable."""
+    wall_s = max(float(wall_s), 1e-9)
+    slo_s = float(ttft_slo_ms) / 1e3
+    finished = [
+        (i, t) for i, t in enumerate(ttft_s) if t is not None
+    ]
+    met = [i for i, t in finished if slo_s <= 0 or t <= slo_s]
+    useful = sum(int(tokens_out[i]) for i in met)
+    out = {
+        "goodput_tokens_per_sec": round(useful / wall_s, 1),
+        "goodput_tokens_per_sec_chip": round(useful / wall_s / max(n_chips, 1), 1),
+    }
+    if slo_s > 0:
+        out["ttft_slo_ms"] = round(float(ttft_slo_ms), 1)
+        out["slo_attainment"] = (
+            round(len(met) / len(finished), 4) if finished else 0.0
+        )
+    return out
 
 
 class ServingEngine:
@@ -556,6 +604,13 @@ class ServingEngine:
         stats.slot_occupancy = (
             stats.slot_occupancy / stats.decode_steps if stats.decode_steps else 0.0
         )
+        stats.goodput = compute_goodput(
+            ttft,
+            [len(o) for o in outputs],
+            wall_s=time.perf_counter() - t_submit,
+            ttft_slo_ms=self.serve.ttft_slo_ms,
+            n_chips=n_chips,
+        )
         p50, p95 = stats.ttft_percentiles()
         log_json({
             "event": "serve_summary",
@@ -567,6 +622,7 @@ class ServingEngine:
             "ttft_p50_ms": round(p50 * 1e3, 1),
             "ttft_p95_ms": round(p95 * 1e3, 1),
             **stats.ttft_decomposition(),
+            **stats.goodput,
             "slot_occupancy": round(stats.slot_occupancy, 4),
             "prefill_seconds": round(stats.prefill_seconds, 3),
             "slots": S,

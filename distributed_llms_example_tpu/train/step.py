@@ -98,20 +98,20 @@ def bucket_of_path(path: tuple) -> str:
     self_attn / mlp / lm_head), t5 (shared / self_attn / cross_attn /
     mlp / lm_head), bart (shared / *_embed_positions / self_attn / mlp),
     and the pipelined stacked trees (same leaf names under
-    ``stacked_blocks``).  Unmatched leaves (norms, biases) fall to
-    ``mlp`` — a bucket must be total, and misfiling a layernorm scale
-    costs nothing the per-bucket ratio is watching for.
+    ``stacked_blocks``).  The matching table itself lives in
+    analysis/ir_lint.py (``MODULE_BUCKET_PATTERNS``) and is shared with
+    the device-time attribution of HLO ``op_name`` scopes
+    (obs/devprof.py) — one definition of what "attn" means.  Unmatched
+    leaves (norms, biases) fall to ``mlp`` — a param bucket must be
+    total, and misfiling a layernorm scale costs nothing the per-bucket
+    ratio is watching for.
     """
+    from distributed_llms_example_tpu.analysis.ir_lint import module_bucket_of
+
     p = "/".join(
         str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))) for k in path
-    ).lower()
-    if "lm_head" in p or "logits" in p:
-        return "head"
-    if "embed" in p or "shared" in p or "wte" in p or "wpe" in p:
-        return "embed"
-    if "attn" in p or "attention" in p:
-        return "attn"
-    return "mlp"
+    )
+    return module_bucket_of(p) or "mlp"
 
 
 def _bucket_sumsq(tree: Any) -> dict[str, jnp.ndarray]:

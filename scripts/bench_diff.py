@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Field-by-field comparison of two bench rounds (``BENCH_*.json``).
+
+The bench artifacts are nested JSON records (tokens/sec, TTFT, budget
+components, the device-account entries) whose round-over-round deltas
+today are read by eye.  This script makes the comparison a CI gate:
+
+    python scripts/bench_diff.py OLD.json NEW.json \
+        [--default-threshold 0.05] [--threshold ttft_p95_ms=0.10 ...] \
+        [--markdown-out DELTA.md]
+
+Every numeric leaf present in BOTH files is compared on its dot-path.
+Fields whose names carry a known direction are **gated**: a relative
+change in the bad direction beyond the threshold is a REGRESSION and the
+exit code is nonzero (CI red).  Direction comes from the leaf name:
+
+- higher is better: ``*tokens_per_sec*``, ``*_per_sec*``, ``*efficiency*``,
+  ``mfu``, ``goodput*``, ``slo_attainment``, ``overlap_frac``,
+  ``accounted_frac``, ``*speedup*``, ``*occupancy*``, ``*utilization*``,
+  ``achieved_bytes_per_sec``
+- lower is better: ``*_ms``, ``ttft*``, ``*_s`` / ``*_seconds`` walls,
+  ``*overhead*``, ``exposed_*``, ``unattributed*``, ``data_wait*``,
+  ``steps_lost*``
+- everything else (counts, configs, byte accounts) is reported
+  informationally and never gates.
+
+Thresholds are relative (``0.05`` = 5%); ``--threshold name=frac``
+overrides per leaf name or per full dot-path (most specific wins).  A
+markdown delta table is printed (or written with ``--markdown-out``) so
+the diff can be stamped into a PR or the bench artifact directory.
+
+Pure stdlib + json — runs anywhere the artifacts are mounted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Iterator
+
+DEFAULT_THRESHOLD = 0.05
+
+_HIGHER_BETTER = (
+    "tokens_per_sec", "_per_sec", "efficiency", "mfu", "goodput",
+    "slo_attainment", "overlap_frac", "accounted_frac", "speedup",
+    "occupancy", "utilization", "vs_synthetic", "vs_baseline",
+    "achieved_bytes_per_sec", "continuous_vs_static",
+)
+_LOWER_BETTER = (
+    "_ms", "ttft", "wall_s", "_seconds", "overhead", "exposed_",
+    "unattributed", "data_wait", "steps_lost",
+)
+# config knobs stamped INTO the artifact (not measurements): changing a
+# setting between rounds must never read as a perf regression
+_CONFIG_LEAVES = ("ttft_slo_ms", "threshold", "slo_ms")
+
+
+def direction_of(path: str) -> int:
+    """+1 = higher is better, -1 = lower is better, 0 = informational.
+
+    Matched on the LEAF name first; a leaf with no signal inherits its
+    parent map's direction (``device_account.buckets_ms.attn``: the leaf
+    is a bucket name, the ``buckets_ms`` parent carries the unit).
+    Config knobs the artifact stamps (SLO settings, thresholds) are
+    always informational."""
+    leaf = path.lower().rsplit(".", 1)[-1]
+    if any(c in leaf for c in _CONFIG_LEAVES):
+        return 0
+    segments = path.lower().rsplit(".", 2)
+    for name in reversed(segments[-2:] if len(segments) > 1 else segments):
+        if any(n in name for n in _HIGHER_BETTER):
+            return 1
+        if any(n in name for n in _LOWER_BETTER):
+            return -1
+    return 0
+
+
+def flatten(doc: Any, prefix: str = "") -> Iterator[tuple[str, float]]:
+    """Numeric leaves of a nested JSON record as (dot.path, value).
+    bools are config, not measurements — skipped."""
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            yield from flatten(v, f"{prefix}.{k}" if prefix else str(k))
+    elif isinstance(doc, bool):
+        return
+    elif isinstance(doc, (int, float)):
+        yield prefix, float(doc)
+
+
+def resolve_threshold(
+    path: str, overrides: dict[str, float], default: float
+) -> float:
+    """Most specific override wins: full dot-path, then leaf name."""
+    if path in overrides:
+        return overrides[path]
+    leaf = path.rsplit(".", 1)[-1]
+    return overrides.get(leaf, default)
+
+
+def compare(
+    old: dict, new: dict, *,
+    overrides: dict[str, float] | None = None,
+    default_threshold: float = DEFAULT_THRESHOLD,
+) -> list[dict]:
+    """Rows for every numeric leaf present in both records, verdict-ed.
+
+    verdict ∈ {"regressed", "improved", "ok", "info"}; a row regresses
+    when the relative change moves in the bad direction past its
+    threshold.  Returned in path order, regressions first within none —
+    callers sort/filter as needed."""
+    overrides = overrides or {}
+    old_flat = dict(flatten(old))
+    new_flat = dict(flatten(new))
+    rows: list[dict] = []
+    for path in sorted(old_flat.keys() & new_flat.keys()):
+        a, b = old_flat[path], new_flat[path]
+        rel = (b - a) / abs(a) if a != 0 else (0.0 if b == 0 else float("inf"))
+        d = direction_of(path)
+        threshold = resolve_threshold(path, overrides, default_threshold)
+        if d == 0:
+            verdict = "info"
+        elif d * rel < -threshold:
+            verdict = "regressed"
+        elif d * rel > threshold:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        rows.append({
+            "field": path, "old": a, "new": b,
+            "rel_change": round(rel, 4) if rel != float("inf") else None,
+            "direction": {1: "higher_better", -1: "lower_better", 0: "info"}[d],
+            "threshold": threshold,
+            "verdict": verdict,
+        })
+    return rows
+
+
+def render_markdown(rows: list[dict], old_path: str, new_path: str) -> str:
+    regressions = [r for r in rows if r["verdict"] == "regressed"]
+    improved = [r for r in rows if r["verdict"] == "improved"]
+    lines = [
+        f"# bench diff — `{old_path}` → `{new_path}`",
+        "",
+        f"{len(rows)} shared numeric fields · "
+        f"{len(regressions)} regression(s) · {len(improved)} improvement(s)",
+        "",
+        "| field | old | new | Δ | verdict |",
+        "|---|---|---|---|---|",
+    ]
+
+    def fmt(v: float) -> str:
+        return f"{v:.6g}"
+
+    # regressions first (the reason anyone reads this table), then
+    # improvements, then the quiet rows
+    order = {"regressed": 0, "improved": 1, "ok": 2, "info": 3}
+    for r in sorted(rows, key=lambda r: (order[r["verdict"]], r["field"])):
+        rel = r["rel_change"]
+        delta = f"{rel * 100:+.1f}%" if rel is not None else "new≠0"
+        mark = {"regressed": "**REGRESSED**", "improved": "improved",
+                "ok": "ok", "info": ""}[r["verdict"]]
+        lines.append(
+            f"| {r['field']} | {fmt(r['old'])} | {fmt(r['new'])} | "
+            f"{delta} | {mark} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def parse_threshold_arg(spec: str) -> tuple[str, float]:
+    name, _, frac = spec.partition("=")
+    if not name or not frac:
+        raise argparse.ArgumentTypeError(
+            f"--threshold takes FIELD=FRAC, got {spec!r}"
+        )
+    return name, float(frac)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/bench_diff.py", description=__doc__
+    )
+    p.add_argument("old", help="baseline BENCH_*.json")
+    p.add_argument("new", help="candidate BENCH_*.json")
+    p.add_argument(
+        "--default-threshold", type=float, default=DEFAULT_THRESHOLD,
+        help=f"relative regression tolerance (default {DEFAULT_THRESHOLD})",
+    )
+    p.add_argument(
+        "--threshold", action="append", default=[], type=parse_threshold_arg,
+        metavar="FIELD=FRAC",
+        help="per-field override, by leaf name or full dot-path "
+             "(repeatable; most specific wins)",
+    )
+    p.add_argument(
+        "--markdown-out", default="",
+        help="write the delta table here instead of stdout",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="emit the rows as JSON instead"
+    )
+    args = p.parse_args(argv)
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    rows = compare(
+        old, new,
+        overrides=dict(args.threshold),
+        default_threshold=args.default_threshold,
+    )
+    if not rows:
+        print("bench_diff: no shared numeric fields", file=sys.stderr)
+        return 2
+    md = render_markdown(rows, args.old, args.new)
+    if args.json:
+        print(json.dumps(rows))
+    if args.markdown_out:
+        with open(args.markdown_out, "w") as f:
+            f.write(md)
+        if not args.json:
+            print(f"bench_diff: wrote {args.markdown_out}")
+    elif not args.json:
+        print(md, end="")
+    regressions = [r for r in rows if r["verdict"] == "regressed"]
+    for r in regressions:
+        print(
+            f"bench_diff: REGRESSED {r['field']}: {r['old']:.6g} → "
+            f"{r['new']:.6g} ({r['rel_change'] * 100 if r['rel_change'] is not None else float('nan'):+.1f}% "
+            f"past the {r['threshold'] * 100:.0f}% threshold)",
+            file=sys.stderr,
+        )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

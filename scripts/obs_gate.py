@@ -35,15 +35,25 @@ def main(argv: list[str] | None = None) -> int:
         "--min-dispatch-efficiency", type=float, default=DEFAULT_FLOOR,
         help=f"wall-weighted dispatch_efficiency floor (default {DEFAULT_FLOOR})",
     )
+    p.add_argument(
+        "--min-overlap-frac", type=float, default=0.0,
+        help="optional device-account floor: fail when a profiled window "
+             "shows collective time with overlap_frac below this, or when "
+             "a profile was captured but no device_account was emitted "
+             "(0 = the device gate is off)",
+    )
     args = p.parse_args(argv)
     from distributed_llms_example_tpu.obs.report import main as report_main
 
-    return report_main([
+    flags = [
         args.output_dir,
         "--strict",
         "--min-dispatch-efficiency", str(args.min_dispatch_efficiency),
         "--json",
-    ])
+    ]
+    if args.min_overlap_frac > 0:
+        flags += ["--min-overlap-frac", str(args.min_overlap_frac)]
+    return report_main(flags)
 
 
 if __name__ == "__main__":

@@ -687,11 +687,19 @@ def test_profile_trigger_capture(tmp_path, capsys):
     assert ctl.active  # window is 2 steps
     ctl.after_step(6)
     assert not ctl.active
-    trace_dir = os.path.join(str(tmp_path), "obs", "profile", "proc000")
+    # the capture dir is self-describing: proc index + step window +
+    # wall clock, so report/devprof locate THIS capture without globbing
+    base = os.path.join(str(tmp_path), "obs", "profile")
+    dirs = [d for d in os.listdir(base) if d.startswith("proc000-s000005-000006-")]
+    assert dirs, f"no step-stamped capture dir under {base}: {os.listdir(base)}"
+    trace_dir = os.path.join(base, dirs[0])
     files = [os.path.join(dp, f) for dp, _, fs in os.walk(trace_dir) for f in fs]
     assert files, f"no trace files under {trace_dir}"
     lines = _json_lines(capsys.readouterr().out)
     assert any(r.get("event") == "profile_trace" for r in lines)
+    captured = next(r for r in lines if r.get("event") == "profile_captured")
+    assert captured["path"] == trace_dir
+    assert captured["window"] == [5, 6] and captured["steps"] == 2
 
 
 # ---------------------------------------------------------------------------

@@ -402,6 +402,15 @@ def test_engine_matches_static_batching_seq2seq(mesh8, capsys):
             "ttft_prefill_share"} <= set(summary)
     assert 0.0 <= summary["ttft_queue_share"] <= 1.0
     assert len(eng.last_stats.queue_wait_s) == len(reqs)
+    # goodput (ISSUE 11 satellite): useful tokens/sec rides the summary;
+    # with no SLO configured every finished token is useful and the SLO
+    # fields stay absent (0 = off, not "everything attained")
+    assert summary["goodput_tokens_per_sec"] > 0
+    assert summary["goodput_tokens_per_sec_chip"] > 0
+    assert "slo_attainment" not in summary and "ttft_slo_ms" not in summary
+    assert eng.last_stats.goodput["goodput_tokens_per_sec"] == summary[
+        "goodput_tokens_per_sec"
+    ]
     ref = static_batch_generate(
         lm.module, lm.config, mesh8, params, reqs, max_new_tokens=L, width=W, batch=4
     )
@@ -413,6 +422,35 @@ def test_engine_matches_static_batching_seq2seq(mesh8, capsys):
         # the engine's tokens must be the static prefix (eos-trimmed)
         assert g == w, (g, w)
         assert len(g) <= budget
+
+
+def test_compute_goodput_slo_arithmetic():
+    """The goodput fields pinned on hand numbers: useful tokens are the
+    tokens of requests whose TTFT met the SLO; attainment counts finished
+    requests only; no SLO → every finished token is useful and the SLO
+    fields are absent."""
+    from distributed_llms_example_tpu.serving.engine import compute_goodput
+
+    ttft = [0.1, 0.4, None, 0.2]  # request 2 never finished
+    tokens = [10, 20, 99, 30]
+    g = compute_goodput(
+        ttft, tokens, wall_s=2.0, ttft_slo_ms=250.0, n_chips=2
+    )
+    # met: requests 0 and 3 → 40 useful tokens over 2 s
+    assert g["goodput_tokens_per_sec"] == 20.0
+    assert g["goodput_tokens_per_sec_chip"] == 10.0
+    assert g["ttft_slo_ms"] == 250.0
+    assert g["slo_attainment"] == pytest.approx(2 / 3, abs=1e-4)
+    # SLO off: all finished tokens are useful, no attainment claim
+    g0 = compute_goodput(ttft, tokens, wall_s=2.0, ttft_slo_ms=0.0, n_chips=2)
+    assert g0["goodput_tokens_per_sec"] == 30.0
+    assert "slo_attainment" not in g0
+    # nothing finished at all: zero goodput, zero attainment
+    g_none = compute_goodput(
+        [None, None], [5, 5], wall_s=1.0, ttft_slo_ms=100.0, n_chips=1
+    )
+    assert g_none["goodput_tokens_per_sec"] == 0.0
+    assert g_none["slo_attainment"] == 0.0
 
 
 def test_engine_matches_static_batching_causal(mesh8):

@@ -655,6 +655,16 @@ def _llama_depth_main() -> None:
     # component in one session (the ROADMAP acceptance shape)
     optim_impl = os.environ.get("BENCH_OPTIM_IMPL", "auto")
     resolved_optim = resolve_optim_impl(optim_impl)
+    # this mode measures depth scaling only and always runs uncompressed;
+    # a silently-ignored BENCH_GRAD_COMPRESSION here would be the exact
+    # config-loss failure obs_gate exists to catch — say so loudly
+    if os.environ.get("BENCH_GRAD_COMPRESSION", "off") != "off":
+        print(
+            "bench: BENCH_GRAD_COMPRESSION is ignored in llama-depth mode "
+            "(record stamps grad_compression=off); the compression A/B "
+            "lives in the main bench",
+            file=sys.stderr,
+        )
     variant_names = [
         v for v in os.environ.get(
             "BENCH_7B_VARIANTS", "optim_xla,fused_ce"
@@ -951,6 +961,7 @@ def _llama_depth_main() -> None:
     print(
         json.dumps(
             {
+                "grad_compression": "off",
                 "metric": f"llama-2-7b causal-LM fine-tune throughput, depth-extrapolated "
                           f"from measured {depths}-layer full-width steps "
                           f"(seq {seq}, bf16+remat[{policy}]"
@@ -1048,6 +1059,7 @@ def _host_input_main() -> None:
         return HFTokenizer(tmp)
 
     result = {
+        "grad_compression": "off",
         "metric": f"host batch-assembly throughput (tokenize+pad+bucket, no devices; "
                   f"host batch {batch}, src1024/tgt128) vs the ~{target / 1e3:.0f}k tok/s "
                   f"a v5e-{n_chips} host must feed at {chip_rate / 1e3:.1f}k tok/s/chip",
@@ -1153,6 +1165,7 @@ def _generate_main() -> None:
     gen_tokens = batch * new_tokens  # fixed trip count: every row decodes L steps
     tps_chip = gen_tokens / dt_total / n_chips
     print(json.dumps({
+        "grad_compression": "off",
         "metric": f"{name} eval generation throughput (beam {beams}, src {src_len} "
                   f"/ max_new {new_tokens}, bf16, batch {batch}) — the reference's "
                   "live eval contract (train-accelerator.py:245-249); no reference "
@@ -1384,6 +1397,7 @@ def _serve_main() -> None:
         eval_beams=eval_beams,
     )
     print(json.dumps({
+        "grad_compression": "off",
         "metric": f"{name} continuous-batching serving decode (slots {slots}, "
                   f"src {src} / max_new {new_tokens}, {n_req} requests with "
                   "varied per-request budgets) — serving/engine.py on mesh "
@@ -1470,6 +1484,16 @@ def main() -> None:
     # elsewhere); the optim A/B add-on below re-measures the other impl
     optim_impl = os.environ.get("BENCH_OPTIM_IMPL", "auto")
     resolved_optim = resolve_optim_impl(optim_impl)
+    # gradient-collective compression for the headline step (default off —
+    # the A/B add-on below measures int8 against it in-session; a TPU
+    # round can flip the headline itself with BENCH_GRAD_COMPRESSION=int8)
+    grad_compression = os.environ.get("BENCH_GRAD_COMPRESSION", "off")
+    if grad_compression == "int8":
+        # same guard the trainer applies: without partitionable threefry
+        # the stochastic-rounding bits lower through u32 collectives as
+        # large as the gradient traffic the compression removes, skewing
+        # every number this session stamps
+        jax.config.update("jax_threefry_partitionable", True)
 
     rng = np.random.RandomState(0)
     vocab = lm.config.vocab_size
@@ -1483,14 +1507,40 @@ def main() -> None:
     tx, schedule, optim_spec = make_optimizer_bundle(
         learning_rate=5e-5, warmup_steps=0, total_steps=1000
     )
+    from distributed_llms_example_tpu.ops.quant_collectives import (
+        attach_error_feedback,
+        worker_count,
+    )
+
+    grad_workers = worker_count(dict(mesh.shape))
+
+    def _fresh_state(mode: str):
+        """A FRESH state from re-sharded initial params (the A/B arms
+        need identical re-inits; the evolving headline state's buffers
+        are donated).  Under int8 the EF tree is allocated
+        sharded-at-birth (attach_error_feedback) — a default-device
+        zeros tree would sit W x params x 4B whole on chip 0."""
+        p0 = lm.params if lm.params is not None else jax.device_get(lm.init_params(0))
+        st = create_train_state(shard_params(p0, mesh), tx)
+        shm = state_shardings(st, mesh)
+        if mode == "int8":
+            st, shm = attach_error_feedback(st, shm, mesh, grad_workers)
+        return jax.tree.map(lambda x, s: jax.device_put(x, s), st, shm), shm
+
+    # the headline state ALIASES the one sharded param tree (`params` is
+    # only read for sizes below) — a second resident copy here would
+    # double param memory for the whole bench
     params = lm.params if lm.params is not None else jax.device_get(lm.init_params(0))
     params = shard_params(params, mesh)
     state = create_train_state(params, tx)
     sh = state_shardings(state, mesh)
+    if grad_compression == "int8":
+        state, sh = attach_error_feedback(state, sh, mesh, grad_workers)
     state = jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
     build = make_train_step(
         lm.module, lm.config, tx, schedule, mesh,
         optim_spec=optim_spec, optim_impl=optim_impl,
+        grad_compression=grad_compression,
     )
     step_fn, _ = build(state)
     gb = put_batch(b, mesh)
@@ -1618,6 +1668,7 @@ def main() -> None:
     result["prng_impl"] = "threefry"
     result["optim_impl"] = resolved_optim  # headline optimizer path (auto-resolved)
     result["grad_accum_steps"] = 1  # the headline step; the A/B below adds accum>1
+    result["grad_compression"] = grad_compression  # headline wire mode
 
     # Emit the record NOW and again after each add-on lands: if an add-on
     # overruns the supervisor's kill (budget gates check only at add-on
@@ -1683,12 +1734,7 @@ def main() -> None:
             # donation, old + replacement living at once would OOM the
             # rebuild itself and lose every already-measured field
             state = None
-            p_re = lm.params if lm.params is not None else jax.device_get(lm.init_params(0))
-            state = jax.tree.map(
-                lambda x, s: jax.device_put(x, s),
-                create_train_state(shard_params(p_re, mesh), tx),
-                sh,
-            )
+            state, _ = _fresh_state(grad_compression)
 
     # health-telemetry overhead: the SAME step compiled with the in-graph
     # numerics (param norm, per-bucket update ratios, non-finite counts —
@@ -1762,6 +1808,133 @@ def main() -> None:
         msg = f"optim A/B skipped (headline already {resolved_optim}; fused needs TPU or --optim-impl fused)"
         print(f"bench: {msg}", file=sys.stderr)
         skipped_passes.append(msg)
+
+    # grad-compression A/B: the step rebuilt with --grad-compression int8
+    # (ops/quant_collectives.py: per-worker partial grads, s8 wire, error
+    # feedback) vs off, SAME session/shapes/seed.  Both arms restart from
+    # an identical fresh init so the loss trajectories are comparable;
+    # the byte delta comes from the compiled programs' collective
+    # accounts (the same classifier the obs gauges use).  Measured
+    # per-collective ms + achieved bytes/sec ride the trainer-loop
+    # bench's profiled device account (BENCH_DEVICE_PROFILE) — on CPU
+    # rounds that capture is auto-skipped, so the A/B stamps the static
+    # byte verdict and the TPU round upgrades it to measured bandwidth.
+    ab_steps = max(2, int(os.environ.get("BENCH_GRAD_COMPRESSION_STEPS", "4")))
+    comp_modes = ("off", "int8")
+    if os.environ.get("BENCH_GRAD_COMPRESSION_AB", "1") == "0":
+        msg = "grad-compression A/B skipped (BENCH_GRAD_COMPRESSION_AB=0)"
+        print(f"bench: {msg}", file=sys.stderr)
+        skipped_passes.append(msg)
+    elif batch % max(1, grad_workers):
+        msg = (
+            f"grad-compression A/B skipped (batch {batch} not divisible "
+            f"by {grad_workers} worker groups)"
+        )
+        print(f"bench: {msg}", file=sys.stderr)
+        skipped_passes.append(msg)
+    elif not over_budget("grad-compression A/B", 3 * est_step_pass):
+        try:
+            from distributed_llms_example_tpu.analysis.ir_lint import (
+                quantized_gradient_census,
+            )
+            from distributed_llms_example_tpu.obs.gauges import (
+                collective_traffic as _ctraffic,
+            )
+
+            # counts need SHAPES only — never materialize params for them
+            a_params = jax.eval_shape(lambda: lm.init_params(0))
+            leaf_counts = [
+                int(np.prod(x.shape)) for x in jax.tree.leaves(a_params)
+            ]
+            # the int8 arm needs partitionable threefry (see the headline
+            # guard above); restore the process default afterwards so the
+            # dropout add-ons below keep their established bit streams
+            _tf_prev = jax.config.jax_threefry_partitionable
+
+            def _comp_arm(mode: str) -> dict:
+                st, _shm = _fresh_state(mode)
+                build_c = make_train_step(
+                    lm.module, lm.config, tx, schedule, mesh,
+                    optim_spec=optim_spec, optim_impl=optim_impl,
+                    grad_compression=mode,
+                )
+                step_c, _ = build_c(st)
+                losses = []
+                for _ in range(ab_steps):
+                    st, m = step_c(st, gb)
+                    losses.append(sync(st, m))
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    st, m = step_c(st, gb)
+                sync(st, m)
+                dtc = time.perf_counter() - t0
+                from distributed_llms_example_tpu.parallel.activation import (
+                    activation_mesh as _amesh,
+                )
+
+                with _amesh(step_c.mesh):
+                    text = step_c.jitted.lower(st, gb).compile().as_text()
+                from distributed_llms_example_tpu.analysis.ir_lint import (
+                    parse_hlo_instructions as _parse,
+                )
+
+                instrs = _parse(text)
+                comm_c = _ctraffic(instrs, leaf_counts, n_chips)
+                census = quantized_gradient_census(
+                    instrs, leaf_counts, dict(mesh.shape)
+                )
+                del st
+                return {
+                    "losses": losses,
+                    "tokens_per_sec_chip": round(tokens_per_step * steps / dtc / n_chips, 1),
+                    "gradient_bytes_per_step": int(comm_c["gradient_bytes"]),
+                    "gradient_wire_bytes": int(census["gradient_wire_bytes"]),
+                    "s8_gradient_collectives": len(census["s8_gradient_collectives"]),
+                }
+
+            try:
+                jax.config.update("jax_threefry_partitionable", True)
+                arms = {m: _comp_arm(m) for m in comp_modes}
+            finally:
+                jax.config.update("jax_threefry_partitionable", _tf_prev)
+            delta = max(
+                abs(a - b)
+                for a, b in zip(arms["off"]["losses"], arms["int8"]["losses"])
+            )
+            off_b = max(1, arms["off"]["gradient_bytes_per_step"])
+            int8_b = max(1, arms["int8"]["gradient_bytes_per_step"])
+            off_w = max(1, arms["off"]["gradient_wire_bytes"])
+            int8_w = max(1, arms["int8"]["gradient_wire_bytes"])
+            result["grad_compression_ab"] = {
+                "steps": ab_steps,
+                "workers": grad_workers,
+                "off_tokens_per_sec_chip": arms["off"]["tokens_per_sec_chip"],
+                "int8_tokens_per_sec_chip": arms["int8"]["tokens_per_sec_chip"],
+                # >1.0 = compression won wall-clock (expect <1 on CPU: the
+                # wire it saves is free there and the quantize math is not)
+                "int8_vs_off": round(
+                    arms["int8"]["tokens_per_sec_chip"]
+                    / max(arms["off"]["tokens_per_sec_chip"], 1e-9), 3,
+                ),
+                "loss_max_abs_delta": round(delta, 6),
+                "loss_final_off": round(arms["off"]["losses"][-1], 6),
+                "loss_final_int8": round(arms["int8"]["losses"][-1], 6),
+                "gradient_bytes_per_step": {"off": off_b, "int8": int8_b},
+                "gradient_bytes_ratio": round(off_b / int8_b, 2),
+                "gradient_wire_bytes": {"off": off_w, "int8": int8_w},
+                "gradient_wire_ratio": round(off_w / int8_w, 2),
+                "s8_gradient_collectives": arms["int8"]["s8_gradient_collectives"],
+                # on profiled rounds the measured per-collective ms +
+                # achieved bytes/sec live in trainer_loop.device_account
+                # (PR 11); CPU rounds auto-skip that capture, so this A/B
+                # carries the static byte verdict only
+                "measured_bandwidth": "see trainer_loop.device_account "
+                                      "(profiled rounds)",
+            }
+            emit_result()
+        except Exception as e:
+            print(f"bench: grad-compression A/B failed ({e})", file=sys.stderr)
+            skipped_passes.append(f"grad-compression A/B failed ({str(e)[:200]})")
 
     # The Trainer trains with the model's real dropout (bart-large-cnn:
     # 0.1, the reference's recipe) while the headline synthetic step runs

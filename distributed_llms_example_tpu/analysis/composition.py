@@ -27,6 +27,8 @@ experts), ``fused_ce`` (--fused-ce), ``ring`` (--attention-impl ring),
 (--grad-accum-steps > 1 — the in-step scan accumulation),
 ``fused_optim`` (an EXPLICIT --optim-impl fused; ``auto`` never sets
 the flag because it resolves to xla wherever fused cannot run),
+``grad_compression`` (--grad-compression int8 — the quantized gradient
+collectives of ops/quant_collectives.py),
 ``decode`` (the KV-cache serving workload: prefill/decode split +
 continuous batching — serving/engine.py and the Evaluator's split
 path).
@@ -105,6 +107,30 @@ KNOWN_BAD: tuple[BadCombo, ...] = (
             "memory trade for pure scan overhead; raise "
             "--pipeline-microbatches instead (the step owns accumulation "
             "on GSPMD meshes, the pipeline owns it under stage>1)"
+        ),
+    ),
+    BadCombo(
+        id="grad-compression-pipelined",
+        flags=("grad_compression", "pipelined"),
+        reason=(
+            "--grad-compression int8 does not compose with stage>1 "
+            "pipelines: the pipeline executors own their communication "
+            "schedules (microbatch hops over the stage ring, their own "
+            "gradient flow inside fused 1f1b schedules) — the replica-"
+            "tiled backward the compression wraps has no seam there; run "
+            "compression on GSPMD (data/fsdp/tensor) meshes"
+        ),
+    ),
+    BadCombo(
+        id="grad-compression-sequence",
+        flags=("grad_compression",),
+        axes_over_1=("sequence",),
+        reason=(
+            "--grad-compression int8 does not compose with sequence "
+            "parallelism: ring attention runs as fully-manual shard_map "
+            "regions that do not nest inside the replica-tiled backward "
+            "(the vmapped per-worker value_and_grad clears the ambient "
+            "mesh); drop the sequence axis or the compression flag"
         ),
     ),
     BadCombo(
@@ -260,6 +286,27 @@ KNOWN_GOOD: tuple[GoodCombo, ...] = (
               "the 8-device mesh (tests/test_fused_optim.py)",
     ),
     GoodCombo(
+        id="grad-compression-gspmd",
+        flags=("grad_compression",),
+        axes=("data", "fsdp", "tensor"),
+        notes="int8 quantized gradient collectives (ops/quant_collectives"
+              ".py): per-worker partial grads tiled over the data axis, "
+              "s8 all-to-all/all-gather wire, int-safe partial sums, "
+              "error feedback in TrainState.ef; pinned on the 8-device "
+              "data x fsdp x tensor mesh (tests/test_quant_collectives.py)",
+    ),
+    GoodCombo(
+        id="grad-compression-accum",
+        flags=("grad_compression", "grad_accum"),
+        axes=("data", "fsdp", "tensor"),
+        notes="compression x in-step grad accumulation: the scan "
+              "accumulates fp32 TILED partial sums and the quantized "
+              "reduction + error feedback apply ONCE at the optimizer-"
+              "step boundary, after the microbatch accumulation — the "
+              "once-per-step placement census covers the reduction's "
+              "source spans, so the compiled program proves it",
+    ),
+    GoodCombo(
         id="sequence-parallel-unpipelined",
         axes=("data", "fsdp", "sequence", "tensor"),
         notes="ring/context parallelism without stages (all families)",
@@ -304,6 +351,7 @@ def config_flags(
     num_experts: int = 0,
     grad_accum_steps: int = 1,
     optim_impl: str = "",
+    grad_compression: str = "",
 ) -> set[str]:
     """Derive the composition-matrix flags from run configuration — the
     ONE mapping from config knobs to table flags, shared by the Trainer's
@@ -318,6 +366,8 @@ def config_flags(
         flags.add("moe")
     if grad_accum_steps > 1:
         flags.add("grad_accum")
+    if grad_compression and grad_compression != "off":
+        flags.add("grad_compression")
     if optim_impl == "fused":
         # ONLY the explicit force: "auto" resolves to xla wherever fused
         # cannot run, so it must never trip the known-bad row

@@ -278,6 +278,88 @@ def model_tree_element_candidates(
     return out
 
 
+# Wire-byte weights for the census estimate: a ring all-reduce moves ~2x
+# its tensor bytes per device (a reduce-scatter phase plus an all-gather
+# phase); reduce-scatter / all-gather / all-to-all / permute move ~1x the
+# instruction's output bytes.  An ESTIMATE of relative wire cost from the
+# instruction census — the device account (obs/devprof.py) measures the
+# real thing; this exists so a compression A/B can be judged on bytes
+# actually moved rather than on output-buffer sizes (an all-gather's
+# output is W x what it moved).
+_WIRE_WEIGHT = {"all-reduce": 2.0}
+
+
+def quantized_gradient_census(
+    instrs: Mapping[str, HloInstr],
+    param_element_counts: Iterable[int],
+    mesh_axes: Mapping[str, int],
+) -> dict[str, Any]:
+    """Census of GRADIENT-classified collectives split by element width —
+    the compiled-program proof of ``--grad-compression int8``: the
+    quantized program's gradient reduction rides s8 tensors (the
+    quantize-reduce-dequantize wrapper's all-to-all / all-gather legs)
+    where the fp32 program rode f32.  Returns per-dtype byte totals, the
+    s8 instruction names, and ``gradient_wire_bytes`` (the
+    direction-weighted estimate above) — ``tests`` and the obs gate
+    compare it between the off and int8 programs (~4x on the replica
+    leg).  Classification (element count matches a model-tree leaf or an
+    even shard of one) is the SAME candidate set the byte account uses,
+    so the two can never disagree."""
+    mesh_size = 1
+    for v in mesh_axes.values():
+        mesh_size *= max(1, int(v))
+    candidates = model_tree_element_candidates(param_element_counts, mesh_size)
+    by_dtype: dict[str, int] = {}
+    wire = 0.0
+    s8_names: list[str] = []
+    for name, instr in instrs.items():
+        if instr.op not in _COLLECTIVE_OPS:
+            continue
+        touched = {instr.elems} | {
+            instrs[o].elems for o in instr.operands if o in instrs
+        }
+        if not (touched & candidates):
+            continue
+        by_dtype[instr.dtype] = by_dtype.get(instr.dtype, 0) + instr.bytes
+        base = instr.op[: -len("-start")] if instr.op.endswith("-start") else instr.op
+        wire += _WIRE_WEIGHT.get(base, 1.0) * instr.bytes
+        if instr.dtype == "s8":
+            s8_names.append(name)
+    return {
+        "gradient_bytes_by_dtype": by_dtype,
+        "gradient_wire_bytes": int(wire),
+        "s8_gradient_collectives": s8_names,
+    }
+
+
+def int8_compression_missing_finding(
+    census: Mapping[str, Any], grad_compression: str
+) -> Finding | None:
+    """Error when a program built with ``--grad-compression int8``
+    carries NO s8 gradient collective: the partitioner folded the wire
+    back to fp32 (a hoisted reshard, a dropped pin) and the run would
+    silently pay uncompressed traffic while stamping itself compressed —
+    the lint-time twin of ``scripts/obs_gate.py
+    --max-gradient-bytes-per-step``."""
+    if grad_compression != "int8":
+        return None
+    if census.get("s8_gradient_collectives"):
+        return None
+    return Finding(
+        severity="error",
+        pass_name="ir",
+        code="int8-compression-missing",
+        message=(
+            "the step was built with --grad-compression int8 but the "
+            "compiled program contains no s8 gradient collective — the "
+            "partitioner folded the quantized wire back to fp32 (hoisted "
+            "reshard or dropped sharding pin); the run would pay full "
+            f"fp32 gradient traffic ({census.get('gradient_bytes_by_dtype')})"
+        ),
+        context=dict(census),
+    )
+
+
 def account_gradient_bytes_by_op(account: Mapping[str, Any]) -> dict[str, int]:
     """Adapter: the obs collective-traffic account (obs/gauges.py
     ``collective_traffic`` — per-op dicts with ``gradient_bytes``) →
@@ -749,6 +831,7 @@ def scan_hlo_text(
     gather_bytes_threshold: int = 16 * 1024**2,
     param_element_counts: Iterable[int] | None = None,
     decode_contract: Mapping[str, int] | None = None,
+    grad_compression: str = "",
 ) -> list[Finding]:
     """Scan post-optimization HLO text.  Pure function of the text.
 
@@ -924,6 +1007,15 @@ def scan_hlo_text(
             if touched & candidates:
                 grad_bytes[instr.op] = grad_bytes.get(instr.op, 0) + instr.bytes
         context["gradient_bytes_by_op"] = grad_bytes
+        # element-width split of the same classification (the int8
+        # compression proof) + the direction-weighted wire estimate
+        quant_census = quantized_gradient_census(
+            instrs, param_element_counts, mesh_axes
+        )
+        context.update(quant_census)
+        missing = int8_compression_missing_finding(quant_census, grad_compression)
+        if missing is not None:
+            findings.append(missing)
         smell = reduce_scatter_smell(grad_bytes, mesh_axes)
         if smell is not None:
             findings.append(smell)
@@ -951,6 +1043,7 @@ def lint_train_step(
     remat: bool = False,
     grad_accum_steps: int = 1,
     optim_impl: str = "",
+    grad_compression: str = "",
     gather_bytes_threshold: int = 16 * 1024**2,
 ) -> list[Finding]:
     """AOT-compile the sharded train step from abstract args and scan it.
@@ -981,7 +1074,7 @@ def lint_train_step(
         model_name, mesh,
         global_batch=global_batch, src_len=src_len, tgt_len=tgt_len,
         dtype=dtype, remat=remat, grad_accum_steps=grad_accum_steps,
-        optim_impl=optim_impl,
+        optim_impl=optim_impl, grad_compression=grad_compression,
     )
     text = compiled.as_text()
     leaves = jax.tree.leaves(a_params)
@@ -997,6 +1090,7 @@ def lint_train_step(
         largest_param_bytes=largest_param,
         gather_bytes_threshold=gather_bytes_threshold,
         param_element_counts=[int(math.prod(x.shape)) for x in leaves],
+        grad_compression=grad_compression,
     )
     if grad_accum_steps > 1 or optim_impl:
         from distributed_llms_example_tpu.train.step import (

@@ -72,6 +72,7 @@ def run_passes(
     fused_ce: bool = False,
     attention_impl: str = "",
     optim_impl: str = "",
+    grad_compression: str = "",
     replicated_bytes_threshold: int = spec_lint.DEFAULT_REPLICATED_BYTES_THRESHOLD,
     run_ir: bool = True,
     global_batch: int = 8,
@@ -114,6 +115,12 @@ def run_passes(
     findings += spec_lint.lint_optimizer_moment_mirror(
         a_params, rules if rules is not None else default_rules()
     )
+    # the grad-compression layout contract: every error-feedback leaf is
+    # the param spec with the worker dim prefixed over the replica axes
+    # (ops/quant_collectives.py error_feedback_specs)
+    findings += spec_lint.lint_error_feedback_mirror(
+        a_params, rules if rules is not None else default_rules()
+    )
 
     # Serving passes (--serve): the KV-cache rule set validated like the
     # param rules, over the abstract decode cache — plus the decode rows
@@ -135,6 +142,30 @@ def run_passes(
             axis_sizes,
         )
 
+    # grad-compression needs a replica leg to compress: workers == 1
+    # means every step pays quantization noise and a params-sized fp32
+    # residual for zero wire savings — reported HERE (and the ir pass
+    # stands down below on the error) instead of as a misleading
+    # int8-compression-missing on a program that was never wrong
+    if grad_compression and grad_compression != "off":
+        from distributed_llms_example_tpu.ops.quant_collectives import (
+            GRAD_WORKER_AXES,
+            worker_count,
+        )
+
+        if worker_count(axis_sizes) <= 1:
+            findings.append(Finding(
+                severity="error",
+                pass_name="spec",
+                code="grad-compression-no-replica-axis",
+                message=(
+                    f"--grad-compression int8 needs a replica axis > 1 "
+                    f"(mesh axes {GRAD_WORKER_AXES} on {axis_sizes} give "
+                    "1 worker group): there is no cross-replica gradient "
+                    "leg to compress — drop the flag or add a data axis"
+                ),
+            ))
+
     # Pass 3 — composition matrix (cheap; run before the compile pass so a
     # known-crash combo is reported even when the compile would die)
     pipelined = axis_sizes.get("stage", 1) > 1
@@ -149,6 +180,7 @@ def run_passes(
             num_experts=int(getattr(lm.config, "num_experts", 0) or 0),
             grad_accum_steps=grad_accum_steps,
             optim_impl=optim_impl,
+            grad_compression=grad_compression,
         ) | set(serve_flags),
     )
 
@@ -186,6 +218,7 @@ def run_passes(
                 remat=remat,
                 grad_accum_steps=grad_accum_steps,
                 optim_impl=optim_impl,
+                grad_compression=grad_compression,
             )
             if serve:
                 # the compiled SERVING decode step: no encoder recompute,
@@ -211,6 +244,7 @@ def startup_lint(cfg: Any) -> list[Finding]:
         fused_ce=cfg.fused_ce,
         attention_impl=cfg.attention_impl,
         optim_impl=cfg.optim_impl,
+        grad_compression=getattr(cfg, "grad_compression", ""),
         run_ir=False,
         dtype=cfg.compute_dtype,
         remat=cfg.remat,
@@ -237,6 +271,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "'fused' additionally checks the in-place contract "
                         "(no f32 param-sized copies in the apply spans) on "
                         "the compiled program")
+    p.add_argument("--grad-compression", type=str, default="",
+                   choices=("", "off", "int8"),
+                   help="lint the step built with this gradient-collective "
+                        "compression; 'int8' additionally asserts the "
+                        "compiled program carries s8 gradient collectives "
+                        "and checks the error-feedback sharding contract")
     p.add_argument("--rules-json", type=str, default="",
                    help='lint this rule set instead of the defaults: '
                         '[["pattern", ["fsdp", null]], ...]')
@@ -288,6 +328,7 @@ def main(argv: list[str] | None = None) -> int:
             fused_ce=args.fused_ce,
             attention_impl=args.attention_impl,
             optim_impl=args.optim_impl,
+            grad_compression=args.grad_compression,
             replicated_bytes_threshold=args.replicated_bytes_threshold,
             run_ir=not args.no_ir,
             global_batch=args.batch,

@@ -120,6 +120,89 @@ def lint_accumulator_mirror(params: Any, rules: Any = None) -> list[Finding]:
     return findings
 
 
+def lint_error_feedback_mirror(params: Any, rules: Any = None) -> list[Finding]:
+    """The grad-compression layout contract (``--grad-compression int8``,
+    ``ops/quant_collectives.py``): every error-feedback leaf is the
+    param's spec with the worker dim prefixed over the replica axes —
+    ``P(GRAD_WORKER_AXES, *param_spec)`` — i.e. the inner dims mirror the
+    params EXACTLY, leaf for leaf, like the grad-accum carry.  This pass
+    feeds the live ``error_feedback_specs`` function the params' resolved
+    specs and errors on any leaf whose inner spec drifts from its param's
+    (a drifted EF replicates a param-sized fp32 residual per device, or
+    forces GSPMD to reshard the residual against the tiled gradients
+    every step) or whose worker prefix is not the replica axes (the
+    residual would shard over a model axis and stop being per-worker).
+    Device-free: specs only, no mesh."""
+    import jax.tree_util as jtu
+
+    from distributed_llms_example_tpu.ops.quant_collectives import (
+        GRAD_WORKER_AXES,
+        error_feedback_specs,
+    )
+    from distributed_llms_example_tpu.parallel.sharding import _path_str
+
+    if rules is None:
+        from distributed_llms_example_tpu.parallel.sharding import default_rules
+
+        rules = default_rules()
+
+    paths: list[str] = []
+    specs: list[Any] = []
+    jtu.tree_map_with_path(
+        lambda path, x: (
+            paths.append(_path_str(path)),
+            specs.append(rules.spec_for(_path_str(path), len(getattr(x, "shape", ())))),
+        )
+        and None,
+        params,
+    )
+    param_spec_tree = jtu.tree_unflatten(jtu.tree_structure(params), specs)
+    ef_leaves = jtu.tree_leaves(error_feedback_specs(param_spec_tree))
+    findings: list[Finding] = []
+    if len(ef_leaves) != len(specs):
+        return [
+            Finding(
+                severity="error",
+                pass_name="spec",
+                code="error-feedback-tree-mismatch",
+                message=(
+                    f"error_feedback_specs returned {len(ef_leaves)} leaves "
+                    f"for a {len(specs)}-leaf param tree — the EF tree no "
+                    "longer mirrors the params"
+                ),
+            )
+        ]
+    want_prefix = (
+        GRAD_WORKER_AXES[0] if len(GRAD_WORKER_AXES) == 1 else GRAD_WORKER_AXES
+    )
+    for path, pspec, ef in zip(paths, specs, ef_leaves):
+        prefix = ef[0] if len(ef) else None
+        inner = tuple(ef[1:])
+        if prefix != want_prefix or inner != tuple(pspec):
+            findings.append(
+                Finding(
+                    severity="error",
+                    pass_name="spec",
+                    code="error-feedback-spec-mismatch",
+                    message=(
+                        f"{path}: error-feedback spec {ef} does not mirror "
+                        f"the param spec {pspec} under the "
+                        f"{GRAD_WORKER_AXES} worker prefix — the EF tree "
+                        "must be the param layout with the worker dim over "
+                        "the replica axes (anything else replicates the "
+                        "fp32 residual per device or re-shards it against "
+                        "the tiled gradients every step)"
+                    ),
+                    context={
+                        "param": path,
+                        "param_spec": str(pspec),
+                        "ef_spec": str(ef),
+                    },
+                )
+            )
+    return findings
+
+
 def lint_optimizer_moment_mirror(params: Any, rules: Any = None) -> list[Finding]:
     """The fused-optimizer layout contract (``ops/fused_optim.py``): the
     AdamW moments' resolved specs must equal the param specs, leaf for

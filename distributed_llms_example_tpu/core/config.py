@@ -162,6 +162,17 @@ class TrainConfig:
     # Pipelined (stage>1) runs always use xla; --optim-impl fused there
     # is a composition-matrix error.
     optim_impl: str = "auto"
+    # gradient-collective compression (ops/quant_collectives.py): "off"
+    # (default — the compiled step is bit-identical to the uncompressed
+    # path) or "int8" — the cross-replica (data-axis) gradient reduction
+    # runs as block-int8 with stochastic rounding, int-safe integer
+    # partial sums on an s8 wire (~4x fewer gradient wire bytes, per
+    # EQuARX arXiv:2506.17615), and a per-worker fp32 error-feedback
+    # tree carried in TrainState (checkpointed; resume from an
+    # uncompressed checkpoint zero-fills it).  Composes with grad
+    # accumulation; stage>1 pipelines and sequence parallelism are
+    # composition-matrix errors.
+    grad_compression: str = "off"
     remat: bool = False  # jax.checkpoint the transformer blocks
     remat_policy: str = "full"  # "full" | "dots" (utils/remat.py)
     # microbatches per pipeline tick when mesh stage>1 (0 → stage count);
@@ -373,6 +384,16 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
              "same pass; optax chain elsewhere), fused or xla to force. "
              "Same op sequence either way (equal up to XLA float "
              "contraction); checkpoints roam between impls",
+    )
+    p.add_argument(
+        "--grad-compression", type=str, default=_D.grad_compression,
+        choices=("off", "int8"),
+        help="gradient-collective compression: off (bit-identical to the "
+             "uncompressed step) or int8 — the cross-replica gradient "
+             "reduction rides an s8 wire (block quantization, stochastic "
+             "rounding, integer partial sums) with a checkpointed "
+             "error-feedback tree; ~4x fewer gradient wire bytes "
+             "(ops/quant_collectives.py)",
     )
     p.add_argument("--remat-policy", type=str, default=_D.remat_policy, choices=REMAT_POLICIES)
     p.add_argument("--pipeline-microbatches", type=int, default=_D.pipeline_microbatches)

@@ -46,6 +46,12 @@ identical op sequence (equal up to XLA float contraction) and write the
 SAME optax opt-state pytree, so checkpoints roam between them; the
 resolved impl is logged as an ``optim_config`` event at startup.
 
+Gradient compression: ``--grad-compression off|int8`` (off = compiled
+step bit-identical to the uncompressed path; int8 = the cross-replica
+gradient reduction on an s8 wire with stochastic rounding, int-safe
+partial sums and a checkpointed error-feedback tree — see README
+"Gradient compression").
+
 Training health: ``--health`` (auto under ``--obs jsonl``) makes the
 compiled step return in-graph numerics (param norm, per-bucket update
 ratios, non-finite counts — zero extra device syncs) and arms the

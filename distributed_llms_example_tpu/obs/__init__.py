@@ -228,6 +228,7 @@ class TrainerObs:
                     remat=cfg.remat,
                     remat_policy=cfg.remat_policy,
                     grad_accum_steps=cfg.grad_accum_steps,
+                    grad_compression=getattr(cfg, "grad_compression", ""),
                 )
         except Exception as e:  # never fail training for telemetry
             sink_mod.emit({

@@ -147,6 +147,7 @@ def train_step_static_gauges(
     remat: bool = False,
     remat_policy: str = "full",
     grad_accum_steps: int = 1,
+    grad_compression: str = "",
 ) -> dict:
     """AOT-compile the train step (the shared recipe the memory audit and
     IR lint use — utils/memory_audit.py) and derive the static gauges:
@@ -168,6 +169,7 @@ def train_step_static_gauges(
         remat=remat,
         remat_policy=remat_policy,
         grad_accum_steps=grad_accum_steps,
+        grad_compression=grad_compression,
     )
     leaves = jax.tree.leaves(a_params)
     n_params = int(sum(int(math.prod(x.shape)) for x in leaves))
@@ -213,6 +215,9 @@ def train_step_static_gauges(
         "mesh": dict(mesh.shape),
         "global_batch": global_batch,
         "grad_accum_steps": int(grad_accum_steps),
+        # stamped so the byte account reads in context: an s8-dominated
+        # gradient account is correct under int8 and a bug under off
+        "grad_compression": grad_compression or "off",
         "params": n_params,
         "tokens_per_step": tokens_per_step,
         "flops_per_step": flops,

@@ -251,6 +251,7 @@ def comm_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
                 "mesh": r.get("mesh"),
                 "flops_per_step": r.get("flops_per_step"),
                 "flops_source": r.get("flops_source"),
+                "grad_compression": r.get("grad_compression"),
                 "comm": comm,
             }
             from distributed_llms_example_tpu.analysis.ir_lint import (
@@ -883,6 +884,16 @@ def main(argv: list[str] | None = None) -> int:
              "must never read as a pass",
     )
     p.add_argument(
+        "--max-gradient-bytes-per-step", type=float, default=0.0,
+        help="with --strict: fail when the startup gauges' collective "
+             "byte account (obs_gauges.comm.gradient_bytes) exceeds this "
+             "ceiling, or when NO obs_gauges record exists (0 = no "
+             "ceiling) — the compression gate: a run that silently loses "
+             "--grad-compression (flag ignored, partitioner folded the "
+             "wire back to fp32) fails here instead of passing on "
+             "wall-clock luck",
+    )
+    p.add_argument(
         "--trace", type=str, default="",
         help="also export the merged Chrome-trace/Perfetto JSON here "
              "(every rank's spans aligned on shared step boundaries, "
@@ -925,6 +936,29 @@ def main(argv: list[str] | None = None) -> int:
                 print(
                     f"strict: dispatch_efficiency {eff} below the "
                     f"{floor} floor", file=sys.stderr,
+                )
+                rc = 1
+        grad_ceiling = args.max_gradient_bytes_per_step
+        if grad_ceiling > 0:
+            comm = report.get("comm")
+            worst = None
+            if comm is not None and isinstance(comm.get("comm"), dict):
+                worst = float(comm["comm"].get("gradient_bytes", 0))
+            if worst is None:
+                print(
+                    "strict: --max-gradient-bytes-per-step set but no "
+                    "obs_gauges byte account found (run with --obs-gauges "
+                    "on) — a missing measurement must never read as a pass",
+                    file=sys.stderr,
+                )
+                rc = 1
+            elif worst > grad_ceiling:
+                print(
+                    f"strict: gradient_bytes per step {worst:.0f} exceeds "
+                    f"the {grad_ceiling:.0f} ceiling — compression lost or "
+                    "never engaged (check grad_compression in the "
+                    "obs_gauges record)",
+                    file=sys.stderr,
                 )
                 rc = 1
         ov_floor = args.min_overlap_frac

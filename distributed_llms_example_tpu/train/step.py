@@ -62,9 +62,20 @@ from distributed_llms_example_tpu.parallel.sharding import (
 
 @flax.struct.dataclass
 class TrainState:
+    """step / params / opt_state, plus ``ef`` — the error-feedback tree of
+    ``--grad-compression int8`` (``ops/quant_collectives.py``): per-leaf
+    ``(W, *shape)`` fp32 quantization residuals, worker dim over the
+    replica axes, inner dims sharded exactly like the params.  ``None``
+    whenever compression is off (the default), which keeps the off path's
+    compiled program bit-identical to the pre-compression step.  Carried
+    in the state so checkpoints resume it; a checkpoint written without
+    it (older run, or compression off) resumes with a zero-filled tree —
+    step 0 semantics, no error to feed back yet."""
+
     step: jnp.ndarray
     params: Any
     opt_state: Any
+    ef: Any = None
 
 
 # ---------------------------------------------------------------------------
@@ -146,8 +157,27 @@ def health_metrics(params: Any, grads: Any, updates: Any) -> dict[str, jnp.ndarr
     return out
 
 
-def create_train_state(params: Any, tx: optax.GradientTransformation) -> TrainState:
-    return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+def create_train_state(
+    params: Any,
+    tx: optax.GradientTransformation,
+    *,
+    grad_compression: str = "off",
+    workers: int = 1,
+) -> TrainState:
+    """``grad_compression="int8"`` additionally allocates the zero
+    error-feedback tree (``workers`` = the replica-axis product — see
+    ``ops/quant_collectives.py worker_count``)."""
+    ef = None
+    if grad_compression == "int8":
+        from distributed_llms_example_tpu.ops.quant_collectives import (
+            zero_error_feedback,
+        )
+
+        ef = zero_error_feedback(params, workers)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params), ef=ef,
+    )
 
 
 def accumulator_shardings(param_shardings: Any) -> Any:
@@ -205,6 +235,7 @@ def optimizer_apply_block(
     *,
     health: bool,
     fused: Any = None,
+    ef: Any = None,
 ) -> tuple[TrainState, dict]:
     """The once-per-optimizer-step tail: normalize the token-weighted
     sums, clip + AdamW, and the health numerics.
@@ -247,7 +278,9 @@ def optimizer_apply_block(
         health_vals = (
             health_metrics(state.params, grads, updates) if health else None
         )
-    new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
+    new_state = TrainState(
+        step=state.step + 1, params=new_params, opt_state=new_opt, ef=ef,
+    )
     metrics = {
         "loss": loss,
         "learning_rate": schedule(state.step),
@@ -272,7 +305,7 @@ def once_per_step_source_spans() -> list[tuple[str, int, int]]:
     ``ir_lint.once_per_step_placement``."""
     import inspect
 
-    from distributed_llms_example_tpu.ops import fused_optim
+    from distributed_llms_example_tpu.ops import fused_optim, quant_collectives
     from distributed_llms_example_tpu.train import optim as optim_mod
 
     spans = []
@@ -288,6 +321,16 @@ def once_per_step_source_spans() -> list[tuple[str, int, int]]:
         fused_optim.adamw_leaf_reference,
         fused_optim._adamw_kernel,
         fused_optim._sharded_leaf,
+        # the quantized gradient reduction (--grad-compression int8) runs
+        # once per optimizer step, at the boundary AFTER the microbatch
+        # scan — covering its frames lets the placement census prove it
+        # never slid into the accumulation loop (the grad-compression-accum
+        # composition contract)
+        quant_collectives.quantized_tree_reduce,
+        quant_collectives._reduce_one_leaf,
+        quant_collectives.quantize_blocks,
+        quant_collectives.dequantize_blocks,
+        quant_collectives.stochastic_round,
     )
     for fn in fns:
         lines, first = inspect.getsourcelines(fn)
@@ -400,8 +443,26 @@ def state_shardings(state: Any, mesh: Mesh, rules: ShardingRules | None = None) 
     """Shardings for a TrainState (or any pytree): param-rule regexes applied
     to every leaf path — optimizer moments mirror the param tree (their
     paths end with the param path, which the regex rules match), scalars
-    fall through to replicated."""
-    return resolve_shardings(state, mesh, rules)
+    fall through to replicated.
+
+    The error-feedback tree (``--grad-compression int8``) is the one
+    subtree the path rules CANNOT resolve: its leaves carry a leading
+    worker dim, so a param rule's spec would land on the wrong ranks.  It
+    gets the tiled layout instead — worker dim over the replica axes,
+    inner dims exactly the param shardings
+    (``ops/quant_collectives.py error_feedback_shardings``)."""
+    ef = getattr(state, "ef", None)
+    if ef is None or not hasattr(state, "replace"):
+        return resolve_shardings(state, mesh, rules)
+    # resolve WITHOUT the ef subtree (a param rule matching "ef/<path>"
+    # at the tiled rank would log spurious ragged-dim fallbacks), then
+    # attach the tiled layout
+    from distributed_llms_example_tpu.ops.quant_collectives import (
+        error_feedback_shardings,
+    )
+
+    sh = resolve_shardings(state.replace(ef=None), mesh, rules)
+    return sh.replace(ef=error_feedback_shardings(sh.params, mesh))
 
 
 def make_train_step(
@@ -421,8 +482,25 @@ def make_train_step(
     health: bool = False,
     optim_spec: Any = None,
     optim_impl: str | None = None,
+    grad_compression: str = "off",
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch[, rng]) → (state, metrics).
+
+    ``grad_compression`` (``--grad-compression``): ``"off"`` (default —
+    the code path is untouched, the compiled program bit-identical to the
+    pre-compression step) or ``"int8"`` — the gradient tree's
+    cross-replica reduction runs through ``ops/quant_collectives.py``:
+    per-worker partial grads (``value_and_grad`` vmapped over shard-local
+    batch groups along the ``data`` axis, the fsdp/tensor legs inside
+    each group staying GSPMD's in fp32), block-int8 quantization with
+    stochastic rounding off the step RNG, int-safe integer partial sums
+    on an s8 wire, and the per-worker error-feedback tree carried in
+    ``TrainState.ef`` (callers allocate it via
+    ``create_train_state(..., grad_compression="int8", workers=W)``).
+    Composes with in-step grad accumulation — the scan accumulates fp32
+    TILED partial sums and the quantized reduction runs once at the
+    optimizer-step boundary; stage>1 pipelines and sequence parallelism
+    are composition-matrix errors.
 
     ``optim_spec`` (a ``train.optim.OptimizerSpec`` describing ``tx``)
     plus ``optim_impl`` (``--optim-impl``; None follows the process
@@ -457,12 +535,25 @@ def make_train_step(
         from distributed_llms_example_tpu.analysis.composition import reason_for
 
         raise ValueError(reason_for("grad-accum-pipelined"))
+    if grad_compression not in ("off", "int8"):
+        raise ValueError(
+            f"grad_compression must be 'off' or 'int8', got {grad_compression!r}"
+        )
+    compress = grad_compression == "int8"
+    if compress and hasattr(model, "num_microbatches"):
+        from distributed_llms_example_tpu.analysis.composition import reason_for
+
+        raise ValueError(reason_for("grad-compression-pipelined"))
     loss_sums = make_loss_fn(model, config, label_smoothing, is_seq2seq=is_seq2seq)
     seq_sharded = (
         sequence_sharded
         if sequence_sharded is not None
         else mesh.shape.get("sequence", 1) > 1
     )
+    if compress and seq_sharded:
+        from distributed_llms_example_tpu.analysis.composition import reason_for
+
+        raise ValueError(reason_for("grad-compression-sequence"))
     micro_sharding = NamedSharding(
         mesh, P(None, ("data", "fsdp", "expert"), "sequence" if seq_sharded else None)
     )
@@ -485,7 +576,78 @@ def make_train_step(
             (lsum, tokens), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
             return lsum, tokens, grads
 
-    def make_step_fn(accum_sh: Any, fused_plan: Any = None) -> Callable:
+    workers = 1
+    if compress:
+        from distributed_llms_example_tpu.ops.quant_collectives import (
+            GRAD_WORKER_AXES,
+            worker_count,
+        )
+
+        base_value_and_grad_sums = value_and_grad_sums
+        workers = worker_count(dict(mesh.shape))
+        if workers <= 1:
+            raise ValueError(
+                f"grad_compression='int8' needs a replica axis > 1 (mesh "
+                f"axes {GRAD_WORKER_AXES} on {dict(mesh.shape)} give 1 "
+                "worker group): with no cross-replica leg there is "
+                "nothing to compress — every step would pay quantization "
+                "noise and a params-sized fp32 residual for zero wire "
+                "savings"
+            )
+        # each worker group's batch rows keep their (fsdp, expert) spread;
+        # the worker dim rides the replica axis.  The (B,) -> (W, B/W)
+        # reshape is a zero-collective relabeling: the combined batch
+        # sharding orders data-major, so every device's rows stay local.
+        tiled_batch_sharding = NamedSharding(
+            mesh, P("data", ("fsdp", "expert"), None)
+        )
+
+        def tiled_value_and_grad_sums(
+            params: Any, batch: dict, rng: jax.Array | None
+        ) -> tuple:
+            """Per-worker partial gradients: (loss sum, token sum, grads
+            tiled ``(W, *shape)``).  The model runs inside ``vmap`` with
+            the ambient mesh CLEARED — its internal activation
+            constraints name the combined batch axes at the un-tiled
+            rank, which would fight the tiled layout; sharding is steered
+            by the explicit input/output pins instead (the same
+            discipline the pipeline adapters use for nested regions)."""
+
+            def regroup(x):
+                if x.shape[0] % workers:
+                    raise ValueError(
+                        f"microbatch {x.shape[0]} is not divisible by the "
+                        f"{workers} grad-compression worker group(s) "
+                        f"(mesh axes {GRAD_WORKER_AXES})"
+                    )
+                return x.reshape(workers, x.shape[0] // workers, *x.shape[1:])
+
+            grouped = jax.tree.map(regroup, batch)
+            grouped = jax.lax.with_sharding_constraint(
+                grouped, jax.tree.map(lambda _: tiled_batch_sharding, batch)
+            )
+            if rng is not None:
+                keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                    jnp.arange(workers)
+                )
+
+                def one(mb, k):
+                    with activation_mesh(None):
+                        return base_value_and_grad_sums(params, mb, k)
+
+                ls, toks, gt = jax.vmap(one)(grouped, keys)
+            else:
+
+                def one(mb):
+                    with activation_mesh(None):
+                        return base_value_and_grad_sums(params, mb, None)
+
+                ls, toks, gt = jax.vmap(one)(grouped)
+            return jnp.sum(ls), jnp.sum(toks), gt
+
+        value_and_grad_sums = tiled_value_and_grad_sums
+
+    def make_step_fn(accum_sh: Any, fused_plan: Any = None, comp_specs: Any = None) -> Callable:
         """The step body, closed over the accumulator shardings (the
         mirror of the param shardings — ``accumulator_shardings``) so the
         scan carry is PINNED to the param layout: under FSDP each
@@ -496,6 +658,12 @@ def make_train_step(
         optimizer tail to the fused Pallas apply (None = optax chain)."""
 
         def step_fn(state: TrainState, batch: dict, rng: jax.Array | None = None) -> tuple[TrainState, dict]:
+            if compress and state.ef is None:
+                raise ValueError(
+                    "grad_compression='int8' needs the error-feedback tree: "
+                    "build the state with create_train_state(..., "
+                    "grad_compression='int8', workers=N)"
+                )
             if grad_accum_steps > 1:
                 b = jax.tree.leaves(batch)[0].shape[0]
                 if b % grad_accum_steps:
@@ -542,7 +710,13 @@ def make_train_step(
                     return (lsum_acc + lsum, tok_acc + tokens, g_acc, i + 1), None
 
                 zero_g = pin(
-                    jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                    jax.tree.map(
+                        lambda p: jnp.zeros(
+                            ((workers,) + p.shape) if compress else p.shape,
+                            jnp.float32,
+                        ),
+                        state.params,
+                    )
                 )
                 (lsum, tokens, grads, _), _ = jax.lax.scan(
                     body,
@@ -551,9 +725,28 @@ def make_train_step(
                 )
             else:
                 lsum, tokens, grads = value_and_grad_sums(state.params, batch, rng)
+            if compress:
+                # the quantized cross-replica reduction, ONCE per optimizer
+                # step (under accumulation the scan above summed fp32 TILED
+                # partials — EF and the s8 wire apply at the step boundary);
+                # stochastic rounding keys off the step RNG, folded with the
+                # step counter so rng-less runs still draw fresh bits
+                from distributed_llms_example_tpu.ops.quant_collectives import (
+                    quantized_tree_reduce,
+                )
+
+                sr_base = rng if rng is not None else jax.random.PRNGKey(0x6e7)
+                sr_key = jax.random.fold_in(
+                    jax.random.fold_in(sr_base, 0x51ab), state.step
+                )
+                grads, new_ef = quantized_tree_reduce(
+                    grads, state.ef, sr_key, mesh=mesh, param_specs=comp_specs,
+                )
+            else:
+                new_ef = state.ef
             return optimizer_apply_block(
                 state, tx, schedule, lsum, tokens, grads, health=health,
-                fused=fused_plan,
+                fused=fused_plan, ef=new_ef,
             )
 
         return step_fn
@@ -578,13 +771,30 @@ def make_train_step(
         # resolution (the --optim-impl dispatch) is the SHARED
         # train/optim.py resolver so the step and the budget probe can
         # never pick different impls
+        comp_specs = None
+        accum_pin_sh = None
+        if grad_accum_steps > 1:
+            accum_pin_sh = accumulator_shardings(state_sh.params)
+        if compress:
+            from distributed_llms_example_tpu.ops.quant_collectives import (
+                error_feedback_shardings,
+            )
+
+            comp_specs = jax.tree.map(
+                lambda sh: getattr(sh, "spec", None), state_sh.params
+            )
+            if grad_accum_steps > 1:
+                # the scan carry holds TILED partial sums: worker dim over
+                # the replica axes, inner dims still the param mirror
+                accum_pin_sh = error_feedback_shardings(state_sh.params, mesh)
         step_fn = make_step_fn(
-            accumulator_shardings(state_sh.params) if grad_accum_steps > 1 else None,
+            accum_pin_sh,
             resolve_fused_plan(
                 optim_spec, optim_impl, tx, state_sh, mesh,
                 abstract_params=abstract_params,
                 pipelined=hasattr(model, "num_microbatches"),
             ),
+            comp_specs,
         )
         in_shardings = (state_sh, {"input_ids": bsh, "attention_mask": bsh, "labels": bsh})
         if with_dropout:
@@ -662,9 +872,14 @@ def make_optimizer_probe(
         new_state, _metrics = optimizer_apply_block(
             state, tx, schedule, jnp.zeros((), jnp.float32),
             jnp.ones((), jnp.float32), grads, health=health, fused=plan,
+            ef=state.ef,
         )
         total = jnp.zeros((), jnp.float32)
-        for leaf in jax.tree.leaves(new_state):
+        # the EF tree only passes THROUGH the apply — folding its W x
+        # params fp32 leaves into the reduction would bill the probe for
+        # reads the real apply never does, inflating optimizer_apply_ms
+        # on compressed runs
+        for leaf in jax.tree.leaves(new_state.replace(ef=None)):
             total = total + jnp.sum(leaf).astype(jnp.float32)
         return total
 

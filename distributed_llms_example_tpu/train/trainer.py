@@ -213,8 +213,61 @@ class Trainer:
             })
 
         params = shard_params(params, self.mesh, self._rules)
+        # gradient-collective compression (--grad-compression int8,
+        # ops/quant_collectives.py): per-worker partial grads tiled over
+        # the replica axes, s8 wire, error-feedback tree in TrainState —
+        # validate the batch regrouping divisibility against the actual
+        # mesh before any compile, like the grad-accum check below
+        self._grad_workers = 1
+        if cfg.grad_compression == "int8":
+            from distributed_llms_example_tpu.ops.quant_collectives import (
+                GRAD_WORKER_AXES,
+                worker_count,
+            )
+
+            self._grad_workers = worker_count(dict(self.mesh.shape))
+            if self._grad_workers <= 1:
+                raise ValueError(
+                    f"--grad-compression int8 needs a replica axis > 1 "
+                    f"(mesh axes {GRAD_WORKER_AXES} on "
+                    f"{dict(self.mesh.shape)} give 1 worker group): with "
+                    "no cross-replica leg there is nothing to compress — "
+                    "every step would pay quantization noise and a "
+                    "params-sized fp32 residual for zero wire savings"
+                )
+            # the stochastic-rounding bits are drawn over the worker-tiled
+            # gradient shapes; without partitionable threefry the lowering
+            # computes them through cross-device u32 collectives as large
+            # as the gradient traffic the compression removes (measured)
+            jax.config.update("jax_threefry_partitionable", True)
+            denom = cfg.grad_accum_steps * self._grad_workers
+            if cfg.batch_size % denom:
+                raise ValueError(
+                    f"--grad-compression int8 cuts each microbatch into "
+                    f"{self._grad_workers} worker group(s) (mesh axes "
+                    f"{GRAD_WORKER_AXES}): --batch-size {cfg.batch_size} "
+                    f"must be divisible by grad-accum-steps x workers = "
+                    f"{denom}"
+                )
+            log_json({
+                "event": "grad_compression",
+                "mode": cfg.grad_compression,
+                "workers": self._grad_workers,
+                "worker_axes": list(GRAD_WORKER_AXES),
+            })
         self.state = create_train_state(params, self.tx)
         self.state_sh = state_shardings(self.state, self.mesh, self._rules)
+        if cfg.grad_compression == "int8":
+            # EF allocated DIRECTLY into the tiled layout (sharded at
+            # birth): a default-device zeros tree before the device_put
+            # would sit W x params x 4B whole on chip 0 at 7B scale
+            from distributed_llms_example_tpu.ops.quant_collectives import (
+                attach_error_feedback,
+            )
+
+            self.state, self.state_sh = attach_error_feedback(
+                self.state, self.state_sh, self.mesh, self._grad_workers,
+            )
         self.state = jax.tree.map(lambda x, s: jax.device_put(x, s), self.state, self.state_sh)
 
         # Sequence (context) parallelism needs every bucket width divisible
@@ -274,6 +327,7 @@ class Trainer:
                 num_experts=int(getattr(self.config, "num_experts", 0) or 0),
                 grad_accum_steps=cfg.grad_accum_steps,
                 optim_impl=cfg.optim_impl,
+                grad_compression=cfg.grad_compression,
             ),
         )
 
@@ -354,6 +408,7 @@ class Trainer:
             health=self.health_on,
             optim_spec=self.optim_spec,
             optim_impl=cfg.optim_impl,
+            grad_compression=cfg.grad_compression,
         )
         self.train_step, _ = build(self.state)
         # lazily-built jitted optimizer-apply probe (budget layer): the
@@ -456,32 +511,66 @@ class Trainer:
                 )
         if cfg.checkpoint.resume and self.checkpointer.latest_step() is not None:
             abstract = abstract_like(self.state, self.state_sh)
-            try:
-                restored = self.checkpointer.restore_latest(
-                    self._with_layout(abstract, abstract=True)
-                )
-            except Exception:
-                # legacy checkpoint (bare TrainState, no layout leaf):
-                # restore the old structure and rely on the sidecar guard
-                # above, which already ran for this directory
-                restored = self.checkpointer.restore_latest(abstract)
+            # restore targets, tried in order: the full payload; the
+            # --grad-compression FLAG-FLIP shapes (an int8 run accepts an
+            # ef-less payload — EF resumes ZERO-FILLED, step-0 semantics;
+            # an off run accepts an ef-carrying payload — the residual is
+            # restored sharded, then DROPPED); then the same pair in the
+            # legacy bare-TrainState shapes (pre-layout-leaf checkpoints)
+            flip, flip_mode = self._ef_flip_target(abstract)
+            candidates: list[tuple] = [
+                (self._with_layout(abstract, abstract=True), False, ""),
+                (self._with_layout(flip, abstract=True), False, flip_mode),
+                (abstract, True, ""),
+                (flip, True, flip_mode),
+            ]
+            # a MIXED dir (checkpoints from both sides of a flag flip)
+            # must resume from the NEWEST step, whichever shapes it has:
+            # a single candidate would silently walk restore_latest back
+            # past the other side's newer steps (measured: an off target
+            # on an off(4)+int8(6,8) dir resumed step 4, losing 6-8) —
+            # so try each and keep the highest restored step, stopping
+            # early once a candidate lands the latest retained step
+            latest = self.checkpointer.latest_step()
+            best = None  # (step, payload, legacy, ef_mode)
+            err = None
+            for target, legacy, ef_mode in candidates:
+                if legacy and best is not None:
+                    # the legacy shapes exist for pre-layout dirs only —
+                    # a dir that already restored a layout payload holds
+                    # no newer legacy one, and when the newest step is
+                    # merely corrupt (so no candidate ever equals
+                    # `latest`) skipping here avoids two more full
+                    # newest-first restore walks with their per-step
+                    # ckpt_restore_failed noise
+                    break
+                try:
+                    restored = self.checkpointer.restore_latest(target)
+                except Exception as e:
+                    err = e
+                    continue
                 if restored is None:
+                    # checkpoints EXIST but none passed verification:
+                    # training silently from step 0 would let this run's
+                    # retention garbage-collect the (possibly
+                    # salvageable) corrupt steps — refuse loudly instead
                     self._refuse_unverifiable_resume(ckpt_dir)
-                self.state, self.start_step = restored
+                if best is None or restored[1] > best[0]:
+                    best = (restored[1], restored[0], legacy, ef_mode)
+                if restored[1] == latest:
+                    break
+            if best is None:
+                raise err
+            self.start_step, payload, legacy, ef_mode = best
+            if legacy:
+                # legacy checkpoint (bare TrainState, no layout leaf):
+                # the sidecar guard above already ran for this directory
+                state = payload
                 log_json({
                     "event": "resumed", "step": self.start_step,
                     "legacy_payload": True,
                 })
-                restored = None
             else:
-                if restored is None:
-                    # checkpoints EXIST but none passed verification:
-                    # training silently from step 0 would let this run's
-                    # retention garbage-collect the (possibly salvageable)
-                    # corrupt steps — refuse loudly instead
-                    self._refuse_unverifiable_resume(ckpt_dir)
-            if restored is not None:
-                payload, self.start_step = restored
                 stored_leaf = np.asarray(jax.device_get(payload["stacked_layout"]))
                 if not np.array_equal(stored_leaf, self._layout_leaf):
                     raise ValueError(
@@ -493,8 +582,9 @@ class Trainer:
                         "and stage-axis size (restoring across layouts would "
                         "silently permute the model's layers)"
                     )
-                self.state = payload["state"]
+                state = payload["state"]
                 log_json({"event": "resumed", "step": self.start_step})
+            self.state = self._apply_ef_mode(state, ef_mode, self.start_step)
         # cross-run recovery state: the (epoch, pos) cursor and the
         # quarantine set ride a sidecar next to the restored step —
         # after a quarantine skip the cursor drifts from step %
@@ -753,6 +843,60 @@ class Trainer:
                     time.sleep(delay)
                     delay = min(delay * 2, 2.0)
             yield batch
+
+    def _ef_flip_target(self, abstract):
+        """The --grad-compression flag-flip restore shapes, shared by
+        resume and anomaly-rewind so neither path can drift: an int8 run
+        accepts an ef-LESS payload (the EF tree is zero-filled after —
+        ``_apply_ef_mode("fill")``), an off run accepts an ef-CARRYING
+        payload (the residual restores sharded, then drops).  Returns
+        ``(target, ef_mode)``."""
+        if getattr(self.state, "ef", None) is not None:
+            return abstract.replace(ef=None), "fill"
+        from distributed_llms_example_tpu.ops.quant_collectives import (
+            error_feedback_shardings,
+            worker_count,
+        )
+
+        ef_sh = error_feedback_shardings(self.state_sh.params, self.mesh)
+        workers = worker_count(dict(self.mesh.shape))
+        return abstract.replace(ef=jax.tree.map(
+            lambda p, sh: jax.ShapeDtypeStruct(
+                (workers,) + tuple(p.shape), np.float32, sharding=sh,
+            ),
+            abstract.params, ef_sh,
+        )), "drop"
+
+    def _apply_ef_mode(self, state, ef_mode: str, step: int):
+        """Finish a flag-flip restore: zero-fill the EF tree (sharded at
+        birth) or drop the restored residual, with the event log."""
+        if ef_mode == "fill":
+            from distributed_llms_example_tpu.ops.quant_collectives import (
+                sharded_zero_error_feedback,
+            )
+
+            state = state.replace(ef=sharded_zero_error_feedback(
+                state.params, self._grad_workers, self.state_sh.ef,
+            ))
+            log_json({
+                "event": "grad_compression_ef_zero_filled",
+                "step": int(step),
+                "reason": "checkpoint carries no error-feedback tree "
+                          "(written before --grad-compression, or with "
+                          "it off); resuming with a zero residual",
+            })
+        elif ef_mode == "drop":
+            state = state.replace(ef=None)
+            log_json({
+                "event": "grad_compression_ef_dropped",
+                "step": int(step),
+                "reason": "checkpoint was written under --grad-compression "
+                          "int8 but this run has it off; the error-feedback "
+                          "residual is dropped (its deferred quantization "
+                          "error is lost once — the uncompressed run does "
+                          "not need it)",
+            })
+        return state
 
     def _with_layout(self, state: Any, abstract: bool = False) -> dict:
         """Checkpoint payload: the TrainState plus the stacked-block layout
@@ -1071,10 +1215,26 @@ class Trainer:
             sink_mod.flush(fsync=True)
             return epoch, pos, step
         if action == "rewind":
+            # the rewind target can sit on the far side of a
+            # --grad-compression flip (resume-then-rewind past the flip
+            # boundary): try the current shapes AND the flag-flip shapes
+            # and take whichever reaches the NEWEST pre-anomaly step, so
+            # a mixed retention window never walks back further than it
+            # must (the resume-time loop above has the same contract)
             abstract = abstract_like(self.state, self.state_sh)
-            restored = self.checkpointer.restore_before(
-                a_step, self._with_layout(abstract, abstract=True)
-            )
+            flip, flip_mode = self._ef_flip_target(abstract)
+            best = None  # (step, payload, ef_mode)
+            for target, mode in ((abstract, ""), (flip, flip_mode)):
+                try:
+                    r = self.checkpointer.restore_before(
+                        a_step, self._with_layout(target, abstract=True)
+                    )
+                except Exception:
+                    continue
+                if r is not None and (best is None or r[1] > best[0]):
+                    best = (r[1], r[0], mode)
+            restored = None if best is None else (best[1], best[0])
+            ef_mode = "" if best is None else best[2]
             if restored is None:
                 action = "halt"
                 reason = (
@@ -1082,7 +1242,9 @@ class Trainer:
                 )
             else:
                 payload, rstep = restored
-                self.state = payload["state"]
+                self.state = self._apply_ef_mode(
+                    payload["state"], ef_mode, rstep
+                )
                 # checkpoints newer than the restore target may hold the
                 # poisoned state (saved between anomaly and detection)
                 # with CLEAN checksums — drop them so the replay re-saves

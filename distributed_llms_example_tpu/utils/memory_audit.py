@@ -106,6 +106,7 @@ def abstract_train_setup(
     dtype: str = "bfloat16",
     remat: bool = True,
     remat_policy: str = "full",
+    grad_compression: str = "",
 ):
     """Model + train state as pure ShapeDtypeStructs with shardings — no
     weights, no devices touched.  Returns ``(lm, tx, schedule, a_params,
@@ -127,7 +128,20 @@ def abstract_train_setup(
     )
     tx, schedule = make_optimizer(total_steps=1000)
     a_params = jax.eval_shape(lambda: lm.init_params(0))
-    a_state = jax.eval_shape(lambda p: create_train_state(p, tx), a_params)
+    workers = 1
+    if grad_compression and grad_compression != "off":
+        from distributed_llms_example_tpu.ops.quant_collectives import (
+            worker_count,
+        )
+
+        workers = worker_count(dict(mesh.shape))
+    a_state = jax.eval_shape(
+        lambda p: create_train_state(
+            p, tx,
+            grad_compression=grad_compression or "off", workers=workers,
+        ),
+        a_params,
+    )
     sh = state_shardings(a_state, mesh)
     a_state = jax.tree.map(
         lambda s, shd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shd),
@@ -148,6 +162,7 @@ def aot_compile_train_step(
     remat_policy: str = "full",
     grad_accum_steps: int = 1,
     optim_impl: str = "",
+    grad_compression: str = "",
 ):
     """AOT-lower and compile the sharded train step from abstract args
     (no parameter is ever materialized).  Returns ``(compiled, lm,
@@ -162,6 +177,7 @@ def aot_compile_train_step(
 
     lm, tx, schedule, a_params, a_state, sh = abstract_train_setup(
         model_name, mesh, dtype=dtype, remat=remat, remat_policy=remat_policy,
+        grad_compression=grad_compression,
     )
     bsh = batch_sharding(mesh)
     shapes = {
@@ -190,6 +206,7 @@ def aot_compile_train_step(
         is_seq2seq=lm.is_seq2seq,
         optim_spec=optim_spec,
         optim_impl=optim_impl or None,
+        grad_compression=grad_compression or "off",
     )
     step_fn, _ = build(a_state)
     with activation_mesh(mesh):

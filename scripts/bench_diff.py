@@ -50,10 +50,16 @@ _HIGHER_BETTER = (
 _LOWER_BETTER = (
     "_ms", "ttft", "wall_s", "_seconds", "overhead", "exposed_",
     "unattributed", "data_wait", "steps_lost",
+    # wire traffic: fewer gradient bytes per step is the whole point of
+    # --grad-compression (PR 12); the generic byte-account leaves stay
+    # informational (activation bytes move with config, not quality)
+    "gradient_bytes_per_step", "gradient_wire_bytes",
 )
 # config knobs stamped INTO the artifact (not measurements): changing a
-# setting between rounds must never read as a perf regression
-_CONFIG_LEAVES = ("ttft_slo_ms", "threshold", "slo_ms")
+# setting between rounds must never read as a perf regression — the
+# same fix ttft_slo_ms needed in PR 11; grad_compression is a mode
+# switch, so flipping it between rounds is information, not regression
+_CONFIG_LEAVES = ("ttft_slo_ms", "threshold", "slo_ms", "grad_compression")
 
 
 def direction_of(path: str) -> int:

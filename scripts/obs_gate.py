@@ -36,6 +36,15 @@ def main(argv: list[str] | None = None) -> int:
         help=f"wall-weighted dispatch_efficiency floor (default {DEFAULT_FLOOR})",
     )
     p.add_argument(
+        "--max-gradient-bytes-per-step", type=float, default=0.0,
+        help="optional compression gate: fail when the startup gauges' "
+             "gradient byte account exceeds this ceiling, or when no "
+             "account was emitted — a round that silently loses "
+             "--grad-compression (flag ignored, partitioner folded back "
+             "to fp32) fails instead of passing on wall-clock luck "
+             "(0 = off)",
+    )
+    p.add_argument(
         "--min-overlap-frac", type=float, default=0.0,
         help="optional device-account floor: fail when a profiled window "
              "shows collective time with overlap_frac below this, or when "
@@ -53,6 +62,11 @@ def main(argv: list[str] | None = None) -> int:
     ]
     if args.min_overlap_frac > 0:
         flags += ["--min-overlap-frac", str(args.min_overlap_frac)]
+    if args.max_gradient_bytes_per_step > 0:
+        flags += [
+            "--max-gradient-bytes-per-step",
+            str(args.max_gradient_bytes_per_step),
+        ]
     return report_main(flags)
 
 

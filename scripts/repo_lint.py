@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Seven rules, all born from real regressions at TPU scale:
+Nine rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -86,6 +86,18 @@ Seven rules, all born from real regressions at TPU scale:
    bit-equivalence pin would no longer cover it — the optimizer twin of
    rules 5/5a.  The apply is owned by ``train.optim.optimizer_update``
    (xla impl) and ``fused_optimizer_apply`` (fused impl).
+
+9. **No hand-rolled gradient collectives or gradient quantization in
+   models/ and train/ outside ``train/step.py``.**  A raw ``lax.psum`` /
+   ``psum_scatter`` / ``all_to_all`` over a gradient tree — or a manual
+   ``grads.astype(int8)`` quantize/dequantize — bypasses the
+   ``--grad-compression`` dispatch (``ops/quant_collectives.py``): the
+   call site would silently miss the error-feedback buffer (its
+   quantization error is LOST, not carried), its bytes would not ride
+   the int-safe shared-scale wire protocol the census proves, and the
+   off-path bit-identity pin would no longer cover it.  The compression
+   layer is the one owner; the step (``train/step.py``) is the one
+   caller.
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -189,6 +201,15 @@ _MANAGER_NAMES = ("manager", "_manager", "checkpoint_manager", "ckpt_manager")
 # cross-rank alignment.
 TRACE_OWNER = os.path.join(PACKAGE, "obs", "trace.py")
 
+# Rule 9: gradient collectives / quantization are owned by
+# ops/quant_collectives.py, called only from train/step.py — a raw
+# psum/psum_scatter/all_to_all (or int8 cast) over grad-named values
+# anywhere else in models/ and train/ bypasses the --grad-compression
+# dispatch and its error-feedback contract.
+GRAD_COLLECTIVE_RULE_DIRS = DROPOUT_RULE_DIRS
+GRAD_COLLECTIVE_OWNER = os.path.join(PACKAGE, "train", "step.py")
+_GRAD_COLLECTIVE_FNS = ("psum", "psum_scatter", "pmean", "all_to_all")
+
 # Rule 8: the optimizer apply is owned by train/optim.py — raw
 # optax.apply_updates / manual p - lr*u tree-maps elsewhere in models/
 # and train/ bypass the --optim-impl dispatch (fused Pallas apply,
@@ -254,6 +275,60 @@ def _optim_apply_violations(tree: ast.AST, rel: str) -> list[str]:
                 "apply outside train/optim.py — a hand-rolled update skips "
                 "clip/AdamW/health AND the --optim-impl dispatch; use "
                 "optimizer_apply_block (train/step.py)"
+            )
+    return violations
+
+
+def _is_int8_node(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("int8", "uint8")
+    if isinstance(node, ast.Constant):
+        return node.value in ("int8", "uint8")
+    if isinstance(node, ast.Name):
+        return node.id in ("int8", "uint8")
+    return False
+
+
+def _grad_collective_violations(tree: ast.AST, rel: str) -> list[str]:
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name)
+            else None
+        )
+        if name in _GRAD_COLLECTIVE_FNS and any(
+            _is_grad_named(a) for a in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: raw {name}(...) over a gradient "
+                "tree outside train/step.py bypasses the "
+                "--grad-compression dispatch (ops/quant_collectives.py: "
+                "error feedback, shared-scale int8 wire, off-path "
+                "bit-identity pin) — the step owns the gradient "
+                "reduction"
+            )
+        elif (
+            name == "astype"
+            and isinstance(fn, ast.Attribute)
+            and _is_grad_named(fn.value)
+            and any(
+                _is_int8_node(a)
+                for a in list(node.args) + [kw.value for kw in node.keywords]
+            )
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: manual int8 cast of a gradient "
+                "value outside train/step.py — hand-rolled gradient "
+                "quantization loses its error to nowhere (no "
+                "error-feedback buffer) and skips the shared-scale "
+                "int-safe wire protocol; route through "
+                "ops.quant_collectives.quantized_tree_reduce"
             )
     return violations
 
@@ -459,6 +534,10 @@ def lint_file(path: str, rel: str) -> list[str]:
         rel.startswith(d + os.sep) for d in OPTIM_RULE_DIRS
     ):
         violations.extend(_optim_apply_violations(tree, rel))
+    if rel != GRAD_COLLECTIVE_OWNER and any(
+        rel.startswith(d + os.sep) for d in GRAD_COLLECTIVE_RULE_DIRS
+    ):
+        violations.extend(_grad_collective_violations(tree, rel))
     if rel != CKPT_OWNER:
         violations.extend(_ckpt_manager_violations(tree, rel))
     if rel != TRACE_OWNER:

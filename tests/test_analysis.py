@@ -744,6 +744,50 @@ def test_repo_lint_grad_accum_rule(tmp_path):
     assert repo_lint.lint_file(str(bad), rel) == []
 
 
+def test_repo_lint_grad_collective_rule(tmp_path):
+    """Rule 9 (ISSUE 12): a raw lax.psum / psum_scatter / all_to_all over
+    a gradient tree — or a manual int8 cast of gradients — outside
+    train/step.py bypasses the --grad-compression dispatch
+    (ops/quant_collectives.py: error feedback, shared-scale int-safe
+    wire, off-path bit-identity pin)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    repo_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repo_lint)
+
+    bad = tmp_path / "qc.py"
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "def f(grads, x, axis):\n"
+        "    g = lax.psum(grads, axis)\n"
+        "    g2 = lax.psum_scatter(grads, axis)\n"
+        "    g3 = jax.lax.all_to_all(grads, axis, 0, 0)\n"
+        "    q = grads.astype(jnp.int8)\n"
+        "    q2 = grads.astype(dtype=jnp.int8)\n"  # kwarg form must not evade
+        "    ok = lax.psum(x, axis)\n"  # non-gradient collectives stay legal
+        "    ok2 = x.astype(jnp.int8)\n"  # non-gradient int8 casts too
+        "    return g, g2, g3, q, ok, ok2\n"
+    )
+    for d in ("models", "train"):
+        rel = os.path.join("distributed_llms_example_tpu", d, "qc.py")
+        violations = repo_lint.lint_file(str(bad), rel)
+        assert len(violations) == 5, violations
+        assert any("quant_collectives" in v for v in violations)
+    # the owners are exempt: train/step.py calls the compression layer,
+    # ops/ and parallel/ ARE implementation layers
+    rel = os.path.join("distributed_llms_example_tpu", "train", "step.py")
+    assert repo_lint.lint_file(str(bad), rel) == []
+    rel = os.path.join("distributed_llms_example_tpu", "ops", "qc.py")
+    assert repo_lint.lint_file(str(bad), rel) == []
+
+
 def test_repo_lint_ckpt_manager_rule(tmp_path):
     """Rule 6 (ISSUE 6): bare orbax ``manager.save``/``manager.restore``
     outside io/checkpoint.py bypasses the integrity wrappers (save

@@ -1239,7 +1239,7 @@ def _serve_measure(
     )
     engine.generate(sharded, requests[: slots], max_new=budgets[: slots])  # compile+warm
     t0 = time.perf_counter()
-    engine.generate(sharded, requests, max_new=budgets)
+    headline_outs = engine.generate(sharded, requests, max_new=budgets)
     serve_s = time.perf_counter() - t0
     stats = engine.last_stats
 
@@ -1339,6 +1339,21 @@ def _serve_measure(
         bad = failing_combos(flags=flags, mesh_axes=axes)
         compo[label] = "ok" if not bad else [row.id for row in bad]
 
+    # decode-capacity block (ISSUE 13): int8 KV A/B on this model (token
+    # parity at a tolerance + static footprint ratio), paged A/B when the
+    # family is causal, capacity headline fields — all at the same mixed
+    # prompt lengths as the headline run
+    capacity = {}
+    try:
+        capacity = _serve_capacity(
+            lm, mesh, sharded, requests, budgets,
+            slots=slots, src=src, new_tokens=new_tokens,
+            f32_stats=stats, f32_outs=headline_outs,
+        )
+    except Exception as e:
+        print(f"bench: serve capacity block failed ({e})", file=sys.stderr)
+        capacity = {"error": str(e)[:300]}
+
     return {
         "decode_tokens_per_sec_chip": round(serve_tps_chip, 1),
         "ttft_p50_ms": round(ttft_p50 * 1e3, 1),
@@ -1361,11 +1376,131 @@ def _serve_measure(
         "static_row_utilization": round(useful_tokens / (static_rows * new_tokens), 4),
         "rouge_eval_ab": rouge_ab,
         "decode_composition": compo,
+        "capacity": capacity,
         "slots": slots,
         "src_len": src,
         "max_new_tokens": new_tokens,
         "requests": n_req,
     }
+
+
+def _token_match_rate(a_rows, b_rows, eos, pad) -> float:
+    """Greedy prefix agreement between two decode paths: positionwise
+    match over the eos-trimmed common prefix length.  A single near-tie
+    argmax flip cascades (every later token conditions on it), so this is
+    the CONSERVATIVE tolerance metric — per-step teacher-forced agreement
+    is strictly higher."""
+    from distributed_llms_example_tpu.serving.engine import trim_eos
+
+    match = total = 0
+    for a, b in zip(a_rows, b_rows):
+        ta, tb = trim_eos(a, eos, pad), trim_eos(b, eos, pad)
+        n = min(len(ta), len(tb))
+        total += max(len(ta), len(tb))
+        match += sum(x == y for x, y in zip(ta[:n], tb[:n]))
+    return match / max(total, 1)
+
+
+def _serve_capacity(
+    lm, mesh, sharded, requests, budgets, *,
+    slots: int, src: int, new_tokens: int, f32_stats, f32_outs,
+) -> dict:
+    """The decode-capacity A/Bs: int8 KV vs the f32 headline engine
+    (token-parity at a tolerance + >= 3.5x static footprint reduction),
+    and — causal families — paged vs flat (BIT-identical tokens,
+    bytes-per-token scaling with actual prompt length).  Static byte
+    accounting throughout (serving/cache_pool.py tree_bytes): capacity
+    claims are measured off the state trees, not inferred; HBM/bandwidth
+    wall-clock verdicts land on the TPU round."""
+    import jax
+
+    from distributed_llms_example_tpu.serving import cache_pool
+    from distributed_llms_example_tpu.serving.engine import (
+        ServeConfig,
+        ServingEngine,
+    )
+
+    eos, pad = lm.config.eos_token_id, lm.config.pad_token_id
+    base_kw = dict(
+        max_slots=slots, prefill_batch=slots, max_new_tokens=new_tokens,
+        max_source_length=src, log_every_steps=0, request_spans=False,
+    )
+
+    def run(**kw):
+        eng = ServingEngine(
+            lm.module, lm.config, mesh, ServeConfig(**base_kw, **kw),
+            is_seq2seq=lm.is_seq2seq,
+        )
+        outs = eng.generate(sharded, requests, max_new=budgets)
+        return eng, outs
+
+    out = {
+        # the f32 flat baseline's capacity headline: a full-width slot set
+        "max_sustained_slots": slots,
+        "cache_bytes_per_token": round(f32_stats.bytes_per_live_token, 1),
+        "cache_bytes_resident": f32_stats.cache_bytes_resident,
+    }
+
+    i8_eng, i8_outs = run(kv_cache_dtype="int8")
+    out["int8_vs_f32_kv"] = {
+        "token_match_rate": round(
+            _token_match_rate(f32_outs, i8_outs, eos, pad), 4
+        ),
+        "cache_bytes_ratio": round(
+            f32_stats.cache_bytes_resident
+            / max(i8_eng.last_stats.cache_bytes_resident, 1),
+            3,
+        ),
+        "cache_bytes_per_token_f32": round(
+            f32_stats.bytes_per_live_token, 1
+        ),
+        "cache_bytes_per_token_int8": round(
+            i8_eng.last_stats.bytes_per_live_token, 1
+        ),
+        "decode_tokens_per_sec_chip_int8": round(
+            i8_eng.last_stats.tokens_per_sec() / max(jax.device_count(), 1), 1
+        ),
+    }
+    if lm.is_seq2seq:
+        out["paged_vs_flat"] = {
+            "note": (
+                "paged_kv applies to the causal KV cache; the seq2seq "
+                "slot state is encoder output + cross-KV — see the "
+                "standalone causal paged record"
+            )
+        }
+        return out
+
+    # kv_block_size=0: the engine picks the largest valid block — it must
+    # tile the cache width AND the admission bucket, a constraint the
+    # engine owns (gcd-based auto default)
+    pg_eng, pg_outs = run(paged_kv=True)
+    bs = pg_eng.block_size
+    mean_blocks = sum(
+        cache_pool.blocks_needed(min(len(r), src), b, bs)
+        for r, b in zip(requests, budgets)
+    ) / max(len(requests), 1)
+    out["paged_vs_flat"] = {
+        # the acceptance pin: paged tokens are BIT-identical to flat
+        "bit_identical": list(pg_outs) == list(f32_outs),
+        "kv_block_size": bs,
+        "pool_blocks": pg_eng.pool.num_blocks,
+        "cache_bytes_per_token_flat": round(
+            f32_stats.bytes_per_live_token, 1
+        ),
+        # scales with ACTUAL prompt length: live blocks / live tokens
+        "cache_bytes_per_token_paged": round(
+            pg_eng.last_stats.bytes_per_live_token, 1
+        ),
+        "admit_deferrals": pg_eng.last_stats.admit_deferrals,
+        # what the SAME pool memory sustains at this workload's mix —
+        # the concurrency headroom paging converts padding into
+        "max_sustained_slots": int(pg_eng.pool.num_blocks // max(mean_blocks, 1)),
+    }
+    out["max_sustained_slots"] = max(
+        out["max_sustained_slots"], out["paged_vs_flat"]["max_sustained_slots"]
+    )
+    return out
 
 
 def _serve_main() -> None:
@@ -1396,6 +1531,52 @@ def _serve_main() -> None:
         slots=slots, src=src, new_tokens=new_tokens, n_req=n_req,
         eval_beams=eval_beams,
     )
+    # the flagship is seq2seq, whose slot state has no causal cache to
+    # page — run the paged_vs_flat acceptance A/B on a causal model at the
+    # same mixed prompt lengths (random init: greedy decode is
+    # deterministic and the bit-identity/footprint claims are
+    # weight-independent)
+    if lm.is_seq2seq and os.environ.get("BENCH_SERVE_PAGED_AB", "1") != "0":
+        try:
+            causal_name = os.environ.get("BENCH_SERVE_CAUSAL", "llama-test")
+            from distributed_llms_example_tpu.models.registry import load_model
+
+            clm = load_model(causal_name)
+            cparams = shard_params(
+                clm.params if clm.params is not None else clm.init_params(0),
+                mesh,
+            )
+            crng = __import__("numpy").random.RandomState(1)
+            c_src, c_new = 64, 16
+            c_slots = max(2, batch_shards)
+            c_reqs = [
+                list(crng.randint(4, min(clm.config.vocab_size, 1000),
+                                  crng.randint(max(c_src // 4, 4), c_src + 1)))
+                for _ in range(3 * c_slots)
+            ]
+            c_budgets = [int(b) for b in crng.randint(c_new // 2, c_new + 1, len(c_reqs))]
+            from distributed_llms_example_tpu.serving.engine import (
+                ServeConfig as _SC,
+                ServingEngine as _SE,
+            )
+
+            base = dict(max_slots=c_slots, prefill_batch=c_slots,
+                        max_new_tokens=c_new, max_source_length=c_src,
+                        log_every_steps=0, request_spans=False)
+            flat_eng = _SE(clm.module, clm.config, mesh, _SC(**base),
+                           is_seq2seq=False)
+            flat_outs = flat_eng.generate(cparams, c_reqs, max_new=c_budgets)
+            serve["paged_vs_flat_causal"] = {
+                "model": causal_name,
+                **_serve_capacity(
+                    clm, mesh, cparams, c_reqs, c_budgets,
+                    slots=c_slots, src=c_src, new_tokens=c_new,
+                    f32_stats=flat_eng.last_stats, f32_outs=flat_outs,
+                ),
+            }
+        except Exception as e:
+            print(f"bench: causal paged A/B failed ({e})", file=sys.stderr)
+            serve["paged_vs_flat_causal"] = {"error": str(e)[:300]}
     print(json.dumps({
         "grad_compression": "off",
         "metric": f"{name} continuous-batching serving decode (slots {slots}, "
@@ -2094,9 +2275,10 @@ def main() -> None:
     # serving block: continuous-batching decode tokens/sec/chip + TTFT +
     # the continuous-vs-static and ROUGE-eval-path A/Bs (serving/engine.py)
     # on the same sharded params the train step just used.  Cost is a
-    # prefill+decode sweep per path — budget it like two step passes.
+    # prefill+decode sweep per path, plus the capacity A/B's int8 engine
+    # rebuild — budget it like four step passes.
     if os.environ.get("BENCH_SERVE", "1") != "0" and not over_budget(
-        "serve block", 3 * est_step_pass
+        "serve block", 4 * est_step_pass
     ):
         try:
             batch_shards = 1

@@ -360,6 +360,44 @@ def int8_compression_missing_finding(
     )
 
 
+def int8_kv_missing_finding(
+    instrs: Mapping[str, HloInstr],
+    kv_cache_dtype: str,
+    *,
+    min_elems: int = 1024,
+) -> Finding | None:
+    """Error when a decode program built with ``--kv-cache-dtype int8``
+    carries NO s8 cache operand: the quantized buffers never reached the
+    compiled step (a dropped context, a stale f32 cache tree) and every
+    decode pays full f32 HBM traffic while stamping itself int8 — the
+    decode-census twin of ``int8-compression-missing`` (PR 12).  The
+    predicate is deliberately simple: any s8 instruction at cache scale
+    (``min_elems`` keeps a stray byte-wide scalar from vouching for the
+    whole cache); a correctly built int8 decode step carries its cache
+    parameters, the updated buffers, and their scatter ops all in s8."""
+    if kv_cache_dtype != "int8":
+        return None
+    s8 = [
+        name
+        for name, instr in instrs.items()
+        if instr.dtype == "s8" and instr.elems >= min_elems
+    ]
+    if s8:
+        return None
+    return Finding(
+        severity="error",
+        pass_name="ir",
+        code="int8-kv-missing",
+        message=(
+            "the decode step was built with --kv-cache-dtype int8 but the "
+            "compiled program carries no cache-sized s8 operand — the "
+            "quantized cache never reached the compiled step (dropped "
+            "kv_cache_context, stale f32 cache tree); decode would pay "
+            "full f32 cache traffic while stamping itself int8"
+        ),
+    )
+
+
 def account_gradient_bytes_by_op(account: Mapping[str, Any]) -> dict[str, int]:
     """Adapter: the obs collective-traffic account (obs/gauges.py
     ``collective_traffic`` — per-op dicts with ``gradient_bytes``) →
@@ -832,6 +870,7 @@ def scan_hlo_text(
     param_element_counts: Iterable[int] | None = None,
     decode_contract: Mapping[str, int] | None = None,
     grad_compression: str = "",
+    kv_cache_dtype: str = "",
 ) -> list[Finding]:
     """Scan post-optimization HLO text.  Pure function of the text.
 
@@ -841,7 +880,9 @@ def scan_hlo_text(
 
     ``decode_contract`` marks the text as a SERVING decode step and runs
     ``prefill_in_decode_smell`` over it; keys: ``enc_len``, ``batch``,
-    ``heads``, optional ``q_len``/``margin``."""
+    ``heads``, optional ``q_len``/``margin``.  ``kv_cache_dtype``
+    ("int8") additionally asserts the program carries s8 cache operands
+    (``int8_kv_missing_finding``)."""
     findings: list[Finding] = []
     instrs = parse_hlo_instructions(hlo_text)
     defs = {n: (i.dtype, i.dims, i.op) for n, i in instrs.items()}
@@ -947,6 +988,11 @@ def scan_hlo_text(
         smell = prefill_in_decode_smell(instrs, **decode_contract)
         if smell is not None:
             findings.append(smell)
+
+    # ---- int8 KV cache actually present in the decode step -------------
+    kv_missing = int8_kv_missing_finding(instrs, kv_cache_dtype)
+    if kv_missing is not None:
+        findings.append(kv_missing)
 
     # ---- collective-permute chains vs the stage ring -------------------
     chain = ppermute_chain_smell(instrs, mesh_axes)
@@ -1149,13 +1195,19 @@ def lint_decode_step(
     src_len: int = 64,
     max_new_tokens: int = 16,
     dtype: str = "float32",
+    kv_cache_dtype: str = "",
 ) -> list[Finding]:
     """AOT-compile the SERVING decode step (the per-token program of the
     prefill/decode split, evaluation/generation.py) from abstract args and
     scan it: ``prefill_in_decode_smell`` (no encoder recompute, no
     per-step cross-KV re-projection) plus host transfers and the
     collective census.  The prefill carry is ``eval_shape``-derived — no
-    weights, same recipe as ``lint_train_step``."""
+    weights, same recipe as ``lint_train_step``.  ``src_len`` is the
+    admission width, so callers loop it over every ``--prefill-buckets``
+    entry to prove each bucket's decode step clean.  ``kv_cache_dtype``
+    "int8" builds the step under ``kv_cache_context`` and additionally
+    requires s8 cache operands in the compiled text
+    (``int8_kv_missing_finding``)."""
     import jax
 
     from distributed_llms_example_tpu.core.config import MeshConfig
@@ -1166,7 +1218,10 @@ def lint_decode_step(
         Seq2SeqGenerator,
     )
     from distributed_llms_example_tpu.models.registry import load_model
-    from distributed_llms_example_tpu.parallel.activation import activation_mesh
+    from distributed_llms_example_tpu.parallel.activation import (
+        activation_mesh,
+        kv_cache_context,
+    )
 
     mesh = build_mesh(mesh_config or MeshConfig())
     lm = load_model(model_name, load_weights=False, dtype=parse_dtype(dtype))
@@ -1175,7 +1230,7 @@ def lint_decode_step(
     gen = cls(lm.module, lm.config, max_new_tokens, num_beams=1)
     ids = jax.ShapeDtypeStruct((slots, src_len), jnp_int32())
     mask = jax.ShapeDtypeStruct((slots, src_len), jnp_int32())
-    with activation_mesh(mesh):
+    with activation_mesh(mesh), kv_cache_context(kv_cache_dtype or "f32"):
         a_carry = jax.eval_shape(gen.prefill, a_params, ids, mask)
         compiled = jax.jit(gen.decode_step).lower(a_params, a_carry).compile()
     text = compiled.as_text()
@@ -1191,6 +1246,7 @@ def lint_decode_step(
             "heads": decode_heads(lm.config),
             "q_len": 1,
         },
+        kv_cache_dtype=kv_cache_dtype,
     )
 
 

@@ -82,6 +82,8 @@ def run_passes(
     remat: bool = False,
     grad_accum_steps: int = 1,
     serve: bool = False,
+    kv_cache_dtype: str = "",
+    prefill_buckets: tuple = (),
 ) -> list[Finding]:
     """The three passes over one (model, mesh, config) triple."""
     import jax
@@ -138,6 +140,7 @@ def run_passes(
                 lm.module, a_params,
                 batch=global_batch, max_new_tokens=tgt_len,
                 src_len=src_len, is_seq2seq=lm.is_seq2seq,
+                kv_cache_dtype=kv_cache_dtype or "f32",
             ),
             axis_sizes,
         )
@@ -221,16 +224,24 @@ def run_passes(
                 grad_compression=grad_compression,
             )
             if serve:
-                # the compiled SERVING decode step: no encoder recompute,
-                # no per-step cross-KV re-projection (prefill-in-decode)
-                findings += ir_lint.lint_decode_step(
-                    model,
-                    mesh_config=MeshConfig(**axis_sizes),
-                    slots=global_batch,
-                    src_len=src_len,
-                    max_new_tokens=tgt_len,
-                    dtype=dtype,
-                )
+                # the compiled SERVING decode step(s): no encoder
+                # recompute, no per-step cross-KV re-projection
+                # (prefill-in-decode), s8 cache operands under int8 — one
+                # compile per admission bucket, since each bucket's
+                # prefill carry shapes its own decode step
+                widths = tuple(
+                    int(b) for b in prefill_buckets if 0 < int(b) < src_len
+                ) + (src_len,)
+                for width in widths:
+                    findings += ir_lint.lint_decode_step(
+                        model,
+                        mesh_config=MeshConfig(**axis_sizes),
+                        slots=global_batch,
+                        src_len=width,
+                        max_new_tokens=tgt_len,
+                        dtype=dtype,
+                        kv_cache_dtype=kv_cache_dtype,
+                    )
     return findings
 
 
@@ -296,6 +307,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "rules over the abstract decode cache, the decode "
                         "composition rows, and (with the IR pass) the "
                         "compiled decode step's prefill-in-decode scan")
+    p.add_argument("--kv-cache-dtype", type=str, default="",
+                   choices=("", "f32", "int8"),
+                   help="with --serve: lint the abstract cache at this KV "
+                        "storage dtype (int8 adds the scale leaves to the "
+                        "spec pass and requires s8 cache operands in the "
+                        "compiled decode step — int8-kv-missing)")
+    p.add_argument("--prefill-buckets", type=str, default="",
+                   help="with --serve: comma list of admission widths; the "
+                        "compiled decode-step scan runs once per bucket "
+                        "(each bucket's prefill carry shapes its own step)")
     p.add_argument("--no-ir", action="store_true",
                    help="skip the lowered-program pass (no AOT compile)")
     p.add_argument("--strict", action="store_true",
@@ -338,6 +359,10 @@ def main(argv: list[str] | None = None) -> int:
             remat=args.remat,
             grad_accum_steps=args.grad_accum_steps,
             serve=args.serve,
+            kv_cache_dtype=args.kv_cache_dtype,
+            prefill_buckets=tuple(
+                int(b) for b in args.prefill_buckets.split(",") if b.strip()
+            ),
         )
     emit(findings, as_json=args.json)
     counts = count_by_severity(findings)

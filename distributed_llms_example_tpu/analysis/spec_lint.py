@@ -290,7 +290,11 @@ def lint_cache_sharding(
     )
     # the oversized-replicated check above only fires on rule FALLTHROUGH;
     # for the cache the contract is stronger — every K/V buffer must hit a
-    # sharding rule (a cache leaf no rule matches decodes replicated)
+    # sharding rule (a cache leaf no rule matches decodes replicated).
+    # The int8 KV cache's 3-D ``*_scale`` leaves are held to the same bar:
+    # an unmatched scale leaf replicates batch×heads×len f32 per device
+    # AND desyncs from the s8 buffers it dequantizes (a GSPMD reshard on
+    # every decode step).
     import jax.tree_util as jtu
 
     from distributed_llms_example_tpu.parallel.sharding import _path_str
@@ -298,7 +302,8 @@ def lint_cache_sharding(
     leaves: list[tuple[str, Any]] = []
     jtu.tree_map_with_path(lambda p, x: leaves.append((_path_str(p), x)), cache)
     for path, leaf in leaves:
-        if len(getattr(leaf, "shape", ())) != 4:
+        nd = len(getattr(leaf, "shape", ()))
+        if nd != 4 and not (nd == 3 and path.endswith("_scale")):
             continue
         if rules.match_path(path) is None:
             findings.append(

@@ -59,10 +59,15 @@ def abstract_cache(
     max_new_tokens: int,
     src_len: int = 64,
     is_seq2seq: bool = True,
+    kv_cache_dtype: str = "f32",
 ):
     """Shape-only decode-cache tree (ShapeDtypeStruct leaves) — the input
     the cache spec lint (``analysis/spec_lint.py lint_cache_sharding``)
-    validates, built without weights or devices."""
+    validates, built without weights or devices.  ``kv_cache_dtype``
+    "int8" yields the quantized layout: s8 K/V buffers plus the per-head
+    per-position ``key_scale``/``value_scale`` f32 leaves the scale rules
+    in ``CACHE_RULES`` cover."""
+    from distributed_llms_example_tpu.parallel.activation import kv_cache_context
     if is_seq2seq:
         def build(p):
             ids = jnp.zeros((batch, src_len), jnp.int32)
@@ -82,7 +87,8 @@ def abstract_cache(
                 use_cache=True,
             )["cache"]
 
-    return jax.eval_shape(build, abstract_params)
+    with kv_cache_context(kv_cache_dtype):
+        return jax.eval_shape(build, abstract_params)
 
 
 # --------------------------------------------------------------- seq2seq

@@ -144,6 +144,29 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="first-token SLO for the serve_summary goodput "
                         "fields (useful tokens/sec + slo_attainment); "
                         "0 = no SLO")
+    p.add_argument("--kv-cache-dtype", type=str, default="f32",
+                   choices=("f32", "int8"),
+                   help="KV-cache storage dtype: int8 quantizes on cache "
+                        "write (per-head per-position scales, ~4x less "
+                        "cache HBM and decode traffic at a token-match "
+                        "tolerance; README 'Serving capacity')")
+    p.add_argument("--prefill-buckets", type=str, default="",
+                   help="comma list of compiled admission widths (e.g. "
+                        "128,256,512); each chunk pads to the smallest "
+                        "covering bucket instead of max-source-length, "
+                        "all AOT-warmed before the first request")
+    p.add_argument("--paged-kv", action="store_true",
+                   help="causal families: slots hold block lists over a "
+                        "shared pool (serving/cache_pool.py) so short "
+                        "prompts stop paying worst-case cache memory; "
+                        "bit-identical tokens to the flat cache")
+    p.add_argument("--pool-blocks", type=int, default=0,
+                   help="paged: shared pool size in blocks (0 = worst "
+                        "case, every slot at full width — shrink it to "
+                        "trade admission deferrals for memory)")
+    p.add_argument("--kv-block-size", type=int, default=0,
+                   help="paged: block length in cache positions (0 = the "
+                        "kv tile size for the cache width)")
     p.add_argument("--mesh", type=str, default="data=-1")
     p.add_argument("--compute-dtype", type=str, default="bfloat16")
     p.add_argument("--attention-impl", type=str, default="",
@@ -221,9 +244,39 @@ def serve_main(argv: list[str] | None = None) -> int:
                 lm.module, a_params,
                 batch=args.max_slots, max_new_tokens=args.max_new_tokens,
                 src_len=args.max_source_length, is_seq2seq=lm.is_seq2seq,
+                kv_cache_dtype=args.kv_cache_dtype,
             ),
             dict(mesh.shape),
         )
+        if args.paged_kv:
+            # the pool is the resident serving tree under --paged-kv:
+            # spec-lint it like CACHE_RULES (POOL_RULES is its rule set)
+            from distributed_llms_example_tpu.ops.flash_attention import (
+                auto_block,
+            )
+            from distributed_llms_example_tpu.parallel.sharding import (
+                pool_rules,
+            )
+            from distributed_llms_example_tpu.serving.cache_pool import (
+                pool_cache_tree,
+            )
+
+            width = args.max_source_length + args.max_new_tokens
+            bs = args.kv_block_size or auto_block(width) or width
+            a_cache = abstract_cache(
+                lm.module, a_params,
+                batch=args.max_slots, max_new_tokens=args.max_new_tokens,
+                src_len=args.max_source_length, is_seq2seq=lm.is_seq2seq,
+                kv_cache_dtype=args.kv_cache_dtype,
+            )
+            n_blocks = args.pool_blocks or args.max_slots * max(width // bs, 1)
+            findings += lint_cache_sharding(
+                jax.eval_shape(
+                    lambda: pool_cache_tree(a_cache, n_blocks, bs)
+                ),
+                dict(mesh.shape),
+                rules=pool_rules(),
+            )
         findings += check_composition(
             family=lm.family, mesh_axes=dict(mesh.shape),
             flags=("decode", "seq2seq" if lm.is_seq2seq else "causal"),
@@ -248,6 +301,13 @@ def serve_main(argv: list[str] | None = None) -> int:
             max_source_length=args.max_source_length,
             log_every_steps=args.log_every_steps,
             ttft_slo_ms=args.ttft_slo_ms,
+            kv_cache_dtype=args.kv_cache_dtype,
+            prefill_buckets=tuple(
+                int(b) for b in args.prefill_buckets.split(",") if b.strip()
+            ),
+            paged_kv=args.paged_kv,
+            pool_blocks=args.pool_blocks,
+            kv_block_size=args.kv_block_size,
         ),
         is_seq2seq=lm.is_seq2seq,
     )

@@ -893,14 +893,50 @@ def flash_supported(q_len: int, kv_len: int, head_dim: int,
 # express), and kv tiles entirely beyond the longest live row are SKIPPED
 # via a dynamic pl.when, so a step early in the decode reads ~offset/L of
 # the cache instead of all of it.  Inference only: no vjp.
+#
+# int8 KV (--kv-cache-dtype int8): the cache buffers arrive as s8 with
+# per-head per-position f32 scales (quantize_kv below — THE owning
+# quantize/dequantize implementation, guarded by repo_lint rule 10).
+# The kernel dequantizes each (block_k, d) tile in VMEM right after the
+# DMA, so HBM traffic and cache footprint are both s8 while the MXU math
+# stays f32 — the XLA fallback path dequantizes through the identical
+# dequantize_kv expression, which is what keeps the two paths
+# token-comparable.
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-head per-position int8 quantization of a K/V tensor.
+
+    ``x``: (..., len, head_dim) — one scale per (..., position): the
+    head_dim row written at one cache slot shares one scale, so a cache
+    write (one row per slot per step) quantizes independently of every
+    other position and nothing ever needs requantizing.  Deterministic
+    round-to-nearest (decode parity wants bit-stable values, not the
+    unbiased stochastic rounding gradients need).  Returns ``(q, scale)``
+    with ``q`` int8 shaped like ``x`` and ``scale`` f32 with the head_dim
+    axis dropped."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of ``quantize_kv`` — the ONE dequantize expression both the
+    Pallas decode kernel (per tile, in VMEM) and the XLA fallback path
+    (whole buffer) evaluate, so their reconstructed K/V are identical."""
+    return q.astype(jnp.float32) * scale[..., None]
 
 
 def _decode_kernel(
     *refs, scale: float, block_k: int, nk: int, has_bias: bool,
+    has_scales: bool = False,
 ):
     it = iter(refs)
     off_ref = next(it)  # SMEM (batch,) int32: absolute position of q row 0
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    ks_ref = next(it) if has_scales else None
+    vs_ref = next(it) if has_scales else None
     bias_ref = next(it) if has_bias else None
     o_ref, m_scr, l_scr, acc_scr = it
     bi = pl.program_id(0)
@@ -921,7 +957,11 @@ def _decode_kernel(
     @pl.when(live)
     def _compute():
         q = q_ref[0, 0]  # (q_len, d)
-        k = k_ref[0, 0]  # (block_k, d)
+        k = k_ref[0, 0]  # (block_k, d) — s8 under int8 KV
+        if ks_ref is not None:
+            # dequantize the tile in VMEM: HBM moved 1 byte/elem, the MXU
+            # sees f32 — same expression as dequantize_kv
+            k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -946,8 +986,11 @@ def _decode_kernel(
             l_scr.shape, (0,),
         )
         m_scr[:] = jax.lax.broadcast_in_dim(m_next[:, 0], m_scr.shape, (0,))
+        v = v_ref[0, 0]
+        if vs_ref is not None:
+            v = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
         pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         acc_scr[:] = acc_scr[:] * alpha + pv
@@ -966,6 +1009,8 @@ def flash_decode(
     bias: jnp.ndarray | None = None,
     *,
     offsets: jnp.ndarray,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -980,9 +1025,13 @@ def flash_decode(
     cache slots <= offsets[b] + r, so not-yet-written slots never
     contribute regardless of their (stale, reused) contents.  ``bias`` is
     a constant additive mask, every dim 1 or full — the padding mask /
-    T5's decode-step relative-position bias.  Inference only (no vjp);
-    numerically identical to masked ``dot_product_attention`` on the same
-    inputs (the parity tests pin greedy and beam decode against it).
+    T5's decode-step relative-position bias.  ``k_scale``/``v_scale``
+    ((B, H, L) f32, both or neither): the int8 KV cache's per-head
+    per-position scales — ``k``/``v`` are then s8 and each kv tile is
+    dequantized in VMEM after the DMA, so decode HBM traffic drops ~4×
+    vs f32 buffers.  Inference only (no vjp); numerically identical to
+    masked ``dot_product_attention`` on the same (dequantized) inputs
+    (the parity tests pin greedy and beam decode against it).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -999,6 +1048,14 @@ def flash_decode(
         ):
             if bd not in (1, full):
                 raise ValueError(f"bias dim {i} is {bd}, must be 1 or {full}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    has_scales = k_scale is not None
+    if has_scales:
+        want = (batch, heads, kv_len)
+        for name, s in (("k_scale", k_scale), ("v_scale", v_scale)):
+            if tuple(s.shape) != want:
+                raise ValueError(f"{name} shape {tuple(s.shape)} != {want}")
     if interpret is None:
         interpret = _default_interpret()
     offsets = jnp.asarray(offsets, jnp.int32).reshape(batch)
@@ -1011,12 +1068,20 @@ def flash_decode(
     def kv_map(b, h, ki):
         return (b, h, ki, 0)
 
+    def scale_map(b, h, ki):
+        return (b, h, ki)
+
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets, whole array
         pl.BlockSpec((1, 1, q_len, d), q_map),
         pl.BlockSpec((1, 1, block_k, d), kv_map),
         pl.BlockSpec((1, 1, block_k, d), kv_map),
     ]
+    if has_scales:
+        in_specs += [
+            pl.BlockSpec((1, 1, block_k), scale_map),
+            pl.BlockSpec((1, 1, block_k), scale_map),
+        ]
     if bias is not None:
         inner = _bias_spec(bias.shape, q_len, block_k)
 
@@ -1027,7 +1092,7 @@ def flash_decode(
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=float(scale), block_k=block_k, nk=nk,
-            has_bias=bias is not None,
+            has_bias=bias is not None, has_scales=has_scales,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -1042,7 +1107,7 @@ def flash_decode(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(offsets, *[x for x in (q, k, v, bias) if x is not None])
+    )(offsets, *[x for x in (q, k, v, k_scale, v_scale, bias) if x is not None])
     return out if dtype is None else out.astype(dtype)
 
 
@@ -1063,6 +1128,211 @@ def flash_decode_supported(
     )
 
 
+# ------------------------------------------------- paged decode variant
+#
+# The paged-cache twin of flash_decode (serving/cache_pool.py owns the
+# pool/allocator; this kernel is the device half): K/V live in a SHARED
+# block pool of (num_blocks, H, block_size, d) and each slot maps its
+# logical kv tiles onto pool blocks through a per-slot block table.  The
+# block size IS the kv tile size, so the kernel's tile loop indexes pool
+# blocks directly — the block table rides scalar prefetch and the
+# BlockSpec index maps read it, meaning the DMA fetches exactly the
+# slot's blocks and a flat (slots, H, L, d) view never exists anywhere.
+# A sentinel entry (>= num_blocks: an unallocated logical tile) clamps to
+# a valid block for the DMA and is masked to -inf in-kernel, so whatever
+# the clamped block holds contributes exactly nothing.
+
+
+def _decode_paged_kernel(
+    *refs, scale: float, block_k: int, nk: int, num_blocks: int,
+    has_bias: bool, has_scales: bool,
+):
+    it = iter(refs)
+    bt_ref, off_ref = next(it), next(it)  # scalar-prefetch: (B, nk), (B,)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    ks_ref = next(it) if has_scales else None
+    vs_ref = next(it) if has_scales else None
+    bias_ref = next(it) if has_bias else None
+    o_ref, m_scr, l_scr, acc_scr = it
+    bi = pl.program_id(0)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    offset = off_ref[bi]
+    q_len = q_ref.shape[2]
+    # dead-tile skip as in _decode_kernel, plus: a sentinel block-table
+    # entry is an unallocated tile — nothing of it may contribute
+    allocated = bt_ref[bi, ki] < num_blocks
+    live = jnp.logical_and(ki * block_k <= offset + q_len - 1, allocated)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]  # one pool block's head slice: (block_k, d)
+        if ks_ref is not None:
+            k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= scale
+        if bias_ref is not None:
+            s += bias_ref[0, 0].astype(jnp.float32)
+        q_pos = offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        safe_m = jnp.where(m_next == -jnp.inf, 0.0, m_next)
+        alpha = jnp.exp(m_prev - safe_m)
+        p = jnp.exp(s - safe_m)
+        l_scr[:] = jax.lax.broadcast_in_dim(
+            (alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True))[:, 0],
+            l_scr.shape, (0,),
+        )
+        m_scr[:] = jax.lax.broadcast_in_dim(m_next[:, 0], m_scr.shape, (0,))
+        v = v_ref[0, 0]
+        if vs_ref is not None:
+            v = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def flash_decode_paged(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    block_tables: jnp.ndarray,
+    offsets: jnp.ndarray,
+    k_scale_pool: jnp.ndarray | None = None,
+    v_scale_pool: jnp.ndarray | None = None,
+    scale: float | None = None,
+    interpret: bool | None = None,
+    dtype: jnp.dtype | None = None,
+) -> jnp.ndarray:
+    """Decode attention straight off a shared block pool.
+
+    ``q``: (B, H, Q≤8, d).  ``k_pool``/``v_pool``: (num_blocks, H,
+    block_size, d) — the pool; ``block_tables``: (B, n_tiles) int32
+    mapping each row's logical tile to its pool block (entries >=
+    num_blocks are unallocated tiles and contribute nothing);
+    ``offsets``: (B,) as in ``flash_decode``.  The logical cache length
+    is ``n_tiles × block_size`` and ``bias`` (1-or-full dims) is indexed
+    in LOGICAL tile order.  ``k_scale_pool``/``v_scale_pool``
+    ((num_blocks, H, block_size) f32) compose the int8 KV cache with
+    paging.  Numerically identical to ``flash_decode`` over the
+    flattened view of the same blocks."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    batch, heads, q_len, d = q.shape
+    num_blocks, pool_heads, block_k, pool_d = k_pool.shape
+    if pool_heads != heads or pool_d != d:
+        raise ValueError(
+            f"pool shape {k_pool.shape} does not match q heads/dim "
+            f"({heads}, {d})"
+        )
+    n_tiles = block_tables.shape[1]
+    kv_len = n_tiles * block_k
+    if block_k % 8:
+        raise ValueError(f"block_size {block_k} must be 8-aligned")
+    if bias is not None:
+        for i, (bd, full) in enumerate(
+            zip(bias.shape, (batch, heads, q_len, kv_len))
+        ):
+            if bd not in (1, full):
+                raise ValueError(f"bias dim {i} is {bd}, must be 1 or {full}")
+    if (k_scale_pool is None) != (v_scale_pool is None):
+        raise ValueError("k_scale_pool and v_scale_pool go together")
+    has_scales = k_scale_pool is not None
+    if interpret is None:
+        interpret = _default_interpret()
+    block_tables = jnp.asarray(block_tables, jnp.int32).reshape(batch, n_tiles)
+    offsets = jnp.asarray(offsets, jnp.int32).reshape(batch)
+    grid = (batch, heads, n_tiles)
+    clamp = num_blocks - 1
+
+    def q_map(b, h, ki, bt_ref, off_ref):
+        return (b, h, 0, 0)
+
+    def pool_map(b, h, ki, bt_ref, off_ref):
+        # sentinel tiles clamp to a real block for the DMA; the kernel
+        # masks them to -inf so the clamped contents never contribute
+        return (jnp.minimum(bt_ref[b, ki], clamp), h, 0, 0)
+
+    def pool_scale_map(b, h, ki, bt_ref, off_ref):
+        return (jnp.minimum(bt_ref[b, ki], clamp), h, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, q_len, d), q_map),
+        pl.BlockSpec((1, 1, block_k, d), pool_map),
+        pl.BlockSpec((1, 1, block_k, d), pool_map),
+    ]
+    if has_scales:
+        in_specs += [
+            pl.BlockSpec((1, 1, block_k), pool_scale_map),
+            pl.BlockSpec((1, 1, block_k), pool_scale_map),
+        ]
+    if bias is not None:
+        inner = _bias_spec(bias.shape, q_len, block_k)
+
+        def bias_map(b, h, ki, bt_ref, off_ref):
+            return inner.index_map(b, h, 0, ki)
+
+        in_specs.append(pl.BlockSpec(inner.block_shape, bias_map))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, q_len, d), lambda b, h, ki, bt_ref, off_ref: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((q_len, LANES), jnp.float32),
+            pltpu.VMEM((q_len, LANES), jnp.float32),
+            pltpu.VMEM((q_len, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_paged_kernel, scale=float(scale), block_k=block_k,
+            nk=n_tiles, num_blocks=num_blocks,
+            has_bias=bias is not None, has_scales=has_scales,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        block_tables, offsets,
+        *[
+            x
+            for x in (q, k_pool, v_pool, k_scale_pool, v_scale_pool, bias)
+            if x is not None
+        ],
+    )
+    return out if dtype is None else out.astype(dtype)
+
+
 def flash_decode_run(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -1071,6 +1341,8 @@ def flash_decode_run(
     *,
     offsets: jnp.ndarray,
     mesh,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
     dtype: jnp.dtype | None = None,
     interpret: bool | None = None,
@@ -1078,9 +1350,11 @@ def flash_decode_run(
     """Run the decode kernel — directly on one device, per-shard under
     ``shard_map`` on a mesh (batch over data×fsdp×expert, heads over
     ``tensor``, mirroring ``ops.mha.flash_run``).  ``offsets`` shard with
-    the batch rows; the kernel body needs no collectives (decode never
-    mixes rows or heads).  A bias carrying a HEAD dim must be full-size
-    (it shards with the heads); batch dim 1-or-full as usual."""
+    the batch rows; the int8 KV scales (``k_scale``/``v_scale``) shard
+    exactly like the buffers they dequantize (batch × heads); the kernel
+    body needs no collectives (decode never mixes rows or heads).  A bias
+    carrying a HEAD dim must be full-size (it shards with the heads);
+    batch dim 1-or-full as usual."""
     import math as _math
 
     from jax.sharding import PartitionSpec as P
@@ -1089,22 +1363,32 @@ def flash_decode_run(
 
     if mesh is None or _math.prod(mesh.devices.shape) == 1:
         return flash_decode(
-            q, k, v, bias, offsets=offsets, scale=scale, dtype=dtype,
-            interpret=interpret,
+            q, k, v, bias, offsets=offsets, k_scale=k_scale, v_scale=v_scale,
+            scale=scale, dtype=dtype, interpret=interpret,
         )
     batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
     head_axis = "tensor" if "tensor" in mesh.shape else None
     qkv_spec = P(batch_axes or None, head_axis, None, None)
+    scale_spec = P(batch_axes or None, head_axis, None)
     off_spec = P(batch_axes or None)
+    has_scales = k_scale is not None
 
     def run(q, k, v, off, *rest):
+        rest = list(rest)
+        ks = vs = None
+        if has_scales:
+            ks, vs = rest.pop(0), rest.pop(0)
         return flash_decode(
-            q, k, v, rest[0] if rest else None, offsets=off, scale=scale,
+            q, k, v, rest[0] if rest else None, offsets=off,
+            k_scale=ks, v_scale=vs, scale=scale,
             dtype=dtype, interpret=interpret,
         )
 
     args = (q, k, v, jnp.asarray(offsets, jnp.int32).reshape(q.shape[0]))
     in_specs = (qkv_spec, qkv_spec, qkv_spec, off_spec)
+    if has_scales:
+        args = (*args, k_scale, v_scale)
+        in_specs = (*in_specs, scale_spec, scale_spec)
     if bias is not None:
         bias_spec = P(
             (batch_axes or None) if bias.shape[0] != 1 else None,

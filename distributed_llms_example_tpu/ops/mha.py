@@ -315,13 +315,37 @@ class MultiHeadAttention(nn.Module):
         (q_len must be 1; ``mode="drop"`` makes an out-of-range position
         a no-op, which is how idle slots park).  Without it, the whole
         batch writes at the shared ``cache_index`` (the static-batch
-        generation loops)."""
+        generation loops).
+
+        Under ``kv_cache_context("int8")`` the buffers are s8 with
+        per-head per-position f32 ``key_scale``/``value_scale`` leaves
+        (``ops.flash_attention.quantize_kv`` — the owning quantize
+        implementation): each write quantizes its own rows, so nothing
+        ever requantizes.  Returns ``(k, v, k_scale, v_scale, idx)``;
+        scales are None on the f32 path."""
+        from distributed_llms_example_tpu.ops.flash_attention import quantize_kv
+        from distributed_llms_example_tpu.parallel.activation import (
+            current_kv_cache_dtype,
+        )
+
+        int8_kv = current_kv_cache_dtype() == "int8"
+        store_dtype = jnp.int8 if int8_kv else key.dtype
         is_initialized = self.has_variable("cache", "cached_key")
-        cached_k = self.variable("cache", "cached_key", jnp.zeros, key.shape, key.dtype)
-        cached_v = self.variable("cache", "cached_value", jnp.zeros, value.shape, value.dtype)
+        cached_k = self.variable("cache", "cached_key", jnp.zeros, key.shape, store_dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros, value.shape, store_dtype)
+        if int8_kv:
+            k_scale = self.variable(
+                "cache", "key_scale", jnp.zeros, key.shape[:3], jnp.float32
+            )
+            v_scale = self.variable(
+                "cache", "value_scale", jnp.zeros, value.shape[:3], jnp.float32
+            )
         cache_index = self.variable("cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32))
         idx = cache_index.value
         if is_initialized:
+            if int8_kv:
+                key, ks_new = quantize_kv(key)
+                value, vs_new = quantize_kv(value)
             if cache_positions is not None:
                 if key.shape[2] != 1:
                     raise ValueError(
@@ -335,16 +359,32 @@ class MultiHeadAttention(nn.Module):
                     value[:, :, 0, :], mode="drop"
                 )
                 cached_k.value, cached_v.value = k, v
+                if int8_kv:
+                    k_scale.value = k_scale.value.at[b, :, cache_positions].set(
+                        ks_new[:, :, 0], mode="drop"
+                    )
+                    v_scale.value = v_scale.value.at[b, :, cache_positions].set(
+                        vs_new[:, :, 0], mode="drop"
+                    )
                 # the engine owns per-slot offsets; the shared counter is
                 # meaningless here and stays put
             else:
                 k = jax.lax.dynamic_update_slice(cached_k.value, key, (0, 0, idx, 0))
                 v = jax.lax.dynamic_update_slice(cached_v.value, value, (0, 0, idx, 0))
                 cached_k.value, cached_v.value = k, v
+                if int8_kv:
+                    k_scale.value = jax.lax.dynamic_update_slice(
+                        k_scale.value, ks_new, (0, 0, idx)
+                    )
+                    v_scale.value = jax.lax.dynamic_update_slice(
+                        v_scale.value, vs_new, (0, 0, idx)
+                    )
                 cache_index.value = idx + key.shape[2]
         else:
             k, v = cached_k.value, cached_v.value
-        return k, v, idx
+        if int8_kv:
+            return k, v, k_scale.value, v_scale.value, idx
+        return k, v, None, None, idx
 
     def __call__(
         self,
@@ -393,6 +433,7 @@ class MultiHeadAttention(nn.Module):
 
         offset = 0
         decode_offsets = None  # (B,) absolute position of q row 0, cached decode
+        k_scale = v_scale = None  # int8 KV cache scales (f32 path: None)
         if use_cache and self.causal:
             # RoPE must see absolute positions, so rotate before caching
             if self.use_rope:
@@ -411,7 +452,7 @@ class MultiHeadAttention(nn.Module):
                 cos, sin = cos[:, None], sin[:, None]  # add heads axis
                 q = apply_rope(q, cos, sin)
                 k = apply_rope(k, cos, sin)
-            k, v, offset = self._cache_kv(k, v, cache_positions)
+            k, v, k_scale, v_scale, offset = self._cache_kv(k, v, cache_positions)
             # validity + causality are the DECODE dispatch's job below:
             # per-row offsets feed either the decode kernel's in-kernel
             # length mask or decode_step_bias on the XLA path
@@ -439,6 +480,9 @@ class MultiHeadAttention(nn.Module):
             rep = self.num_heads // self.kv_heads
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
+            if k_scale is not None:
+                k_scale = jnp.repeat(k_scale, rep, axis=1)
+                v_scale = jnp.repeat(v_scale, rep, axis=1)
 
         # causal masking for the non-cached path is applied here (the cached
         # path built step_bias above): natively by the flash kernel, or as an
@@ -512,12 +556,23 @@ class MultiHeadAttention(nn.Module):
             _log_impl_once(impl, reason)
             if impl == "flash_decode":
                 # bias here is the caller's constant padding mask only —
-                # validity/causality ride the kernel's per-row length mask
+                # validity/causality ride the kernel's per-row length mask;
+                # int8 KV scales dequantize per kv tile inside the kernel
                 out = flash_decode_run(
                     q, k, v, bias, offsets=decode_offsets, mesh=mesh,
+                    k_scale=k_scale, v_scale=v_scale,
                     dtype=self.dtype,
                 )
             else:
+                if k_scale is not None:
+                    # the XLA fallback dequantizes through the IDENTICAL
+                    # expression the kernel evaluates per tile
+                    from distributed_llms_example_tpu.ops.flash_attention import (
+                        dequantize_kv,
+                    )
+
+                    k = dequantize_kv(k, k_scale)
+                    v = dequantize_kv(v, v_scale)
                 step = decode_step_bias(decode_offsets, q.shape[2], k.shape[2])
                 out = dot_product_attention(
                     q, k, v, step if bias is None else bias + step,

@@ -128,6 +128,37 @@ def compat_shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
     )
 
 
+def current_kv_cache_dtype() -> str:
+    """The serving KV-cache storage dtype for programs traced under
+    ``kv_cache_context`` — ``"f32"`` (store K/V at compute dtype, the
+    default) or ``"int8"`` (quantize on cache write with per-head
+    per-position symmetric scales; ``ops/flash_attention.py`` owns the
+    quantize/dequantize math).  A trace-time knob exactly like the
+    ambient mesh: the attention modules' ``_cache_kv`` reads it when
+    creating/writing cache variables, so the flag never threads through
+    every model signature."""
+    return getattr(_state, "kv_cache_dtype", "f32")
+
+
+@contextlib.contextmanager
+def kv_cache_context(dtype: str):
+    """Install the KV-cache storage dtype for tracing (see
+    ``current_kv_cache_dtype``).  Must wrap BOTH the cache-allocating
+    program (prefill / init) and every program that reads or writes the
+    cache — the serving engine and the static runners wrap all their
+    jitted calls, so one engine is internally consistent by construction."""
+    if dtype not in ("f32", "int8"):
+        raise ValueError(
+            f"kv_cache_dtype={dtype!r}: must be 'f32' or 'int8'"
+        )
+    prev = current_kv_cache_dtype()
+    _state.kv_cache_dtype = dtype
+    try:
+        yield
+    finally:
+        _state.kv_cache_dtype = prev
+
+
 def current_manual_seq() -> tuple[str, int] | None:
     """(axis_name, axis_size) when tracing inside a manual region that owns
     the sequence axis (the stage×sequence pipeline), else None."""
@@ -203,12 +234,37 @@ def constrain_kv(x: jax.Array) -> jax.Array:
     return constrain(x, kv_leaf_spec(x.shape, dict(mesh.shape)))
 
 
+def constrain_kv_scale(x: jax.Array) -> jax.Array:
+    """(batch, heads, len) int8-KV-cache scale leaf: same layout as the K/V
+    buffer it scales, minus the head_dim axis (``kv_scale_spec`` — the one
+    definition, like ``kv_leaf_spec`` for the buffers)."""
+    mesh = current_mesh()
+    if mesh is None or x.ndim != 3:
+        return x
+    from distributed_llms_example_tpu.parallel.sharding import kv_scale_spec
+
+    return constrain(x, kv_scale_spec(x.shape, dict(mesh.shape)))
+
+
 def constrain_cache(tree):
     """Pin a whole flax "cache" collection (or cross-KV tuple tree) to the
-    serving layout: every 4-D leaf via ``constrain_kv``, scalars (the
-    ``cache_index`` counters) replicated by GSPMD default.  No-op without
-    an ambient mesh — the decode/prefill programs call it unconditionally,
-    exactly like the models call ``constrain_hidden``."""
-    return jax.tree.map(
-        lambda x: constrain_kv(x) if getattr(x, "ndim", 0) == 4 else x, tree
-    )
+    serving layout: every 4-D leaf via ``constrain_kv``, 3-D ``*_scale``
+    leaves (the int8 KV cache's per-head per-position scales) via
+    ``constrain_kv_scale``, scalars (the ``cache_index`` counters)
+    replicated by GSPMD default.  No-op without an ambient mesh — the
+    decode/prefill programs call it unconditionally, exactly like the
+    models call ``constrain_hidden``."""
+    import jax.tree_util as jtu
+
+    def leaf_key(path) -> str:
+        return str(path[-1].key) if path and hasattr(path[-1], "key") else ""
+
+    def pin(path, x):
+        nd = getattr(x, "ndim", 0)
+        if nd == 4:
+            return constrain_kv(x)
+        if nd == 3 and leaf_key(path).endswith("_scale"):
+            return constrain_kv_scale(x)
+        return x
+
+    return jtu.tree_map_with_path(pin, tree)

@@ -142,12 +142,35 @@ def default_rules() -> ShardingRules:
 # programs.
 CACHE_RULES: list[tuple[str, P]] = [
     (r"(cached_key|cached_value)$", P(("data", "fsdp", "expert"), "tensor", None, None)),
+    # int8 KV cache (--kv-cache-dtype int8): per-head per-position f32
+    # scales, (batch, heads, len) — the K/V layout minus head_dim, so the
+    # scales always live next to the buffers they dequantize
+    (r"(key_scale|value_scale)$", P(("data", "fsdp", "expert"), "tensor", None)),
     (r"cache_index$", P()),
 ]
 
 
 def cache_rules() -> ShardingRules:
     return ShardingRules(rules=CACHE_RULES)
+
+
+# Paged serving state (--paged-kv): the shared block pool replaces the
+# per-slot K/V buffers as the resident serving tree.  Blocks belong to
+# individual slots, so the block dim cannot shard over the batch axes the
+# way slot rows do (a slot's blocks would scatter across devices and every
+# gather would cross the mesh); heads still split over ``tensor`` like the
+# projections that produce them.  ``analysis/spec_lint.py
+# lint_cache_sharding`` validates this rule set over the abstract pool
+# exactly like CACHE_RULES over the slot cache.
+POOL_RULES: list[tuple[str, P]] = [
+    (r"(cached_key|cached_value)$", P(None, "tensor", None, None)),
+    (r"(key_scale|value_scale)$", P(None, "tensor", None)),
+    (r"cache_index$", P()),
+]
+
+
+def pool_rules() -> ShardingRules:
+    return ShardingRules(rules=POOL_RULES)
 
 
 def kv_leaf_spec(shape: tuple, mesh_axes: Any) -> P:
@@ -169,6 +192,16 @@ def kv_leaf_spec(shape: tuple, mesh_axes: Any) -> P:
         "tensor" if shape[1] % max(mesh_axes.get("tensor", 1), 1) == 0 else None
     )
     return P(batch, heads, None, None)
+
+
+def kv_scale_spec(shape: tuple, mesh_axes: Any) -> P:
+    """The CACHE_RULES layout for one (batch, heads, len) int8-KV scale
+    leaf — ``kv_leaf_spec`` minus the head_dim axis, divisibility-guarded
+    the same way.  THE single definition of the scale layout:
+    ``activation.constrain_kv_scale`` and the engine's host placement
+    both derive from it."""
+    full = kv_leaf_spec((*shape, 1), mesh_axes)
+    return P(full[0], full[1], None)
 
 
 # Pipelined (stage>1) param layout: stacked block trees shard their leading

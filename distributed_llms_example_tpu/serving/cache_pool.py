@@ -1,0 +1,291 @@
+"""Paged KV-cache: a shared block pool + host-side free-list allocator.
+
+The flat serving state charges every decode slot ``max_source_length``
+worth of cache whether its prompt needs it or not — the exact capacity
+ceiling the Gemma-on-TPU serving comparison (arXiv:2605.25645) names.
+Here slots become BLOCK LISTS over a shared pool (vLLM-style paging,
+restated for the fixed-shape SPMD engine):
+
+- the resident serving state is one fixed-shape pool tensor per cache
+  leaf — ``(num_blocks, heads, block_size, head_dim)`` — so admitting or
+  evicting a request never changes a compiled shape (no recompiles);
+- a request holds ``ceil(prompt_len / block_size)`` prompt blocks plus
+  ``ceil(budget / block_size)`` decode blocks — bytes scale with the
+  ACTUAL prompt, not the worst case;
+- allocation/free is pure host bookkeeping (``CachePool``) between
+  jitted steps, mirroring how the engine already admits/evicts slots;
+  blocks are identityless, so "fragmentation" cannot strand capacity —
+  any request whose block count fits the free list is admissible;
+- the compiled decode step reads the pool through a per-slot block
+  table: on the kernel path ``ops.flash_attention.flash_decode_paged``
+  indexes pool blocks directly in its tile loop (block size == kv tile
+  size); the XLA path gathers a slot view with ``mode="fill"`` zeros for
+  unallocated tiles, which the attention mask makes contribute exactly
+  nothing — that fill is what makes paged decode BIT-identical to flat.
+
+Stale blocks are unreachable by the same argument PR 7 made for slot
+reuse, restated per block: a freed block re-enters the pool with its old
+contents, but every read is masked to ``k_pos <= offset`` (decode tail)
+or to the attention mask (prompt region), so a new owner's output cannot
+observe the previous owner's K/V.  The ``pool-garbage-invariant`` test
+pins this by poisoning the whole pool at init.
+
+Spec lint: ``parallel/sharding.py POOL_RULES`` is the pool's rule set,
+validated by ``analysis/spec_lint.py lint_cache_sharding`` exactly like
+``CACHE_RULES`` for the flat cache.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+# ----------------------------------------------------- host-side allocator
+
+
+class CachePool:
+    """Free-list allocator over identityless cache blocks (pure host).
+
+    The engine calls ``alloc`` at admission and ``free`` at eviction —
+    between jitted steps, like every other piece of slot bookkeeping.
+    Invariants (property-tested): a block is never handed out twice,
+    ``blocks_free + blocks_in_use == num_blocks`` always, double-free and
+    foreign-free raise."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # pop() from the end → blocks hand out in ascending order, which
+        # keeps tests readable; correctness never depends on the order
+        self._free: list[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._used: set[int] = set()
+
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return len(self._used)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` blocks, or None when the free list is short (the caller
+        defers admission — never a partial grant)."""
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} blocks")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._used.update(out)
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b not in self._used:
+                raise ValueError(
+                    f"block {b} is not allocated (double-free or foreign id)"
+                )
+            self._used.remove(b)
+            self._free.append(b)
+
+
+def blocks_needed(prompt_len: int, budget: int, block_size: int) -> int:
+    """Blocks one request holds for its whole lifetime: prompt tiles by
+    ACTUAL length + decode tiles by its token budget — allocated once at
+    admission, so a slot never stalls mid-decode waiting for a block."""
+    return max(
+        1, math.ceil(max(prompt_len, 1) / block_size)
+    ) + math.ceil(max(budget, 1) / block_size)
+
+
+def build_block_row(
+    n_tiles: int,
+    blocks: Sequence[int],
+    *,
+    prompt_len: int,
+    bucket_width: int,
+    budget: int,
+    block_size: int,
+    sentinel: int,
+):
+    """One slot's block-table row: prompt tiles ``[0, ceil(len/bs))`` and
+    decode tiles ``[bucket/bs, bucket/bs + ceil(budget/bs))`` take the
+    allocated blocks in order; everything else (the padding gap between
+    the true prompt and the bucket width, and the tail past the budget)
+    stays at ``sentinel`` — reads of those tiles fill zeros, writes drop."""
+    import numpy as np
+
+    if bucket_width % block_size:
+        raise ValueError(
+            f"bucket width {bucket_width} must be a multiple of the block "
+            f"size {block_size} (decode tiles must start on a tile boundary)"
+        )
+    row = np.full(n_tiles, sentinel, np.int32)
+    prompt_tiles = max(1, math.ceil(max(prompt_len, 1) / block_size))
+    decode_tile0 = bucket_width // block_size
+    decode_tiles = math.ceil(max(budget, 1) / block_size)
+    want = prompt_tiles + decode_tiles
+    if len(blocks) != want:
+        raise ValueError(f"got {len(blocks)} blocks for {want} tiles")
+    row[:prompt_tiles] = blocks[:prompt_tiles]
+    row[decode_tile0 : decode_tile0 + decode_tiles] = blocks[prompt_tiles:]
+    return row
+
+
+# ------------------------------------------------ in-program pool plumbing
+#
+# These run INSIDE the engine's jitted admit/step programs.  Leaf
+# conventions mirror the flax cache collection: 4-D (slots, heads, len,
+# head_dim) K/V buffers, 3-D (slots, heads, len) int8-KV scale leaves,
+# scalars (cache_index) pass through untouched.
+
+
+def pool_cache_tree(abstract_cache: Any, num_blocks: int, block_size: int):
+    """Zeroed pool tree with the same structure as a slot-view cache tree:
+    every K/V leaf becomes ``(num_blocks, heads, block_size[, head_dim])``,
+    scalars stay scalars.  The ONE place slot-view shapes map to pool
+    shapes."""
+
+    def to_pool(x):
+        nd = len(getattr(x, "shape", ()))
+        if nd == 4:
+            return jnp.zeros(
+                (num_blocks, x.shape[1], block_size, x.shape[3]), x.dtype
+            )
+        if nd == 3:
+            return jnp.zeros((num_blocks, x.shape[1], block_size), x.dtype)
+        return jnp.zeros(getattr(x, "shape", ()), x.dtype)
+
+    return jax.tree.map(to_pool, abstract_cache)
+
+
+def gather_cache(pool_tree: Any, block_tables: jnp.ndarray):
+    """Slot-view cache tree from the pool through the block tables —
+    ``mode="fill"`` zeros for sentinel (unallocated) tiles, which the
+    attention masks make contribute exactly nothing (the paged==flat
+    bit-identity argument).  The view is a STEP-TRANSIENT on the XLA
+    path — only the pool is resident between steps; the kernel path
+    (``flash_decode_paged``) never materializes it at all."""
+    n_tiles = block_tables.shape[1]
+
+    def view(x):
+        if x.ndim == 4:
+            g = jnp.take(x, block_tables, axis=0, mode="fill", fill_value=0)
+            g = g.transpose(0, 2, 1, 3, 4)  # (S, H, nt, bs, D)
+            return g.reshape(g.shape[0], g.shape[1], n_tiles * x.shape[2], x.shape[3])
+        if x.ndim == 3:
+            g = jnp.take(x, block_tables, axis=0, mode="fill", fill_value=0)
+            g = g.transpose(0, 2, 1, 3)
+            return g.reshape(g.shape[0], g.shape[1], n_tiles * x.shape[2])
+        return x
+
+    return jax.tree.map(view, pool_tree)
+
+
+def scatter_step(
+    pool_tree: Any,
+    new_cache: Any,
+    block_tables: jnp.ndarray,
+    offsets: jnp.ndarray,
+    *,
+    num_blocks: int,
+    block_size: int,
+):
+    """Write each slot's just-decoded cache row (position ``offsets[s]``
+    of the slot view) back into its pool block.  Parked slots (offset
+    past the view width) and sentinel tiles resolve to an out-of-range
+    block index, so their writes drop — the paged twin of the flat
+    path's ``mode="drop"`` scatter."""
+    n_tiles = block_tables.shape[1]
+    width = n_tiles * block_size
+    rows = jnp.arange(offsets.shape[0])
+    tile = jnp.clip(offsets // block_size, 0, n_tiles - 1)
+    blocks = jnp.take_along_axis(block_tables, tile[:, None], axis=1)[:, 0]
+    blocks = jnp.where(offsets < width, blocks, num_blocks)
+    inb = offsets % block_size
+    safe = jnp.clip(offsets, 0, width - 1)
+
+    def scat(pool, flat):
+        if pool.ndim == 4:
+            row = flat[rows, :, safe, :]  # (S, H, D)
+            return pool.at[blocks, :, inb, :].set(row, mode="drop")
+        if pool.ndim == 3:
+            row = flat[rows, :, safe]
+            return pool.at[blocks, :, inb].set(row, mode="drop")
+        return pool
+
+    return jax.tree.map(scat, pool_tree, new_cache)
+
+
+def scatter_admit(
+    pool_tree: Any, chunk_cache: Any, admit_blocks: jnp.ndarray, block_size: int
+):
+    """Copy a prefilled admission chunk's allocated tiles into the pool.
+
+    ``chunk_cache`` leaves are (chunk, heads, width, head_dim) at the
+    BUCKET width; ``admit_blocks`` is the flat (chunk × tiles,) block
+    assignment with sentinel entries for tiles that must not copy
+    (padding rows, the prompt-gap region).  Decode tiles DO copy — the
+    chunk cache is zeros there, which scrubs whatever a freed block held
+    and keeps the paged==flat bit-identity argument airtight."""
+
+    def scat(pool, chunk):
+        nd = chunk.ndim
+        if nd == 4:
+            c, h, lc, d = chunk.shape
+            nt = lc // block_size
+            tiles = (
+                chunk.reshape(c, h, nt, block_size, d)
+                .transpose(0, 2, 1, 3, 4)
+                .reshape(c * nt, h, block_size, d)
+            )
+            return pool.at[admit_blocks].set(tiles, mode="drop")
+        if nd == 3:
+            c, h, lc = chunk.shape
+            nt = lc // block_size
+            tiles = (
+                chunk.reshape(c, h, nt, block_size)
+                .transpose(0, 2, 1, 3)
+                .reshape(c * nt, h, block_size)
+            )
+            return pool.at[admit_blocks].set(tiles, mode="drop")
+        return pool
+
+    return jax.tree.map(scat, pool_tree, chunk_cache)
+
+
+# --------------------------------------------------------- byte accounting
+
+
+def tree_bytes(tree: Any) -> int:
+    """Static byte account of a pytree (arrays or ShapeDtypeStructs) —
+    the resident-footprint number the capacity gauges and the bench's
+    ``cache_bytes_per_token`` report, measured nowhere near a device."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()))
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+        total += int(math.prod(shape)) * int(itemsize)
+    return total
+
+
+def block_bytes(pool_tree: Any, num_blocks: int) -> int:
+    """Bytes ONE pool block accounts for across every cache leaf."""
+    total = 0
+    for leaf in jax.tree.leaves(pool_tree):
+        if len(getattr(leaf, "shape", ())) >= 3:
+            total += int(
+                math.prod(leaf.shape) * leaf.dtype.itemsize
+            ) // max(num_blocks, 1)
+    return total

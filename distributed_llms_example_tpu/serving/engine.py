@@ -66,7 +66,9 @@ from distributed_llms_example_tpu.parallel.activation import (
     BATCH_AXES,
     activation_mesh,
     constrain_cache,
+    kv_cache_context,
 )
+from distributed_llms_example_tpu.serving import cache_pool
 from distributed_llms_example_tpu.utils.jsonlog import log_json
 
 
@@ -88,7 +90,25 @@ class ServeConfig:
     ``ttft_slo_ms``: the first-token SLO the goodput fields are judged
     against (0 = no SLO: every finished request's tokens are useful) —
     the router tier's dispatch inputs (``serve_summary``:
-    ``goodput_tokens_per_sec`` + ``slo_attainment``)."""
+    ``goodput_tokens_per_sec`` + ``slo_attainment``).
+
+    Decode-capacity knobs (README "Serving capacity"):
+
+    ``kv_cache_dtype``: "f32" (store K/V at compute dtype) or "int8"
+    (quantize on cache write, per-head per-position scales; ~4× less
+    cache HBM and decode traffic at a token-match-rate tolerance — the
+    paged/bucketed knobs below stay BIT-exact instead).
+    ``prefill_buckets``: ascending compiled admission widths (e.g.
+    ``(128, 256, 512)``); each admission chunk pads to the smallest
+    bucket covering it instead of always paying ``max_source_length``,
+    and every bucket's programs are AOT-warmed before the first request
+    so no request ever hits a compile.  ``max_source_length`` is always
+    an implicit last bucket.
+    ``paged_kv`` (causal families only): slots hold block lists over a
+    shared pool (serving/cache_pool.py) instead of worst-case-width
+    rows; ``pool_blocks`` (0 = worst case: every slot at full width) and
+    ``kv_block_size`` (0 = auto kv tile size) shape the pool.  Admission
+    defers while the free list is short; eviction returns all blocks."""
 
     max_slots: int = 8
     prefill_batch: int = 0  # 0 = max_slots
@@ -97,6 +117,11 @@ class ServeConfig:
     log_every_steps: int = 50
     request_spans: bool = True
     ttft_slo_ms: float = 0.0
+    kv_cache_dtype: str = "f32"
+    prefill_buckets: tuple = ()
+    paged_kv: bool = False
+    pool_blocks: int = 0  # 0 = worst case (max_slots x tiles per slot)
+    kv_block_size: int = 0  # 0 = auto (the kv tile size for the cache width)
 
 
 @dataclasses.dataclass
@@ -109,6 +134,15 @@ class ServeStats:
     decode_seconds: float = 0.0
     prefill_seconds: float = 0.0
     slot_occupancy: float = 0.0
+    # capacity gauges (static byte accounting — measured, not inferred):
+    # resident = the serving state's fixed allocation; in_use = what live
+    # requests actually hold (= resident on the flat path; blocks×block
+    # bytes on the paged path); bytes_per_live_token averages in_use over
+    # the live tokens at each decode step
+    cache_bytes_resident: int = 0
+    peak_cache_bytes_in_use: int = 0
+    bytes_per_live_token: float = 0.0
+    admit_deferrals: int = 0  # paged: admissions deferred on a short free list
     ttft_s: list[float] = dataclasses.field(default_factory=list)
     # per-request TTFT decomposition (same order as ttft_s): time spent
     # waiting for a slot vs inside the request's prefill call
@@ -186,6 +220,21 @@ def compute_goodput(
     return out
 
 
+def device_peak_bytes() -> int | None:
+    """Peak allocator bytes from ``memory_stats`` where the backend
+    supports it (TPU/GPU); None on CPU — callers fall back to the static
+    account, which is why the capacity gauges never claim a live number
+    they didn't measure."""
+    try:
+        ms = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not ms:
+        return None
+    peak = ms.get("peak_bytes_in_use")
+    return int(peak) if peak is not None else None
+
+
 class ServingEngine:
     """Greedy continuous-batching decode over a fixed slot set.
 
@@ -213,6 +262,63 @@ class ServingEngine:
                 f"prefill_batch {self.prefill_batch} must be in "
                 f"[1, max_slots={self.S}]"
             )
+        if self.serve.kv_cache_dtype not in ("f32", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={self.serve.kv_cache_dtype!r}: "
+                "must be 'f32' or 'int8'"
+            )
+        # admission buckets: ascending widths, max_source_length always the
+        # implicit last bucket (every prompt fits somewhere)
+        self.buckets = tuple(
+            sorted({int(b) for b in self.serve.prefill_buckets if 0 < int(b) < self.W})
+        ) + (self.W,)
+        self.paged = bool(self.serve.paged_kv)
+        self.pool: cache_pool.CachePool | None = None
+        if self.paged:
+            if self.is_seq2seq:
+                raise ValueError(
+                    "paged_kv applies to the causal KV cache (prompt + "
+                    "decode tail in one buffer); the seq2seq slot state is "
+                    "encoder output + cross-KV, which pages nothing — run "
+                    "the flat cache for seq2seq families"
+                )
+            from distributed_llms_example_tpu.ops.flash_attention import auto_block
+
+            width = self.W + self.L
+            bs = self.serve.kv_block_size
+            if not bs:
+                # the block size must tile the cache width AND every
+                # admission bucket (decode tiles start on tile boundaries),
+                # so the auto default divides their gcd — kernel-preferred
+                # tile when the gcd allows, else the gcd itself (8-aligned)
+                g = math.gcd(width, *self.buckets)
+                bs = auto_block(g) or (g if g >= 8 and g % 8 == 0 else 0)
+            if not bs or width % bs:
+                raise ValueError(
+                    f"kv_block_size={self.serve.kv_block_size} does not tile "
+                    f"the cache width {width} (prompt {self.W} + decode "
+                    f"{self.L}); pass an explicit 8-aligned divisor of "
+                    f"gcd(width, buckets) = "
+                    f"{math.gcd(width, *self.buckets)}"
+                )
+            for b in self.buckets:
+                if b % bs:
+                    raise ValueError(
+                        f"prefill bucket {b} is not a multiple of the kv "
+                        f"block size {bs} — decode tiles must start on a "
+                        "tile boundary"
+                    )
+            self.block_size = int(bs)
+            self.n_tiles = width // self.block_size
+            n_blocks = self.serve.pool_blocks or self.S * self.n_tiles
+            worst = cache_pool.blocks_needed(self.W, self.L, self.block_size)
+            if n_blocks < worst:
+                raise ValueError(
+                    f"pool_blocks={n_blocks} cannot hold even one "
+                    f"worst-case request ({worst} blocks at block size "
+                    f"{self.block_size}) — admission would livelock"
+                )
+            self.pool = cache_pool.CachePool(n_blocks, self.block_size)
         mesh_axes = dict(mesh.shape) if mesh is not None else {}
         # known-bad serving compositions are matrix rows, not scattered
         # raises — same table the trainer/lint consult
@@ -234,11 +340,16 @@ class ServingEngine:
                     f"{batch_shards} batch shards (data×fsdp×expert) — "
                     "uneven slot rows cannot shard"
                 )
+        # per-program Python trace counts: a retrace IS a recompile, so the
+        # zero-recompile contract (AOT-warmed buckets, fixed-shape churn)
+        # is pinnable by comparing these before/after serving traffic
+        self.trace_counts: dict[str, int] = {}
+        self._warmed = False
         self._build_programs()
         self.last_stats: ServeStats | None = None
 
     # ------------------------------------------------------------ programs
-    def _wrap(self, fn, donate: tuple[int, ...] = ()):
+    def _wrap(self, fn, donate: tuple[int, ...] = (), name: str = ""):
         # donate the slot-state buffers where the backend supports it: the
         # engine holds the only reference and rebinds the result, so the
         # per-step cache update happens in place instead of copying the
@@ -246,13 +357,35 @@ class ServingEngine:
         # test backend quiet)
         if jax.default_backend() == "cpu":
             donate = ()
-        jitted = jax.jit(fn, donate_argnums=donate)
+        name = name or getattr(fn, "__name__", "program")
+
+        def counted(*args):
+            # runs at TRACE time only: one bump per compiled specialization
+            self.trace_counts[name] = self.trace_counts.get(name, 0) + 1
+            return fn(*args)
+
+        jitted = jax.jit(counted, donate_argnums=donate)
 
         def run(*args):
-            with activation_mesh(self.mesh):
+            with activation_mesh(self.mesh), kv_cache_context(
+                self.serve.kv_cache_dtype
+            ):
                 return jitted(*args)
 
         return run
+
+    @staticmethod
+    def _pad_axis(x, axis: int, width: int):
+        """Right-pad one axis to ``width`` with zeros — how a bucket-width
+        admission chunk lands in full-width slot state.  The padding is
+        mask-invisible: enc_mask/full_mask stay 0 there, so padded
+        positions contribute exactly nothing (the bucketed == unbucketed
+        bit-identity argument)."""
+        if x.shape[axis] == width:
+            return x
+        pads = [(0, 0)] * x.ndim
+        pads[axis] = (0, width - x.shape[axis])
+        return jnp.pad(x, pads)
 
     def _build_programs(self) -> None:
         model, L, S = self.model, self.L, self.S
@@ -265,6 +398,14 @@ class ServingEngine:
 
             def admit(state, enc, mask, ckv, slot_idx):
                 put = lambda dst, src: dst.at[slot_idx].set(src, mode="drop")  # noqa: E731
+                # bucket-width chunks pad to the slot width here, inside
+                # the (per-bucket-compiled) admit program
+                enc = self._pad_axis(enc, 1, self.W)
+                mask = self._pad_axis(mask, 1, self.W)
+                ckv = jax.tree.map(
+                    lambda x: self._pad_axis(x, 2, self.W) if x.ndim == 4 else x,
+                    ckv,
+                )
                 return {
                     **state,
                     "enc": put(state["enc"], enc),
@@ -310,49 +451,113 @@ class ServingEngine:
                 )
                 return cache, full_mask, lengths, jnp.argmax(first, axis=-1).astype(jnp.int32)
 
-            def admit(state, cache, full_mask, first_tok, slot_idx):
-                put = lambda dst, src: (  # noqa: E731
-                    dst.at[slot_idx].set(src, mode="drop") if dst.ndim > 0 else dst
-                )
-                return {
-                    **state,
-                    "cache": jax.tree.map(put, state["cache"], cache),
-                    "mask": put(state["mask"], full_mask),
-                    "last": put(state["last"], first_tok),
-                }
+            width_full = self.W + L
 
-            def step(params, state, write_pos, rope_pos, active):
-                width = state["mask"].shape[1]
-                offs = jnp.where(active, write_pos, width)
-                mask = state["mask"].at[jnp.arange(S), offs].set(1, mode="drop")
-                logits, mut = model.apply(
-                    {"params": params, "cache": state["cache"]},
-                    state["last"][:, None],
-                    mask,
-                    use_cache=True,
-                    positions=rope_pos[:, None],
-                    cache_positions=offs,
-                    mutable=["cache"],
-                )
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                nxt = jnp.where(active, nxt, self.pad)
-                return nxt, {
-                    **state,
-                    "cache": constrain_cache(mut["cache"]),
-                    "mask": mask,
-                    "last": nxt,
-                }
+            def _pad_cache_tree(cache):
+                # bucket-width chunk cache → slot width; K/V on axis 2,
+                # int8 scale leaves on axis 2 too, scalars untouched
+                def pad(x):
+                    if x.ndim >= 3:
+                        return self._pad_axis(x, 2, width_full)
+                    return x
+
+                return jax.tree.map(pad, cache)
+
+            if self.paged:
+                n_blocks, bs = self.pool.num_blocks, self.block_size
+
+                def admit(state, cache, full_mask, first_tok, slot_idx,
+                          admit_blocks):
+                    put = lambda dst, src: (  # noqa: E731
+                        dst.at[slot_idx].set(src, mode="drop") if dst.ndim > 0 else dst
+                    )
+                    return {
+                        **state,
+                        "pool": cache_pool.scatter_admit(
+                            state["pool"], cache, admit_blocks, bs
+                        ),
+                        "mask": put(state["mask"], self._pad_axis(full_mask, 1, width_full)),
+                        "last": put(state["last"], first_tok),
+                    }
+
+                def step(params, state, block_tables, write_pos, rope_pos, active):
+                    width = state["mask"].shape[1]
+                    offs = jnp.where(active, write_pos, width)
+                    mask = state["mask"].at[jnp.arange(S), offs].set(1, mode="drop")
+                    # the slot view is a step-transient: only the pool is
+                    # resident between steps (serving/cache_pool.py)
+                    cache = constrain_cache(
+                        cache_pool.gather_cache(state["pool"], block_tables)
+                    )
+                    logits, mut = model.apply(
+                        {"params": params, "cache": cache},
+                        state["last"][:, None],
+                        mask,
+                        use_cache=True,
+                        positions=rope_pos[:, None],
+                        cache_positions=offs,
+                        mutable=["cache"],
+                    )
+                    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(active, nxt, self.pad)
+                    pool = cache_pool.scatter_step(
+                        state["pool"], mut["cache"], block_tables, offs,
+                        num_blocks=n_blocks, block_size=bs,
+                    )
+                    return nxt, {
+                        **state,
+                        "pool": pool,
+                        "mask": mask,
+                        "last": nxt,
+                    }
+            else:
+                def admit(state, cache, full_mask, first_tok, slot_idx):
+                    put = lambda dst, src: (  # noqa: E731
+                        dst.at[slot_idx].set(src, mode="drop") if dst.ndim > 0 else dst
+                    )
+                    return {
+                        **state,
+                        "cache": jax.tree.map(put, state["cache"], _pad_cache_tree(cache)),
+                        "mask": put(state["mask"], self._pad_axis(full_mask, 1, width_full)),
+                        "last": put(state["last"], first_tok),
+                    }
+
+                def step(params, state, write_pos, rope_pos, active):
+                    width = state["mask"].shape[1]
+                    offs = jnp.where(active, write_pos, width)
+                    mask = state["mask"].at[jnp.arange(S), offs].set(1, mode="drop")
+                    logits, mut = model.apply(
+                        {"params": params, "cache": state["cache"]},
+                        state["last"][:, None],
+                        mask,
+                        use_cache=True,
+                        positions=rope_pos[:, None],
+                        cache_positions=offs,
+                        mutable=["cache"],
+                    )
+                    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(active, nxt, self.pad)
+                    return nxt, {
+                        **state,
+                        "cache": constrain_cache(mut["cache"]),
+                        "mask": mask,
+                        "last": nxt,
+                    }
 
         self._prefill_core = prefill
-        self._prefill = self._wrap(prefill)
-        self._admit = self._wrap(admit, donate=(0,))
-        self._step = self._wrap(step, donate=(1,))
+        self._prefill = self._wrap(prefill, name="prefill")
+        self._admit = self._wrap(admit, donate=(0,), name="admit")
+        self._step = self._wrap(step, donate=(1,), name="decode_step")
 
     # --------------------------------------------------------------- state
-    def _leaf_spec(self, x):
+    def _leaf_spec(self, path: str, x):
         from jax.sharding import PartitionSpec as P
 
-        from distributed_llms_example_tpu.parallel.sharding import kv_leaf_spec
+        from distributed_llms_example_tpu.parallel.sharding import (
+            kv_leaf_spec,
+            kv_scale_spec,
+            pool_rules,
+        )
 
         mesh_axes = dict(self.mesh.shape)
         batch_shards = 1
@@ -361,18 +566,30 @@ class ServingEngine:
         nd = getattr(x, "ndim", 0)
         if nd == 0:
             return P()
+        if path.startswith("pool"):
+            # shared block pool: blocks belong to single slots, so the
+            # block dim never shards over the batch axes — POOL_RULES
+            leaf = path.rsplit("/", 1)[-1]
+            return pool_rules().spec_for(leaf, nd)
         if nd == 4:  # cached/cross K/V: the ONE shared layout definition
             return kv_leaf_spec(x.shape, mesh_axes)
+        if nd == 3 and path.endswith("_scale"):  # int8 KV scales
+            return kv_scale_spec(x.shape, mesh_axes)
         batch = BATCH_AXES if x.shape[0] % max(batch_shards, 1) == 0 else None
         return P(batch, *([None] * (nd - 1)))
 
     def _place(self, tree):
         if self.mesh is None:
             return tree
+        import jax.tree_util as jtu
         from jax.sharding import NamedSharding
 
-        return jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(self.mesh, self._leaf_spec(x))),
+        from distributed_llms_example_tpu.parallel.sharding import _path_str
+
+        return jtu.tree_map_with_path(
+            lambda p, x: jax.device_put(
+                x, NamedSharding(self.mesh, self._leaf_spec(_path_str(p), x))
+            ),
             tree,
         )
 
@@ -381,32 +598,94 @@ class ServingEngine:
         zeros = lambda s: jax.tree.map(  # noqa: E731
             lambda a: jnp.zeros(a.shape, a.dtype), s
         )
-        if self.is_seq2seq:
-            ids = jnp.zeros((S, W), jnp.int32)
-            mask = jnp.zeros((S, W), jnp.int32)
-            a_enc, _, a_ckv = jax.eval_shape(
-                lambda p: self._prefill_core(p, ids, mask), params
-            )
-            enc0 = zeros(a_enc)
-            state = {
-                "cache": _init_cache(self.model, params, S, L, enc0, mask),
-                "enc": enc0,
-                "enc_mask": mask,
-                "ckv": zeros(a_ckv),
-                "last": jnp.full((S, 1), self.pad, jnp.int32),
-            }
-        else:
-            ids = jnp.zeros((S, W), jnp.int32)
-            mask = jnp.zeros((S, W), jnp.int32)
-            a_cache, a_mask, _, _ = jax.eval_shape(
-                lambda p: self._prefill_core(p, ids, mask), params
-            )
-            state = {
-                "cache": zeros(a_cache),
-                "mask": zeros(a_mask),
-                "last": jnp.full((S,), self.pad, jnp.int32),
-            }
+        with kv_cache_context(self.serve.kv_cache_dtype):
+            if self.is_seq2seq:
+                ids = jnp.zeros((S, W), jnp.int32)
+                mask = jnp.zeros((S, W), jnp.int32)
+                a_enc, _, a_ckv = jax.eval_shape(
+                    lambda p: self._prefill_core(p, ids, mask), params
+                )
+                enc0 = zeros(a_enc)
+                state = {
+                    "cache": _init_cache(self.model, params, S, L, enc0, mask),
+                    "enc": enc0,
+                    "enc_mask": mask,
+                    "ckv": zeros(a_ckv),
+                    "last": jnp.full((S, 1), self.pad, jnp.int32),
+                }
+            else:
+                ids = jnp.zeros((S, W), jnp.int32)
+                mask = jnp.zeros((S, W), jnp.int32)
+                a_cache, a_mask, _, _ = jax.eval_shape(
+                    lambda p: self._prefill_core(p, ids, mask), params
+                )
+                if self.paged:
+                    state = {
+                        "pool": cache_pool.pool_cache_tree(
+                            a_cache, self.pool.num_blocks, self.block_size
+                        ),
+                        "mask": zeros(a_mask),
+                        "last": jnp.full((S,), self.pad, jnp.int32),
+                    }
+                else:
+                    state = {
+                        "cache": zeros(a_cache),
+                        "mask": zeros(a_mask),
+                        "last": jnp.full((S,), self.pad, jnp.int32),
+                    }
         return self._place(state)
+
+    # ------------------------------------------------------------ capacity
+    def _state_byte_account(self, state) -> tuple[int, int]:
+        """(resident bytes, per-block bytes) of the serving K/V state —
+        static accounting over the cache/pool/enc/ckv leaves (masks and
+        token vectors are noise).  per-block is 0 on the flat path."""
+        if self.paged:
+            kv = state["pool"]
+            resident = cache_pool.tree_bytes(kv)
+            per_block = cache_pool.block_bytes(kv, self.pool.num_blocks)
+            return resident, per_block
+        keys = ("cache", "enc", "ckv") if self.is_seq2seq else ("cache",)
+        resident = sum(cache_pool.tree_bytes(state[k]) for k in keys if k in state)
+        return resident, 0
+
+    def warm(self, params, state) -> Any:
+        """AOT-warm every compiled program before the first real request:
+        one prefill+admit trace per bucket (zeros, all writes dropped via
+        out-of-range slot indices) and one all-slots-idle decode step —
+        so no request ever pays a compile, and the trace counts are
+        pinned BEFORE traffic (``trace_counts``).  Returns the (possibly
+        donated-and-rebound) state."""
+        if self._warmed:
+            return state
+        C, S = self.prefill_batch, self.S
+        park = jnp.full((C,), S, jnp.int32)  # out of range: every write drops
+        for bucket in self.buckets:
+            ids = jnp.zeros((C, bucket), jnp.int32)
+            mask = jnp.zeros((C, bucket), jnp.int32)
+            pre = self._prefill(params, ids, mask)
+            if self.is_seq2seq:
+                enc, pmask, ckv = pre
+                state = self._admit(state, enc, pmask, ckv, park)
+            elif self.paged:
+                cache, full_mask, _, first = pre
+                ntc = (bucket + self.L) // self.block_size
+                sentinel = jnp.full((C * ntc,), self.pool.num_blocks, jnp.int32)
+                state = self._admit(state, cache, full_mask, first, park, sentinel)
+            else:
+                cache, full_mask, _, first = pre
+                state = self._admit(state, cache, full_mask, first, park)
+        idle = jnp.zeros((S,), bool)
+        pos = jnp.zeros((S,), jnp.int32)
+        if self.is_seq2seq:
+            _, state = self._step(params, state, pos, idle)
+        elif self.paged:
+            bt = jnp.full((S, self.n_tiles), self.pool.num_blocks, jnp.int32)
+            _, state = self._step(params, state, bt, pos, pos, idle)
+        else:
+            _, state = self._step(params, state, pos, pos, idle)
+        self._warmed = True
+        return state
 
     # ---------------------------------------------------------------- loop
     def generate(
@@ -448,12 +727,36 @@ class ServingEngine:
         pending = list(range(len(requests)))[::-1]  # pop() preserves order
         slot_req = np.full(S, -1, np.int64)  # request index per slot
         emitted = np.zeros(S, np.int64)
-        lengths = np.zeros(S, np.int64)  # causal: true prompt lengths
+        lengths = np.zeros(S, np.int64)  # true prompt lengths (both families)
+        base = np.full(S, W, np.int64)  # causal: decode tail start (= the
+        #                                 slot's admission-bucket width)
         active = np.zeros(S, bool)
-        t_submit = time.perf_counter()
+        # paged bookkeeping: block ownership per slot + the block table the
+        # step program reads (sentinel = num_blocks → reads fill zeros,
+        # writes drop)
+        slot_blocks: list[list[int]] = [[] for _ in range(S)]
+        slot_bt = (
+            np.full((S, self.n_tiles), self.pool.num_blocks, np.int32)
+            if self.paged
+            else None
+        )
         state = self._init_state(params)
+        state = self.warm(params, state)
+        t_submit = time.perf_counter()
+        stats.cache_bytes_resident, per_block = self._state_byte_account(state)
+        bpt_samples: list[float] = []
         win_tokens, win_t0, win_occ = 0, time.perf_counter(), 0.0
         win_prefill, win_decode = 0.0, 0.0
+
+        def bytes_in_use() -> int:
+            if self.paged:
+                return self.pool.blocks_in_use * per_block
+            return stats.cache_bytes_resident
+
+        def live_tokens() -> int:
+            # tokens the serving state holds for live requests: true
+            # prompt + generated so far, per active slot
+            return int((lengths[active] + emitted[active]).sum())
 
         def finish_request(req: int, slot: int, now: float) -> None:
             """Evict-time lifecycle record — the trace exporter's feed and
@@ -477,24 +780,82 @@ class ServingEngine:
                 "finished_at_step": int(stats.decode_steps),
             })
 
+        def evict_slot(slot: int) -> None:
+            """Free the slot NOW — and, paged, return every block it held
+            to the pool (the evict-returns-all-blocks contract)."""
+            active[slot] = False
+            slot_req[slot] = -1
+            if self.paged and slot_blocks[slot]:
+                self.pool.free(slot_blocks[slot])
+                slot_blocks[slot] = []
+                slot_bt[slot, :] = self.pool.num_blocks
+
         def admit_now() -> None:
             nonlocal state
             free = [i for i in range(S) if not active[i]]
             n = min(len(free), C, len(pending))
             if n == 0:
                 return
+            plen = lambda req: min(len(requests[req]), W)  # noqa: E731
+            if self.paged:
+                # shrink the chunk until the free list funds it: admission
+                # DEFERS on a short pool instead of over-committing — every
+                # eviction frees blocks, so deferred requests admit later
+                while n > 0:
+                    needed = sum(
+                        cache_pool.blocks_needed(
+                            plen(pending[-1 - i]), budgets[pending[-1 - i]],
+                            self.block_size,
+                        )
+                        for i in range(n)
+                    )
+                    if self.pool.can_alloc(needed):
+                        break
+                    n -= 1
+                if n == 0:
+                    stats.admit_deferrals += 1
+                    return
             reqs = [pending.pop() for _ in range(n)]
-            ids = np.full((C, W), self.pad, np.int32)
-            mask = np.zeros((C, W), np.int32)
+            # the smallest compiled admission width covering this chunk —
+            # short prompts stop paying the max_source_length program
+            bucket = next(
+                b for b in self.buckets if b >= max(plen(req) for req in reqs)
+            )
+            ids = np.full((C, bucket), self.pad, np.int32)
+            mask = np.zeros((C, bucket), np.int32)
             for r, req in enumerate(reqs):
-                toks = list(requests[req])[:W]
+                toks = list(requests[req])[:bucket]
                 ids[r, : len(toks)] = toks
                 mask[r, : len(toks)] = 1
                 if attention_masks is not None:
-                    m = list(attention_masks[req])[:W]
+                    m = list(attention_masks[req])[:bucket]
                     mask[r, : len(m)] = m
             slot_idx = np.full(C, S, np.int32)  # padding rows drop
             slot_idx[:n] = free[:n]
+            admit_rows = None
+            if self.paged:
+                # fund + map each row's blocks BEFORE the program runs: the
+                # flat (chunk × chunk-tiles) assignment carries sentinels
+                # for tiles that must not copy (padding rows, prompt gap)
+                ntc = (bucket + self.L) // self.block_size
+                admit_rows = np.full((C, ntc), self.pool.num_blocks, np.int32)
+                for r, req in enumerate(reqs):
+                    blocks = self.pool.alloc(
+                        cache_pool.blocks_needed(
+                            plen(req), budgets[req], self.block_size
+                        )
+                    )
+                    assert blocks is not None  # funded above
+                    slot = free[r]
+                    slot_blocks[slot] = blocks
+                    row = cache_pool.build_block_row(
+                        self.n_tiles, blocks,
+                        prompt_len=plen(req), bucket_width=bucket,
+                        budget=budgets[req], block_size=self.block_size,
+                        sentinel=self.pool.num_blocks,
+                    )
+                    slot_bt[slot, :] = row
+                    admit_rows[r, :] = row[:ntc]
             t0 = time.perf_counter()
             pre = self._prefill(params, jnp.asarray(ids), jnp.asarray(mask))
             if self.is_seq2seq:
@@ -502,7 +863,15 @@ class ServingEngine:
                 state = self._admit(state, enc, pmask, ckv, jnp.asarray(slot_idx))
             else:
                 cache, full_mask, plens, first = pre
-                state = self._admit(state, cache, full_mask, first, jnp.asarray(slot_idx))
+                if self.paged:
+                    state = self._admit(
+                        state, cache, full_mask, first, jnp.asarray(slot_idx),
+                        jnp.asarray(admit_rows.reshape(-1)),
+                    )
+                else:
+                    state = self._admit(
+                        state, cache, full_mask, first, jnp.asarray(slot_idx)
+                    )
                 plens_h = np.asarray(jax.device_get(plens))
                 first_h = np.asarray(jax.device_get(first))
             dt = time.perf_counter() - t0
@@ -514,6 +883,8 @@ class ServingEngine:
                 slot = free[r]
                 slot_req[slot] = req
                 emitted[slot] = 0
+                lengths[slot] = plen(req)
+                base[slot] = bucket
                 active[slot] = True
                 admit_t[req] = t0
                 prefill_dt[req] = dt
@@ -524,20 +895,31 @@ class ServingEngine:
                     emitted[slot] = 1
                     ttft[req] = now - t_submit
                     if int(first_h[r]) == self.eos or emitted[slot] >= budgets[req]:
-                        active[slot] = False
-                        slot_req[slot] = -1
+                        evict_slot(slot)
                         finish_request(req, slot, now)
+            stats.peak_cache_bytes_in_use = max(
+                stats.peak_cache_bytes_in_use, bytes_in_use()
+            )
 
         while pending or active.any():
             admit_now()
             if not active.any():
                 continue  # every admitted sequence finished at prefill
-            offsets = emitted if self.is_seq2seq else (W + emitted - 1)
+            offsets = emitted if self.is_seq2seq else (base + emitted - 1)
             t0 = time.perf_counter()
             if self.is_seq2seq:
                 tokens, state = self._step(
                     params, state,
                     jnp.asarray(offsets.astype(np.int32)),
+                    jnp.asarray(active),
+                )
+            elif self.paged:
+                rope = lengths + emitted - 1
+                tokens, state = self._step(
+                    params, state,
+                    jnp.asarray(slot_bt),
+                    jnp.asarray(offsets.astype(np.int32)),
+                    jnp.asarray(rope.astype(np.int32)),
                     jnp.asarray(active),
                 )
             else:
@@ -558,6 +940,7 @@ class ServingEngine:
             stats.slot_occupancy += n_active / S
             win_tokens += n_active
             win_occ += n_active / S
+            bpt_samples.append(bytes_in_use() / max(live_tokens(), 1))
             now = time.perf_counter()
             for slot in np.nonzero(active)[0]:
                 req = int(slot_req[slot])
@@ -567,15 +950,14 @@ class ServingEngine:
                     ttft[req] = now - t_submit
                 emitted[slot] += 1
                 if tok == self.eos or emitted[slot] >= budgets[req]:
-                    active[slot] = False  # evict: the slot is free NOW
-                    slot_req[slot] = -1
+                    evict_slot(slot)  # the slot (and its blocks) free NOW
                     finish_request(req, slot, now)
             if (
                 self.serve.log_every_steps
                 and stats.decode_steps % self.serve.log_every_steps == 0
             ):
                 w_dt = max(now - win_t0, 1e-9)
-                log_json({
+                window = {
                     "event": "serve_window",
                     "step": stats.decode_steps,
                     "decode_tokens_per_sec": round(win_tokens / w_dt, 1),
@@ -589,7 +971,17 @@ class ServingEngine:
                     # paying admission on the decode critical path
                     "prefill_ms": round(win_prefill * 1e3, 1),
                     "decode_ms": round(win_decode * 1e3, 1),
-                })
+                    # capacity gauges: what the cache state holds RIGHT NOW
+                    # per live token — the number the paged pool shrinks
+                    "cache_bytes_in_use": bytes_in_use(),
+                    "cache_bytes_per_token": round(
+                        bytes_in_use() / max(live_tokens(), 1), 1
+                    ),
+                }
+                if self.paged:
+                    window["pool_blocks_in_use"] = self.pool.blocks_in_use
+                    window["pool_blocks_free"] = self.pool.blocks_free
+                log_json(window)
                 win_tokens, win_t0, win_occ = 0, now, 0.0
                 win_prefill, win_decode = 0.0, 0.0
 
@@ -611,8 +1003,11 @@ class ServingEngine:
             ttft_slo_ms=self.serve.ttft_slo_ms,
             n_chips=n_chips,
         )
+        stats.bytes_per_live_token = (
+            sum(bpt_samples) / len(bpt_samples) if bpt_samples else 0.0
+        )
         p50, p95 = stats.ttft_percentiles()
-        log_json({
+        summary = {
             "event": "serve_summary",
             "sequences": stats.sequences,
             "decode_steps": stats.decode_steps,
@@ -627,7 +1022,25 @@ class ServingEngine:
             "prefill_seconds": round(stats.prefill_seconds, 3),
             "slots": S,
             "chips": n_chips,
-        })
+            # capacity block: config knobs + the measured static account —
+            # so capacity claims are read off the log, not inferred
+            "kv_cache_dtype": self.serve.kv_cache_dtype,
+            "paged_kv": self.paged,
+            "prefill_buckets": list(self.buckets),
+            "cache_bytes_resident": stats.cache_bytes_resident,
+            "peak_cache_bytes_in_use": stats.peak_cache_bytes_in_use,
+            "cache_bytes_per_token": round(stats.bytes_per_live_token, 1),
+        }
+        if self.paged:
+            summary["pool_blocks"] = self.pool.num_blocks
+            summary["kv_block_size"] = self.block_size
+            summary["admit_deferrals"] = stats.admit_deferrals
+        peak_hbm = device_peak_bytes()
+        if peak_hbm is not None:
+            # live allocator peak where the backend supports memory_stats
+            # (TPU); the static account above is the portable fallback
+            summary["peak_hbm_bytes"] = peak_hbm
+        log_json(summary)
         self.last_stats = stats
         return outputs
 
@@ -635,13 +1048,17 @@ class ServingEngine:
 def make_static_runner(
     model: Any, config: Any, mesh: Any, *,
     max_new_tokens: int, width: int, batch: int, is_seq2seq: bool = True,
+    kv_cache_dtype: str = "f32",
 ):
     """The pre-engine contract as ONE compiled runner: pad every request
     chunk to a static batch and decode EVERY row to ``max_new_tokens``
     regardless of when it finishes.  Returns ``run_all(params, requests)
     -> list of generated-id rows``; the jit lives in the closure, so a
     warm-up call and a timed call share the compile (bench) and the
-    determinism test compares against exactly this contract."""
+    determinism test compares against exactly this contract.
+    ``kv_cache_dtype`` matches the engine flag, so the engine-vs-static
+    determinism pins hold under int8 too (same quantized cache on both
+    sides)."""
     from distributed_llms_example_tpu.evaluation.generation import (
         CausalGenerator,
         Seq2SeqGenerator,
@@ -660,7 +1077,7 @@ def make_static_runner(
                 toks = list(req)[:width]
                 ids[r, : len(toks)] = toks
                 mask[r, : len(toks)] = 1
-            with activation_mesh(mesh):
+            with activation_mesh(mesh), kv_cache_context(kv_cache_dtype):
                 got = np.asarray(run(params, jnp.asarray(ids), jnp.asarray(mask)))
             outs.extend(got[r].tolist() for r in range(len(chunk)))
         return outs
@@ -672,7 +1089,7 @@ def static_batch_generate(
     model: Any, config: Any, mesh: Any, params: Any,
     requests: Sequence[Sequence[int]], *,
     max_new_tokens: int, width: int, batch: int | None = None,
-    is_seq2seq: bool = True,
+    is_seq2seq: bool = True, kv_cache_dtype: str = "f32",
 ) -> list[list[int]]:
     """One-shot form of ``make_static_runner`` (the determinism tests'
     entry point)."""
@@ -680,6 +1097,7 @@ def static_batch_generate(
         model, config, mesh,
         max_new_tokens=max_new_tokens, width=width,
         batch=batch or len(requests), is_seq2seq=is_seq2seq,
+        kv_cache_dtype=kv_cache_dtype,
     )(params, requests)
 
 

@@ -46,6 +46,9 @@ _HIGHER_BETTER = (
     "slo_attainment", "overlap_frac", "accounted_frac", "speedup",
     "occupancy", "utilization", "vs_synthetic", "vs_baseline",
     "achieved_bytes_per_sec", "continuous_vs_static",
+    # serving capacity (PR 13): sustained concurrency per chip and the
+    # int8/f32 footprint ratio are the levers the capacity block measures
+    "max_sustained_slots", "token_match_rate", "cache_bytes_ratio",
 )
 _LOWER_BETTER = (
     "_ms", "ttft", "wall_s", "_seconds", "overhead", "exposed_",
@@ -54,12 +57,21 @@ _LOWER_BETTER = (
     # --grad-compression (PR 12); the generic byte-account leaves stay
     # informational (activation bytes move with config, not quality)
     "gradient_bytes_per_step", "gradient_wire_bytes",
+    # cache footprint per live token: what the int8/paged knobs shrink
+    "cache_bytes_per_token", "bytes_per_live_token",
+    "admit_deferrals",
 )
 # config knobs stamped INTO the artifact (not measurements): changing a
 # setting between rounds must never read as a perf regression — the
 # same fix ttft_slo_ms needed in PR 11; grad_compression is a mode
-# switch, so flipping it between rounds is information, not regression
-_CONFIG_LEAVES = ("ttft_slo_ms", "threshold", "slo_ms", "grad_compression")
+# switch, so flipping it between rounds is information, not regression.
+# The decode-capacity knobs (kv_cache_dtype, prefill_buckets, pool
+# sizing) are the same class: flag flips, never regressions.
+_CONFIG_LEAVES = (
+    "ttft_slo_ms", "threshold", "slo_ms", "grad_compression",
+    "kv_cache_dtype", "prefill_buckets", "pool_blocks", "kv_block_size",
+    "paged_kv",
+)
 
 
 def direction_of(path: str) -> int:

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Nine rules, all born from real regressions at TPU scale:
+Ten rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -98,6 +98,18 @@ Nine rules, all born from real regressions at TPU scale:
    off-path bit-identity pin would no longer cover it.  The compression
    layer is the one owner; the step (``train/step.py``) is the one
    caller.
+
+10. **No raw int8 casts of KV-cache values outside the owning modules.**
+   ``ops/flash_attention.py`` (quantize_kv/dequantize_kv + in-kernel
+   dequant) and ``serving/cache_pool.py`` own the int8 KV cache's
+   number format.  A stray ``k.astype(jnp.int8)`` in models/, serving/
+   or evaluation/ forks the format: its values would quantize without
+   the per-head per-position scale contract, the kernel and XLA decode
+   paths would stop reconstructing identical K/V, and the token-parity
+   pins (engine == static under int8) would no longer cover it.  In
+   those dirs (plus ops/mha.py, the cache-write site) ANY
+   ``.astype(int8/uint8)`` fails here — creation via ``jnp.zeros(...,
+   jnp.int8)`` is allocation, not quantization, and stays legal.
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -218,6 +230,22 @@ OPTIM_RULE_DIRS = DROPOUT_RULE_DIRS
 OPTIM_OWNER = os.path.join(PACKAGE, "train", "optim.py")
 _LR_NAMES = ("lr", "learning_rate", "step_size")
 
+# Rule 10: the int8 KV cache's number format is owned by
+# ops/flash_attention.py (quantize_kv / dequantize_kv / in-kernel tile
+# dequant) and serving/cache_pool.py.  Any raw astype-to-int8 in the
+# dirs that touch cache values forks the format outside the scale
+# contract; jnp.zeros(..., jnp.int8) allocation stays legal.
+KV_CAST_RULE_DIRS = (
+    os.path.join(PACKAGE, "models"),
+    os.path.join(PACKAGE, "serving"),
+    os.path.join(PACKAGE, "evaluation"),
+)
+KV_CAST_RULE_FILES = {os.path.join(PACKAGE, "ops", "mha.py")}
+KV_CAST_OWNERS = {
+    os.path.join(PACKAGE, "ops", "flash_attention.py"),
+    os.path.join(PACKAGE, "serving", "cache_pool.py"),
+}
+
 
 def _names_contain_lr(node: ast.AST) -> bool:
     return any(
@@ -329,6 +357,30 @@ def _grad_collective_violations(tree: ast.AST, rel: str) -> list[str]:
                 "error-feedback buffer) and skips the shared-scale "
                 "int-safe wire protocol; route through "
                 "ops.quant_collectives.quantized_tree_reduce"
+            )
+    return violations
+
+
+def _kv_cast_violations(tree: ast.AST, rel: str) -> list[str]:
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and any(
+                _is_int8_node(a)
+                for a in list(node.args) + [kw.value for kw in node.keywords]
+            )
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: raw .astype(int8) outside the KV "
+                "quantization owners (ops/flash_attention.py, "
+                "serving/cache_pool.py) — a hand-rolled int8 cast of cache "
+                "values forks the number format away from the per-head "
+                "per-position scale contract and breaks the kernel/XLA "
+                "dequant identity; route through "
+                "ops.flash_attention.quantize_kv / dequantize_kv"
             )
     return violations
 
@@ -538,6 +590,11 @@ def lint_file(path: str, rel: str) -> list[str]:
         rel.startswith(d + os.sep) for d in GRAD_COLLECTIVE_RULE_DIRS
     ):
         violations.extend(_grad_collective_violations(tree, rel))
+    if rel not in KV_CAST_OWNERS and (
+        rel in KV_CAST_RULE_FILES
+        or any(rel.startswith(d + os.sep) for d in KV_CAST_RULE_DIRS)
+    ):
+        violations.extend(_kv_cast_violations(tree, rel))
     if rel != CKPT_OWNER:
         violations.extend(_ckpt_manager_violations(tree, rel))
     if rel != TRACE_OWNER:

@@ -775,10 +775,13 @@ def test_repo_lint_grad_collective_rule(tmp_path):
         "    ok2 = x.astype(jnp.int8)\n"  # non-gradient int8 casts too
         "    return g, g2, g3, q, ok, ok2\n"
     )
-    for d in ("models", "train"):
+    # Under models/ rule 10 (KV-cast ownership, ISSUE 13) also fires on
+    # every astype(int8) — including the non-gradient one — on top of
+    # rule 9's five hits; under train/ only rule 9 applies.
+    for d, expected in (("models", 8), ("train", 5)):
         rel = os.path.join("distributed_llms_example_tpu", d, "qc.py")
         violations = repo_lint.lint_file(str(bad), rel)
-        assert len(violations) == 5, violations
+        assert len(violations) == expected, violations
         assert any("quant_collectives" in v for v in violations)
     # the owners are exempt: train/step.py calls the compression layer,
     # ops/ and parallel/ ARE implementation layers
@@ -786,6 +789,49 @@ def test_repo_lint_grad_collective_rule(tmp_path):
     assert repo_lint.lint_file(str(bad), rel) == []
     rel = os.path.join("distributed_llms_example_tpu", "ops", "qc.py")
     assert repo_lint.lint_file(str(bad), rel) == []
+
+
+def test_repo_lint_kv_cast_rule(tmp_path):
+    """Rule 10 (ISSUE 13): a raw ``.astype(int8/uint8)`` in models/,
+    serving/, evaluation/ or ops/mha.py forks the KV-cache number format
+    away from the quantize_kv/dequantize_kv scale contract; the owners
+    (ops/flash_attention.py, serving/cache_pool.py) stay exempt, and
+    int8 *allocation* (jnp.zeros) stays legal everywhere."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    repo_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repo_lint)
+
+    bad = tmp_path / "kv.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(k, v):\n"
+        "    qk = k.astype(jnp.int8)\n"
+        "    qv = v.astype(dtype=jnp.uint8)\n"  # kwarg + uint8 must not evade
+        "    pool = jnp.zeros((4, 8), jnp.int8)\n"  # allocation stays legal
+        "    wide = k.astype(jnp.float32)\n"  # non-int8 casts stay legal
+        "    return qk, qv, pool, wide\n"
+    )
+    for d in ("models", "serving", "evaluation"):
+        rel = os.path.join("distributed_llms_example_tpu", d, "kv.py")
+        violations = repo_lint.lint_file(str(bad), rel)
+        assert len(violations) == 2, violations
+        assert all("quantize_kv" in v for v in violations)
+    # the cache-write site is covered by file, not dir
+    rel = os.path.join("distributed_llms_example_tpu", "ops", "mha.py")
+    assert len(repo_lint.lint_file(str(bad), rel)) == 2
+    # the owners are exempt; so is everything outside the covered dirs
+    for rel in (
+        os.path.join("distributed_llms_example_tpu", "ops", "flash_attention.py"),
+        os.path.join("distributed_llms_example_tpu", "serving", "cache_pool.py"),
+        os.path.join("distributed_llms_example_tpu", "train", "kv.py"),
+    ):
+        assert repo_lint.lint_file(str(bad), rel) == []
 
 
 def test_repo_lint_ckpt_manager_rule(tmp_path):

@@ -110,6 +110,20 @@ KNOWN_BAD: tuple[BadCombo, ...] = (
         ),
     ),
     BadCombo(
+        id="reshard-pipelined",
+        flags=("reshard", "pipelined"),
+        reason=(
+            "topology-change resharding does not compose with stage>1 "
+            "pipelines: the stacked-block STORAGE layout is a function of "
+            "the stage count (interleaved packing puts each device's "
+            "virtual-stage chunks contiguously), so restoring onto a "
+            "resized stage axis silently permutes the model's layers — "
+            "stage>1 owns its layout; restart on a slice with the SAME "
+            "stage factorization (data/fsdp/tensor re-factorizations are "
+            "the ones the resharding restore supports)"
+        ),
+    ),
+    BadCombo(
         id="grad-compression-pipelined",
         flags=("grad_compression", "pipelined"),
         reason=(

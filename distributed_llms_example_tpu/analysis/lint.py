@@ -84,6 +84,7 @@ def run_passes(
     serve: bool = False,
     kv_cache_dtype: str = "",
     prefill_buckets: tuple = (),
+    reshard_from: Any = None,
 ) -> list[Finding]:
     """The three passes over one (model, mesh, config) triple."""
     import jax
@@ -123,6 +124,48 @@ def run_passes(
     findings += spec_lint.lint_error_feedback_mirror(
         a_params, rules if rules is not None else default_rules()
     )
+    # the resharding-restore proof (--reshard-from): cross-check a SAVED
+    # topology (mesh config + optional processes/ef_workers — the facts a
+    # checkpoint's mesh_layout payload records) against THIS mesh: every
+    # leaf resolvable, mirrors re-derived, unmappable factorizations
+    # (stage/expert moves) are errors — plus the reshard×pipelined
+    # composition row when either side is staged
+    if reshard_from is not None:
+        saved_axes = (
+            dict(reshard_from.get("axes", {}))
+            if isinstance(reshard_from, dict)
+            else _resolve_axis_sizes(reshard_from)
+        )
+        saved_layout = {
+            "axes": saved_axes,
+            "processes": (
+                reshard_from.get("processes", 1)
+                if isinstance(reshard_from, dict) else 1
+            ),
+            "ef_workers": (
+                reshard_from.get("ef_workers", 0)
+                if isinstance(reshard_from, dict) else 0
+            ),
+        }
+        findings += spec_lint.lint_reshard_layout(
+            saved_layout, axis_sizes, a_params,
+            rules=rules if rules is not None else default_rules(),
+        )
+        # a stage>1 restore onto the SAME stage factorization is the
+        # normal pipelined resume (the stacked-layout leaf guards row
+        # order) — the composition row fires only when the stage axis
+        # MOVED, matching the trainer's _check_reshardable judgement
+        if saved_axes.get("stage", 1) != axis_sizes.get("stage", 1):
+            from distributed_llms_example_tpu.analysis.composition import (
+                reason_for,
+            )
+
+            findings.append(Finding(
+                severity="error",
+                pass_name="composition",
+                code="reshard-pipelined",
+                message=reason_for("reshard-pipelined"),
+            ))
 
     # Serving passes (--serve): the KV-cache rule set validated like the
     # param rules, over the abstract decode cache — plus the decode rows
@@ -317,6 +360,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --serve: comma list of admission widths; the "
                         "compiled decode-step scan runs once per bucket "
                         "(each bucket's prefill carry shapes its own step)")
+    p.add_argument("--reshard-from", type=str, default="",
+                   help="run the resharding-restore proof pass: the SAVED "
+                        "topology's mesh as a comma list axis=size (what a "
+                        "checkpoint's mesh_layout payload records), judged "
+                        "against --mesh as the restore target")
+    p.add_argument("--reshard-processes", type=int, default=1,
+                   help="saved process count for --reshard-from")
+    p.add_argument("--reshard-ef-workers", type=int, default=0,
+                   help="saved error-feedback worker count for "
+                        "--reshard-from (0 = no EF tree in the payload)")
     p.add_argument("--no-ir", action="store_true",
                    help="skip the lowered-program pass (no AOT compile)")
     p.add_argument("--strict", action="store_true",
@@ -340,6 +393,33 @@ def main(argv: list[str] | None = None) -> int:
             rules = _parse_rules_json(args.rules_json)
         except (ValueError, TypeError) as e:
             findings.append(Finding("error", "cli", "bad-rules-json", str(e)))
+    reshard_from = None
+    if args.reshard_from:
+        try:
+            from distributed_llms_example_tpu.core.config import parse_mesh_arg
+
+            saved_sizes = dict(parse_mesh_arg(args.reshard_from).axis_sizes())
+            wild = sorted(a for a, v in saved_sizes.items() if v == -1)
+            if wild:
+                # the saved topology is a HISTORICAL fact: resolving a
+                # wildcard against THIS host's device count would lint a
+                # factorization that was never saved
+                findings.append(Finding(
+                    "error", "cli", "reshard-from-wildcard",
+                    f"--reshard-from must pin every axis explicitly "
+                    f"(unresolved: {', '.join(wild)}): the saved topology "
+                    "cannot be inferred from this host's device count — "
+                    "read it from the checkpoint's recovery sidecar or "
+                    "mesh_layout payload leaf",
+                ))
+            else:
+                reshard_from = {
+                    "axes": saved_sizes,
+                    "processes": args.reshard_processes,
+                    "ef_workers": args.reshard_ef_workers,
+                }
+        except ValueError as e:
+            findings.append(Finding("error", "cli", "unknown-mesh-axis", str(e)))
     if not findings:
         findings = run_passes(
             model=args.model,
@@ -363,6 +443,7 @@ def main(argv: list[str] | None = None) -> int:
             prefill_buckets=tuple(
                 int(b) for b in args.prefill_buckets.split(",") if b.strip()
             ),
+            reshard_from=reshard_from,
         )
     emit(findings, as_json=args.json)
     counts = count_by_severity(findings)

@@ -322,6 +322,168 @@ def lint_cache_sharding(
     return findings
 
 
+# Axes a topology change may NOT move when either side uses them (>1):
+# ``stage`` because the stacked-block storage layout is a function of the
+# stage count (a resized axis silently permutes layers — composition row
+# reshard-pipelined), ``expert`` because the MoE program structure
+# (expert placement, the a2a groups, capacity math) is built around the
+# expert count — restoring an expert>1 checkpoint onto an expert=1 mesh
+# used to surface as an opaque restore exception deep in the walk-back.
+RESHARD_PINNED_AXES = ("stage", "expert")
+
+
+def lint_reshard_layout(
+    saved_layout: Mapping[str, Any],
+    mesh_axes: Mapping[str, int],
+    params: Any,
+    *,
+    rules: Any = None,
+) -> list[Finding]:
+    """The resharding-restore proof pass (ISSUE 14): cross-check a
+    checkpoint's recorded topology — the ``mesh_layout`` payload leaf /
+    recovery-sidecar dict, ``{"axes": {axis: size}, "processes": N,
+    "ef_workers": W}`` — against an ARBITRARY target mesh.
+
+    Errors are the unmappable factorizations (the restore must fail fast
+    and named, not deep in orbax): an axis name the live build does not
+    know, or a moved ``stage``/``expert`` axis (see
+    ``RESHARD_PINNED_AXES``).  ``data``/``fsdp``/``tensor``/``sequence``
+    re-factorizations are exactly what the resharding restore exists
+    for — for those the pass instead proves the TARGET layout is
+    well-typed: every param leaf's spec resolves on the target mesh
+    (ragged dims → warning: they silently replicate), and the
+    accumulator / error-feedback mirrors re-derive leaf-for-leaf from
+    the target param specs (the arXiv:2004.13336 discipline that makes
+    the reshard well-typed in the first place).  The EF worker-count
+    transition is reported as info (re-tile) or warning (zero-fill).
+    Device-free: specs + shapes only."""
+    import jax.tree_util as jtu
+
+    from distributed_llms_example_tpu.parallel.sharding import (
+        _clip_spec,
+        _path_str,
+        divisible_spec,
+    )
+
+    if rules is None:
+        from distributed_llms_example_tpu.parallel.sharding import default_rules
+
+        rules = default_rules()
+
+    findings: list[Finding] = []
+    saved_axes = dict(saved_layout.get("axes", {}) or {})
+    for a, size in sorted(saved_axes.items()):
+        if a not in AXES:
+            findings.append(
+                Finding(
+                    severity="error",
+                    pass_name="spec",
+                    code="unknown-saved-axis",
+                    message=(
+                        f"checkpoint layout names mesh axis {a!r} "
+                        f"(size {size}), which this build does not know "
+                        f"(axes: {', '.join(AXES)}) — the payload was "
+                        "written by an incompatible mesh schema"
+                    ),
+                    context={"axis": a, "size": int(size)},
+                )
+            )
+    for a in RESHARD_PINNED_AXES:
+        old = int(saved_axes.get(a, 1) or 1)
+        new = int(mesh_axes.get(a, 1) or 1)
+        if old != new and (old > 1 or new > 1):
+            findings.append(
+                Finding(
+                    severity="error",
+                    pass_name="spec",
+                    code=f"reshard-{a}-mismatch",
+                    message=(
+                        f"checkpoint was saved with {a}={old} but the "
+                        f"target mesh has {a}={new} — the {a} "
+                        "factorization is part of the program structure "
+                        + (
+                            "(stacked-block storage layout is a function "
+                            "of the stage count; a resized axis silently "
+                            "permutes layers)"
+                            if a == "stage"
+                            else "(expert placement, all-to-all groups and "
+                            "capacity math are built around the expert "
+                            "count)"
+                        )
+                        + "; resume on a slice with the same "
+                        f"{a} factorization"
+                    ),
+                    context={"axis": a, "saved": old, "target": new},
+                )
+            )
+
+    # target-layout well-typedness: every leaf resolvable, ragged dims
+    # named (they replicate at runtime — legal, but the operator should
+    # know the reshard costs per-device memory)
+    mesh_view = type("_MeshView", (), {"shape": dict(mesh_axes)})()
+    leaves: list[tuple[str, Any]] = []
+    jtu.tree_map_with_path(
+        lambda path, x: leaves.append((_path_str(path), x)), params
+    )
+    for path, leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        spec = rules.spec_for(path, len(shape))
+        if any(a not in AXES for a in _spec_axes(spec)):
+            continue  # a broken rule set is lint_sharding_rules' job
+        effective = divisible_spec(spec, shape, mesh_view)
+        if effective != _clip_spec(spec, len(shape)):
+            findings.append(
+                Finding(
+                    severity="warning",
+                    pass_name="spec",
+                    code="reshard-leaf-replicated",
+                    message=(
+                        f"{path}: shape {shape} resolves to spec {spec} on "
+                        f"the target mesh {dict(mesh_axes)} but the ragged "
+                        "dims will be replicated — the reshard lands, at a "
+                        "per-device memory cost the saving mesh did not pay"
+                    ),
+                    context={"param": path, "spec": str(spec), "shape": list(shape)},
+                )
+            )
+
+    # the mirrors that make the reshard well-typed: accumulator and (when
+    # the payload carries an EF tree) error-feedback specs re-derived
+    # leaf-for-leaf from the TARGET param specs
+    findings.extend(lint_accumulator_mirror(params, rules))
+    ef_workers = int(saved_layout.get("ef_workers", 0) or 0)
+    if ef_workers > 0:
+        findings.extend(lint_error_feedback_mirror(params, rules))
+        from distributed_llms_example_tpu.ops.quant_collectives import (
+            worker_count,
+        )
+
+        new_workers = worker_count(dict(mesh_axes))
+        if new_workers != ef_workers:
+            retile = new_workers > 1 and ef_workers % new_workers == 0
+            findings.append(
+                Finding(
+                    severity="info" if retile else "warning",
+                    pass_name="spec",
+                    code="reshard-ef-retile" if retile else "reshard-ef-zero-fill",
+                    message=(
+                        f"error-feedback tree moves from {ef_workers} to "
+                        f"{new_workers} worker group(s): "
+                        + (
+                            "merged groups' residuals sum (total deferred "
+                            "error preserved)"
+                            if retile
+                            else "no residual regrouping preserves the "
+                            "per-worker error — it zero-fills (one "
+                            "residual's worth of deferred error dropped)"
+                        )
+                    ),
+                    context={"saved_workers": ef_workers, "target_workers": new_workers},
+                )
+            )
+    return findings
+
+
 def lint_sharding_rules(
     rules: Any,
     mesh_axes: Mapping[str, int],

@@ -255,6 +255,13 @@ class TrainConfig:
     # bounded in-process rewind budget for --on-anomaly rewind; once
     # exhausted the escalation continues skip-batch → halt
     max_rewinds: int = 2
+    # topology-change policy (ISSUE 14): on an agreed host-loss signal
+    # ("--chaos host_loss@K", or a pod-size change at resume), "reshard"
+    # tears down collectives, re-initializes jax.distributed on the
+    # surviving slice, rebuilds mesh/shardings/train-step, and restores
+    # the newest verified checkpoint through the resharding path;
+    # "halt" checkpoints the evidence and stops (restart-based recovery)
+    on_host_loss: str = "reshard"
     # flight-recorder ring capacity in steps (0 = off): the last N steps'
     # metrics + batch fingerprints, dumped on anomaly/SIGTERM/crash
     recorder_steps: int = 256
@@ -482,12 +489,23 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
              "budget escalates skip-batch -> halt",
     )
     p.add_argument(
+        "--on-host-loss", type=str, default=_D.on_host_loss,
+        choices=("reshard", "halt"),
+        help="agreed topology-change policy: reshard — tear down "
+             "collectives, re-init jax.distributed on the surviving "
+             "slice, rebuild mesh/shardings/train-step and restore the "
+             "newest verified checkpoint through the resharding path "
+             "(needs --save-every-steps); halt — checkpoint the evidence "
+             "and stop, leaving recovery to a resumed run on the new "
+             "slice (the resume path reshards either way)",
+    )
+    p.add_argument(
         "--chaos", type=str, default=_D.chaos,
         help="deterministic fault injection: comma list of kind@tick with "
-             "kind in nan_grad/ckpt_corrupt/data_error/sigterm (tick = "
-             "global step; for ckpt_corrupt the Nth checkpoint save), "
-             "e.g. 'nan_grad@120,ckpt_corrupt@2'; every firing is logged "
-             "as a chaos_injection event",
+             "kind in nan_grad/ckpt_corrupt/data_error/sigterm/host_loss "
+             "(tick = global step; for ckpt_corrupt the Nth checkpoint "
+             "save), e.g. 'nan_grad@120,ckpt_corrupt@2'; every firing is "
+             "logged as a chaos_injection event",
     )
     p.add_argument(
         "--recorder-steps", type=int, default=_D.recorder_steps,
@@ -588,5 +606,16 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         # grammar errors fail here, not at injection time mid-run
         from distributed_llms_example_tpu.obs.chaos import parse_chaos
 
-        parse_chaos(cfg.chaos)
+        schedule = parse_chaos(cfg.chaos)
+        if (
+            schedule.armed_at("host_loss")
+            and cfg.on_host_loss == "reshard"
+            and cfg.checkpoint.save_every_steps <= 0
+        ):
+            raise ValueError(
+                "--chaos host_loss@K with --on-host-loss reshard needs a "
+                "checkpoint to reshard FROM: set --save-every-steps N "
+                "(a lost host's state is gone — topology recovery is a "
+                "restore, not a migration)"
+            )
     return cfg

@@ -171,6 +171,57 @@ def initialize_distributed(
     )
 
 
+def elastic_mesh_spec(cfg: MeshConfig, n_devices: int) -> MeshSpec:
+    """Resolve a mesh shape for a CHANGED device count (topology-change
+    recovery, ISSUE 14): the configured factorization re-resolved against
+    the surviving slice.
+
+    A ``-1`` axis absorbs the change exactly as at startup.  A fully
+    pinned factorization whose product no longer matches re-scales the
+    DATA axis (the replica dimension is the one elasticity semantically
+    varies — model sharding axes keep their meaning); when the remaining
+    axes' product does not divide the device count there is no
+    well-typed shrink and this raises with both factorizations named."""
+    sizes = cfg.axis_sizes()
+    try:
+        return resolve_mesh_shape(cfg, n_devices)
+    except ValueError:
+        pass
+    rest = int(np.prod([v for k, v in sizes.items() if k != "data"]))
+    if -1 in sizes.values() or rest <= 0 or n_devices % rest:
+        raise ValueError(
+            f"cannot re-factorize mesh {sizes} onto {n_devices} surviving "
+            f"device(s): the non-data axes' product ({rest}) must divide "
+            "the device count — resume on a slice shape the configured "
+            "model sharding fits, or change the mesh config"
+        )
+    sizes["data"] = n_devices // rest
+    return MeshSpec(**sizes)
+
+
+def reinitialize_distributed(
+    coordinator_address: str = "",
+    num_processes: int = 0,
+    process_id: int = -1,
+) -> None:
+    """Tear down and re-run the multi-host bootstrap on a CHANGED slice
+    (topology-change recovery): ``jax.distributed.shutdown`` if a client
+    is live, then :func:`initialize_distributed` with the new rendezvous
+    facts (argument > platform > env, exactly like startup).  This is
+    the ONE owner of the re-init path — ``scripts/repo_lint.py`` forbids
+    ``jax.distributed`` calls and raw ``Mesh`` construction outside this
+    module, so a second, subtly different re-init cannot grow elsewhere.
+    Single-process (no facts, or world size 1): shutdown only — the
+    surviving slice needs no rendezvous."""
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        # no client initialized (single-process run, or a client torn
+        # down by the failure itself): nothing to shut down
+        pass
+    initialize_distributed(coordinator_address, num_processes, process_id)
+
+
 def _valohai_facts() -> tuple[str, int, int | None]:
     """(master_ip, world_size, rank) from the platform, else env, else local.
 

@@ -77,6 +77,36 @@ def microbatch_size(
     return micro
 
 
+def validate_batch_mesh(
+    global_batch: int,
+    mesh_axes: dict,
+    *,
+    process_count: int = 1,
+    grad_accum_steps: int = 1,
+) -> None:
+    """Re-validate the batch-plan divisibilities against a (possibly
+    NEW) mesh — the topology-change path's precondition check (ISSUE
+    14): the global batch is PRESERVED across a reshard (that is what
+    keeps the loss trajectory comparable), so the new factorization must
+    still divide it.  Raises with the failing triple named; a passing
+    call means the re-derived batch plan slices cleanly on every
+    surviving host.  (The grad-compression worker regrouping needs no
+    extra check here: the worker axes are a subset of the batch-shard
+    axes, so ``microbatch % shards == 0`` already implies the per-worker
+    split divides.)"""
+    shards = 1
+    for ax in ("data", "fsdp", "expert"):
+        shards *= max(1, int(mesh_axes.get(ax, 1) or 1))
+    # microbatch_size covers batch % accum, microbatch % shards and
+    # batch % processes with the accumulation named in each error
+    microbatch_size(
+        global_batch,
+        max(1, grad_accum_steps),
+        batch_shards=shards,
+        process_count=max(1, process_count),
+    )
+
+
 def bucket_len(max_len_in_batch: int, multiple: int, cap: int) -> int:
     b = ((max(1, max_len_in_batch) + multiple - 1) // multiple) * multiple
     return min(b, cap)

@@ -39,11 +39,13 @@ import json
 import os
 import time
 import zlib
-from typing import Any
+from typing import Any, Callable
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
+from distributed_llms_example_tpu.core.config import AXES
 from distributed_llms_example_tpu.utils.jsonlog import log_json
 
 # sidecars live next to the step dirs, never inside them: orbax owns the
@@ -54,6 +56,66 @@ from distributed_llms_example_tpu.utils.jsonlog import log_json
 _MANIFEST_PREFIX = "integrity-"
 RECOVERY_PREFIX = "recovery-"
 _SIDECAR_PREFIXES = (_MANIFEST_PREFIX, RECOVERY_PREFIX)
+
+# The mesh-layout payload leaf (ISSUE 14): every checkpoint records the
+# topology it was written under — mesh axis sizes in AXES order, the
+# process count, and the error-feedback worker count — as an ARRAY leaf
+# riding the payload (like the stacked-block layout identity: a sidecar
+# can be separated from the arrays it describes, a payload leaf cannot).
+# The resharding restore reads the live structure from orbax metadata
+# and this leaf only confirms it; the FAIL-FAST pre-check reads the same
+# facts from the recovery sidecar, which is available without a restore.
+MESH_LAYOUT_KEY = "mesh_layout"
+
+
+class ReshardError(ValueError):
+    """A checkpoint's recorded topology cannot map onto the live mesh.
+
+    Raised by the resharding restore pre-checks (the named, fail-fast
+    alternative to an opaque orbax structure error deep in the
+    newest-first walk-back) — the message always names BOTH
+    factorizations."""
+
+
+def mesh_layout_array(
+    mesh_axes: dict, process_count: int, ef_workers: int
+) -> np.ndarray:
+    """The mesh-layout leaf: int32 ``[*axis sizes in AXES order,
+    process_count, ef_workers]`` (``ef_workers`` 0 = no error-feedback
+    tree in the payload)."""
+    return np.asarray(
+        [int(mesh_axes.get(a, 1) or 1) for a in AXES]
+        + [int(process_count), int(ef_workers)],
+        np.int32,
+    )
+
+
+def parse_mesh_layout(leaf: Any) -> dict:
+    """Inverse of :func:`mesh_layout_array`:
+    ``{"axes": {axis: size}, "processes": int, "ef_workers": int}``."""
+    v = [int(x) for x in np.asarray(leaf).reshape(-1)]
+    if len(v) != len(AXES) + 2:
+        raise ValueError(
+            f"mesh-layout leaf has {len(v)} entries, expected "
+            f"{len(AXES) + 2} ([{', '.join(AXES)}, processes, ef_workers])"
+        )
+    return {
+        "axes": dict(zip(AXES, v[: len(AXES)])),
+        "processes": v[len(AXES)],
+        "ef_workers": v[len(AXES) + 1],
+    }
+
+
+def describe_factorization(layout: dict | None) -> str:
+    """One-line human name for a recorded topology (error messages)."""
+    if not layout:
+        return "<unrecorded>"
+    axes = layout.get("axes", {})
+    body = ",".join(f"{a}={axes.get(a, 1)}" for a in AXES if axes.get(a, 1) != 1)
+    return (
+        f"{{{body or 'all axes 1'}}} over {layout.get('processes', '?')} "
+        f"process(es)"
+    )
 
 
 def _crc32_file(path: str, chunk: int = 1 << 20) -> tuple[int, int]:
@@ -106,7 +168,16 @@ class Checkpointer:
             save_interval_steps=max(1, save_every_steps),
             enable_async_checkpointing=async_save,
         )
-        self.manager = ocp.CheckpointManager(self.directory, options=options)
+        # the registered handler is what makes ``item_metadata`` work on
+        # a manager that has not saved in THIS session (a resumed run's
+        # first act is reading the saved payload's structure for the
+        # resharding target) — save/restore still route through the
+        # StandardSave/StandardRestore args as before
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=options,
+            item_handlers=ocp.StandardCheckpointHandler(),
+        )
         # steps THIS instance saved: only the writer may author a step's
         # manifest.  Manufacturing one at restore time for a pre-existing
         # step would checksum possibly-already-corrupt files and baptize
@@ -283,6 +354,25 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self.manager.latest_step()
 
+    def payload_metadata(self, step: int) -> Any | None:
+        """The SAVED payload's structure (a tree of orbax ArrayMetadata:
+        shapes + dtypes, no array reads) — what the resharding restore
+        builds its per-step abstract target from, so the target always
+        matches the structure on disk (legacy bare-TrainState vs layout
+        payload, error-feedback tree present or not, and the EF worker
+        dim as saved) while the SHARDINGS come from the live mesh.
+        Deterministic on every rank (one _METADATA file on shared
+        storage); None when the step predates orbax's metadata file.
+        Only the genuinely-absent case (FileNotFoundError) maps to None
+        — any other storage error propagates LOUDLY: swallowing it on
+        one rank would hand that rank a different candidate-target list
+        than its peers and desynchronize the per-attempt restore
+        agreements."""
+        try:
+            return self.manager.item_metadata(step)
+        except FileNotFoundError:
+            return None
+
     def all_steps(self) -> list[int]:
         return sorted(self.manager.all_steps())
 
@@ -308,6 +398,23 @@ class Checkpointer:
         agreed = int(gathered[0, 0])
         return None if agreed < 0 else agreed
 
+    def _agreed_count(self, n: int) -> int:
+        """Pod-agreed attempt count for one step's candidate targets:
+        the MAX across ranks.  The target builder is deterministic on
+        shared metadata, but if one rank ever sees a different local
+        list, padding the shorter lists (the caller repeats the last
+        candidate) keeps every rank running the SAME number of
+        per-attempt agreements instead of desynchronizing the
+        collective sequence."""
+        if jax.process_count() == 1:
+            return n
+        import numpy as np
+
+        from distributed_llms_example_tpu.obs.heartbeat import gather_probe
+
+        counts = gather_probe(np.asarray([n], np.int32))
+        return int(counts[:, 0].max())
+
     def _agreed_ok(self, ok: bool) -> bool:
         """Pod-uniform restore outcome: a restore exception on ONE rank
         must fail the step for EVERY rank — otherwise the failing rank
@@ -324,7 +431,11 @@ class Checkpointer:
         return bool(int(flags[:, 0].min()))
 
     def restore_latest(
-        self, abstract_state: Any, *, max_step: int | None = None
+        self,
+        abstract_state: Any,
+        *,
+        max_step: int | None = None,
+        target_for: Callable[[int], Any] | None = None,
     ) -> tuple[Any, int] | None:
         """Restore the newest VERIFIED checkpoint into the given abstract
         (shape/dtype/sharding) pytree; returns (state, step) or None.
@@ -333,7 +444,20 @@ class Checkpointer:
         A step failing checksum verification — or whose restore raises —
         is reported and skipped, so a corrupt or partially-written
         highest step degrades to the previous retained step instead of
-        crashing the resume."""
+        crashing the resume.
+
+        THE RESHARDING PATH (ISSUE 14): when ``target_for`` is given,
+        the abstract target is built PER CANDIDATE STEP —
+        ``target_for(step)`` (typically from :meth:`payload_metadata`,
+        so the target's structure matches what that step actually
+        stored while its shardings come from the live mesh) — which is
+        what lets a checkpoint written under one ``data×fsdp``
+        factorization (or process count) restore onto another.  The
+        verify-before-restore, the pod-agreed single-verifier verdict,
+        and the newest-first fallback walk are all unchanged; a
+        :class:`ReshardError` from the builder propagates immediately
+        (an unmappable topology must fail fast and named, not walk back
+        through N misleading restore attempts)."""
         # finalize any pending async save (and its manifest) first: an
         # in-flight step must be either fully committed+checksummed or
         # absent before we enumerate candidates — never half-written
@@ -362,24 +486,44 @@ class Checkpointer:
             chosen = self._agreed_step(chosen)
             if chosen is None:
                 return None
+            targets = (
+                abstract_state if target_for is None else target_for(chosen)
+            )
+            # a builder may return SEVERAL candidate structures for one
+            # step (a dir with no orbax metadata cannot be classified:
+            # layout payload vs legacy bare state) — attempted in order,
+            # deterministic on every rank so the per-attempt agreement
+            # below stays pod-uniform
+            if not isinstance(targets, (list, tuple)):
+                targets = [targets]
+            targets = list(targets)
+            # pod-uniform attempt count (ONE collective, not one per
+            # iteration): a rank with a shorter local list repeats its
+            # last candidate so the per-attempt _agreed_ok sequence
+            # stays aligned across the pod
+            n_attempts = self._agreed_count(len(targets))
+            while len(targets) < n_attempts:
+                targets.append(targets[-1])
             state, err = None, None
-            try:
-                state = self.manager.restore(
-                    chosen, args=ocp.args.StandardRestore(abstract_state)
-                )
-            except Exception as e:
-                err = e
-            # pod-uniform verdict BEFORE anyone returns: a rank whose
-            # restore raised must not walk back into a collective its
-            # peers (who succeeded and returned) will never join
-            if self._agreed_ok(err is None):
-                return state, chosen
-            if err is None:
-                # a PEER failed; this rank's restored state is discarded
-                # so the pod walks back together
-                err = RuntimeError(
-                    f"restore of step {chosen} failed on a peer process"
-                )
+            for target in targets:
+                state, err = None, None
+                try:
+                    state = self.manager.restore(
+                        chosen, args=ocp.args.StandardRestore(target)
+                    )
+                except Exception as e:
+                    err = e
+                # pod-uniform verdict BEFORE anyone returns: a rank whose
+                # restore raised must not walk back into a collective its
+                # peers (who succeeded and returned) will never join
+                if self._agreed_ok(err is None):
+                    return state, chosen
+                if err is None:
+                    # a PEER failed; this rank's restored state is
+                    # discarded so the pod walks back together
+                    err = RuntimeError(
+                        f"restore of step {chosen} failed on a peer process"
+                    )
             if not os.path.exists(self.manifest_path(chosen)):
                 # a manifest-less (legacy) step whose restore raised is
                 # almost certainly payload-structure drift, which every
@@ -399,12 +543,20 @@ class Checkpointer:
                 raise err
 
     def restore_before(
-        self, step: int, abstract_state: Any
+        self,
+        step: int,
+        abstract_state: Any,
+        *,
+        target_for: Callable[[int], Any] | None = None,
     ) -> tuple[Any, int] | None:
         """Restore the newest verified checkpoint STRICTLY OLDER than
         ``step`` — the rewind target: a checkpoint saved at or after the
-        anomaly step may already hold the poisoned state."""
-        return self.restore_latest(abstract_state, max_step=step - 1)
+        anomaly step may already hold the poisoned state.  ``target_for``
+        is the per-step resharding target builder (see
+        :meth:`restore_latest`)."""
+        return self.restore_latest(
+            abstract_state, max_step=step - 1, target_for=target_for
+        )
 
     def delete_after(self, step: int) -> list[int]:
         """Drop every retained step NEWER than ``step`` (checkpoints and

@@ -25,6 +25,13 @@ the save cadence maps to steps).  Kinds:
                       retry-with-backoff
 - ``sigterm@K``       deliver SIGTERM to this process after step K —
                       exercises the graceful-preemption checkpoint path
+- ``host_loss@K``     raise the agreed topology-change signal after step
+                      K — exercises the elastic-recovery path (teardown,
+                      ``jax.distributed`` re-init on the surviving
+                      slice, resharding restore) the way ``sigterm``
+                      rides the real preemption handler: the flag is
+                      agreed over the same heartbeat-cadence allgather,
+                      so every rank takes the topology branch together
 
 Every injection is **one-shot** (armed → fired): a rewind replaying the
 same steps does not re-inject, so a recovered run stays recovered.  Each
@@ -39,7 +46,7 @@ import dataclasses
 import os
 from typing import Iterable
 
-KINDS = ("nan_grad", "ckpt_corrupt", "data_error", "sigterm")
+KINDS = ("nan_grad", "ckpt_corrupt", "data_error", "sigterm", "host_loss")
 
 GRAMMAR_HELP = (
     "expected a comma list of kind@tick with kind in "

@@ -416,6 +416,8 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
     verify_failures: list[dict] = []
     data_events: list[dict] = []
     anomalies: list[dict] = []
+    topo_changes: list[dict] = []
+    reshards: list[dict] = []
     # injections/recoveries/quarantines are ``local`` events (every
     # rank's file carries its own copy — the schedule and the escalation
     # are deterministic across the pod): dedup to per-run rows
@@ -444,6 +446,13 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
             )
         for r in ev.get("quarantine", []):
             dedup(quarantines, r, "epoch", "epoch_step")
+        for r in ev.get("topology_change", []):
+            dedup(topo_changes, r, "step", "policy")
+        for r in ev.get("reshard_restore", []):
+            # (step, detected_at_step) identifies one reshard across the
+            # ranks' local copies; wall clock differs per rank, so it
+            # must stay OUT of the key
+            dedup(reshards, r, "step", "detected_at_step", "new_processes")
         for kind in ("ckpt_verify_failed", "ckpt_restore_failed"):
             verify_failures.extend(ev.get(kind, []))
         for kind in ("data_retry", "data_skipped_records"):
@@ -490,12 +499,28 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
             faults.append(fault_row(
                 "data_retry", d.get("step"), injected, str(d.get("error", ""))[:120]
             ))
+    # a topology change is a FAULT (a host left) even when the recovery
+    # succeeds: injected when a host_loss chaos firing explains its step,
+    # organic otherwise — exactly the split --strict gates on
+    for t in topo_changes:
+        injected = t.get("step") in injected_at.get("host_loss", set())
+        faults.append(fault_row(
+            "topology_change", t.get("step"), injected,
+            f"policy {t.get('policy')}: "
+            f"{t.get('old_mesh')} → {t.get('reason', 'reshard')}"[:120],
+        ))
     organic = [f for f in faults if not f["injected"]]
     rewinds = [r for r in recoveries if r.get("action") == "rewind"]
+    # reshard wall-clock counts toward MTTR: a topology recovery is a
+    # recovery, and its restore is the dominant cost
     mttr_vals = [
         r["recovery_wall_s"]
         for r in rewinds
         if isinstance(r.get("recovery_wall_s"), (int, float))
+    ] + [
+        r["reshard_wall_s"]
+        for r in reshards
+        if isinstance(r.get("reshard_wall_s"), (int, float))
     ]
     return {
         "injections": [
@@ -516,10 +541,32 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
             {k: q.get(k) for k in ("epoch", "epoch_step", "reason") if k in q}
             for q in quarantines
         ],
+        "topology": [
+            {
+                k: t.get(k)
+                for k in (
+                    "step", "policy", "old_mesh", "old_processes", "reason",
+                )
+                if k in t
+            }
+            for t in topo_changes
+        ],
+        "reshards": [
+            {
+                k: r.get(k)
+                for k in (
+                    "step", "detected_at_step", "old_mesh", "new_mesh",
+                    "old_processes", "new_processes", "ef_mode",
+                    "steps_lost", "reshard_wall_s",
+                )
+                if k in r
+            }
+            for r in reshards
+        ],
         "rewinds": len(rewinds),
         "steps_lost_total": sum(
             int(r.get("steps_lost", 0) or 0) for r in rewinds
-        ),
+        ) + sum(int(r.get("steps_lost", 0) or 0) for r in reshards),
         "mttr_s": (
             round(sum(mttr_vals) / len(mttr_vals), 4) if mttr_vals else None
         ),
@@ -818,6 +865,21 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
                 f"- **{a.get('action')}**: anomaly [{a.get('code')}] at step "
                 f"{a.get('step')} — {a.get('reason', '')}"
             )
+    for t in rec.get("topology", []):
+        add(
+            f"- **topology change** at step {t.get('step')} "
+            f"(policy {t.get('policy')}): mesh was {t.get('old_mesh')} over "
+            f"{t.get('old_processes')} process(es)"
+            + (f" — {t['reason']}" if t.get("reason") else "")
+        )
+    for r in rec.get("reshards", []):
+        add(
+            f"- **reshard restore**: step {r.get('step')} re-laid "
+            f"{r.get('old_mesh')}×{r.get('old_processes')}p → "
+            f"{r.get('new_mesh')}×{r.get('new_processes')}p "
+            f"(ef {r.get('ef_mode')}, {r.get('steps_lost', 0)} steps lost, "
+            f"{_fmt(r.get('reshard_wall_s'))} s)"
+        )
     for q in rec.get("quarantines", []):
         add(
             f"- quarantined batch (epoch {q.get('epoch')}, epoch_step "

@@ -227,7 +227,7 @@ def build_trace(output_dir: str) -> dict[str, Any]:
                     })
             elif ev in (
                 "heartbeat", "obs_anomaly", "chaos_injection", "recovery",
-                "ckpt_verify_failed",
+                "ckpt_verify_failed", "topology_change", "reshard_restore",
             ):
                 t = at_step(r)
                 if t is None:

@@ -153,6 +153,41 @@ def sharded_zero_error_feedback(params: Any, workers: int, shardings: Any) -> An
     return jax.jit(make, out_shardings=shardings)()
 
 
+def retile_error_feedback(ef: Any, new_workers: int, shardings: Any = None) -> Any:
+    """Re-tile a ``(W_old, *shape)`` error-feedback tree onto
+    ``new_workers`` worker groups after a topology change (elastic
+    resharding restore, ISSUE 14).  Requires ``new_workers`` to divide
+    the saved worker count: each new worker group absorbs the SUM of the
+    residuals of the old groups it merges, which preserves the
+    telescoping invariant (the total deferred quantization error —
+    ``ef.sum(axis=0)`` — is unchanged, so nothing the compensation was
+    owed is lost).  A worker count that grew, or does not divide, has no
+    such mapping — callers zero-fill instead (step-0 semantics, one
+    residual's worth of error dropped) and say so with a
+    ``grad_compression_ef_reshaped`` event.
+
+    ``shardings`` (the NEW mesh's :func:`error_feedback_shardings`)
+    makes the result sharded at birth via ``jit`` ``out_shardings``,
+    like :func:`sharded_zero_error_feedback`."""
+    new_workers = int(new_workers)
+
+    def one(x: jnp.ndarray) -> jnp.ndarray:
+        w_old = int(x.shape[0])
+        if w_old % new_workers:
+            raise ValueError(
+                f"cannot re-tile error feedback from {w_old} to "
+                f"{new_workers} workers: the new count must divide the old"
+            )
+        return x.reshape((new_workers, w_old // new_workers) + x.shape[1:]).sum(
+            axis=1, dtype=jnp.float32
+        )
+
+    fn = lambda t: jax.tree.map(one, t)  # noqa: E731
+    if shardings is None:
+        return fn(ef)
+    return jax.jit(fn, out_shardings=shardings)(ef)
+
+
 def attach_error_feedback(state: Any, state_sh: Any, mesh: Mesh, workers: int) -> tuple[Any, Any]:
     """Attach a zero EF tree (sharded at birth) and its shardings to a
     TrainState + its sharding tree — THE one recipe for turning an

@@ -48,7 +48,14 @@ from distributed_llms_example_tpu.data.dataset import (
 from distributed_llms_example_tpu.data.prefetch import Prefetcher
 from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
 from distributed_llms_example_tpu.evaluation.evaluate import Evaluator
-from distributed_llms_example_tpu.io.checkpoint import Checkpointer, abstract_like
+from distributed_llms_example_tpu.io.checkpoint import (
+    Checkpointer,
+    ReshardError,
+    abstract_like,
+    describe_factorization,
+    mesh_layout_array,
+    parse_mesh_layout,
+)
 from distributed_llms_example_tpu.io.valohai_meta import save_valohai_metadata
 from distributed_llms_example_tpu.models.registry import load_model
 from distributed_llms_example_tpu.parallel.sharding import shard_params
@@ -115,6 +122,7 @@ class Trainer:
         # For causal LM, input and labels share one width: cap both at
         # max_source_length so the bucket widths agree.
         tgt_cap = cfg.max_target_length if self.loaded.is_seq2seq else cfg.max_source_length
+        self._tgt_cap = tgt_cap  # the topology-change rebuild re-derives the plan
         self.batches = BatchIterator(
             self.train_ds,
             global_batch=cfg.batch_size,
@@ -393,27 +401,7 @@ class Trainer:
         from distributed_llms_example_tpu.obs.health import health_enabled
 
         self.health_on = health_enabled(cfg)
-        build = make_train_step(
-            self.model,
-            self.config,
-            self.tx,
-            self.schedule,
-            self.mesh,
-            grad_accum_steps=cfg.grad_accum_steps,
-            label_smoothing=cfg.label_smoothing,
-            with_dropout=self.use_dropout,
-            is_seq2seq=self.loaded.is_seq2seq,
-            sequence_sharded=self.sequence_sharded,
-            rules=self._rules,
-            health=self.health_on,
-            optim_spec=self.optim_spec,
-            optim_impl=cfg.optim_impl,
-            grad_compression=cfg.grad_compression,
-        )
-        self.train_step, _ = build(self.state)
-        # lazily-built jitted optimizer-apply probe (budget layer): the
-        # cadenced optimizer_apply_ms sample — see _optimizer_probe_output
-        self._opt_probe = None
+        self._build_train_step()
         # deterministic fault injection (obs/chaos.py --chaos): the ONE
         # injection point for faulted numerics, checkpoint corruption,
         # transient data errors and signals; the legacy
@@ -472,6 +460,15 @@ class Trainer:
             ],
             np.int32,
         )
+        # the TOPOLOGY identity rides the payload the same way: mesh axis
+        # sizes + process count + EF worker count (io/checkpoint.py
+        # mesh_layout_array) — what the resharding restore's fail-fast
+        # check and the spec-lint reshard pass judge a live mesh against
+        self._mesh_layout_leaf = mesh_layout_array(
+            dict(self.mesh.shape),
+            jax.process_count(),
+            self._grad_workers if cfg.grad_compression == "int8" else 0,
+        )
         # THE single storage→true-order map (None: storage is already in
         # layer order).  Every consumer — eval unstack, HF export, the
         # val-loss un-permute — reads this one attribute, so the layout
@@ -509,82 +506,46 @@ class Trainer:
                     "permutation, so restoring across layouts would "
                     "silently permute the model's layers)"
                 )
+        # per-step resharding plans, populated by _restore_target_for as
+        # restore_latest's walk consults it (cleared before every walk)
+        self._reshard_plan: dict[int, dict] = {}
+        # test hook: the topology-change path's next mesh (a MeshSpec /
+        # MeshConfig); None = re-resolve the configured shape against the
+        # surviving device count (core/mesh.py elastic_mesh_spec)
+        self._next_mesh_override = None
         if cfg.checkpoint.resume and self.checkpointer.latest_step() is not None:
-            abstract = abstract_like(self.state, self.state_sh)
-            # restore targets, tried in order: the full payload; the
-            # --grad-compression FLAG-FLIP shapes (an int8 run accepts an
-            # ef-less payload — EF resumes ZERO-FILLED, step-0 semantics;
-            # an off run accepts an ef-carrying payload — the residual is
-            # restored sharded, then DROPPED); then the same pair in the
-            # legacy bare-TrainState shapes (pre-layout-leaf checkpoints)
-            flip, flip_mode = self._ef_flip_target(abstract)
-            candidates: list[tuple] = [
-                (self._with_layout(abstract, abstract=True), False, ""),
-                (self._with_layout(flip, abstract=True), False, flip_mode),
-                (abstract, True, ""),
-                (flip, True, flip_mode),
-            ]
-            # a MIXED dir (checkpoints from both sides of a flag flip)
-            # must resume from the NEWEST step, whichever shapes it has:
-            # a single candidate would silently walk restore_latest back
-            # past the other side's newer steps (measured: an off target
-            # on an off(4)+int8(6,8) dir resumed step 4, losing 6-8) —
-            # so try each and keep the highest restored step, stopping
-            # early once a candidate lands the latest retained step
-            latest = self.checkpointer.latest_step()
-            best = None  # (step, payload, legacy, ef_mode)
-            err = None
-            for target, legacy, ef_mode in candidates:
-                if legacy and best is not None:
-                    # the legacy shapes exist for pre-layout dirs only —
-                    # a dir that already restored a layout payload holds
-                    # no newer legacy one, and when the newest step is
-                    # merely corrupt (so no candidate ever equals
-                    # `latest`) skipping here avoids two more full
-                    # newest-first restore walks with their per-step
-                    # ckpt_restore_failed noise
-                    break
-                try:
-                    restored = self.checkpointer.restore_latest(target)
-                except Exception as e:
-                    err = e
-                    continue
-                if restored is None:
-                    # checkpoints EXIST but none passed verification:
-                    # training silently from step 0 would let this run's
-                    # retention garbage-collect the (possibly
-                    # salvageable) corrupt steps — refuse loudly instead
-                    self._refuse_unverifiable_resume(ckpt_dir)
-                if best is None or restored[1] > best[0]:
-                    best = (restored[1], restored[0], legacy, ef_mode)
-                if restored[1] == latest:
-                    break
-            if best is None:
-                raise err
-            self.start_step, payload, legacy, ef_mode = best
-            if legacy:
-                # legacy checkpoint (bare TrainState, no layout leaf):
-                # the sidecar guard above already ran for this directory
-                state = payload
-                log_json({
-                    "event": "resumed", "step": self.start_step,
-                    "legacy_payload": True,
-                })
-            else:
-                stored_leaf = np.asarray(jax.device_get(payload["stacked_layout"]))
-                if not np.array_equal(stored_leaf, self._layout_leaf):
-                    raise ValueError(
-                        f"checkpoint payload records stacked-block layout "
-                        f"[interleaved, virtual_stages, stages] = "
-                        f"{stored_leaf.tolist()}, but this run uses "
-                        f"{self._layout_leaf.tolist()} — resume with the same "
-                        "--pipeline-schedule/--pipeline-virtual-stages flags "
-                        "and stage-axis size (restoring across layouts would "
-                        "silently permute the model's layers)"
-                    )
-                state = payload["state"]
-                log_json({"event": "resumed", "step": self.start_step})
-            self.state = self._apply_ef_mode(state, ef_mode, self.start_step)
+            # THE RESHARDING RESTORE (ISSUE 14): the abstract target is
+            # built PER CANDIDATE STEP from the saved payload's orbax
+            # metadata — its STRUCTURE (legacy bare-TrainState vs layout
+            # payload, error-feedback tree present or not, the EF worker
+            # dim as saved) matches the disk, its SHARDINGS come from the
+            # LIVE mesh — so a checkpoint written under a different
+            # data×fsdp factorization or process count restores directly
+            # onto this mesh.  A mixed flag-flip dir needs no candidate
+            # ladder anymore: every step gets the target its own payload
+            # shape requires, so the newest verified step always wins.
+            t0 = time.perf_counter()
+            self._reshard_plan = {}
+            restored = self.checkpointer.restore_latest(
+                None, target_for=self._restore_target_for
+            )
+            if restored is None:
+                # checkpoints EXIST but none passed verification:
+                # training silently from step 0 would let this run's
+                # retention garbage-collect the (possibly salvageable)
+                # corrupt steps — refuse loudly instead
+                self._refuse_unverifiable_resume(ckpt_dir)
+            payload, self.start_step = restored
+            self.state, plan = self._finish_restore(payload, self.start_step)
+            log_json({
+                "event": "resumed", "step": self.start_step,
+                **({"legacy_payload": True} if plan["legacy"] else {}),
+            })
+            if plan["resharded"]:
+                self._emit_reshard_restore(
+                    plan, self.start_step,
+                    reshard_wall_s=round(time.perf_counter() - t0, 4),
+                )
         # cross-run recovery state: the (epoch, pos) cursor and the
         # quarantine set ride a sidecar next to the restored step —
         # after a quarantine skip the cursor drifts from step %
@@ -680,6 +641,34 @@ class Trainer:
             self.obs.startup_gauges(self.mesh, tgt_cap=tgt_cap)
 
     # ------------------------------------------------------------------
+
+    def _build_train_step(self) -> None:
+        """(Re)build the jitted train step against ``self.mesh`` — the
+        step closes over the mesh, so the topology-change path calls
+        this again after swapping it.  Also resets the lazily-built
+        optimizer-apply probe (same closure problem)."""
+        cfg = self.cfg
+        build = make_train_step(
+            self.model,
+            self.config,
+            self.tx,
+            self.schedule,
+            self.mesh,
+            grad_accum_steps=cfg.grad_accum_steps,
+            label_smoothing=cfg.label_smoothing,
+            with_dropout=self.use_dropout,
+            is_seq2seq=self.loaded.is_seq2seq,
+            sequence_sharded=self.sequence_sharded,
+            rules=self._rules,
+            health=self.health_on,
+            optim_spec=self.optim_spec,
+            optim_impl=cfg.optim_impl,
+            grad_compression=cfg.grad_compression,
+        )
+        self.train_step, _ = build(self.state)
+        # lazily-built jitted optimizer-apply probe (budget layer): the
+        # cadenced optimizer_apply_ms sample — see _optimizer_probe_output
+        self._opt_probe = None
 
     def set_prng_impl(self, impl: str) -> None:
         """(Re)seed the dropout stream with the given PRNG implementation
@@ -781,6 +770,10 @@ class Trainer:
             "quarantined": [
                 [e, s, rec] for (e, s), rec in self.recovery.quarantined.items()
             ],
+            # the saving topology, readable WITHOUT a restore: the
+            # resharding path's fail-fast pre-check and obs.report's
+            # old→new mesh rows both read it from here
+            "mesh_layout": self._live_mesh_layout(),
         }
         path = self._recovery_sidecar_path(step)
         tmp = path + ".tmp"
@@ -844,32 +837,70 @@ class Trainer:
                     delay = min(delay * 2, 2.0)
             yield batch
 
-    def _ef_flip_target(self, abstract):
-        """The --grad-compression flag-flip restore shapes, shared by
-        resume and anomaly-rewind so neither path can drift: an int8 run
-        accepts an ef-LESS payload (the EF tree is zero-filled after —
-        ``_apply_ef_mode("fill")``), an off run accepts an ef-CARRYING
-        payload (the residual restores sharded, then drops).  Returns
-        ``(target, ef_mode)``."""
-        if getattr(self.state, "ef", None) is not None:
-            return abstract.replace(ef=None), "fill"
-        from distributed_llms_example_tpu.ops.quant_collectives import (
-            error_feedback_shardings,
-            worker_count,
+    def _saved_ef_workers(self, meta: Any) -> int:
+        """The error-feedback worker count a payload was SAVED with, read
+        from its orbax metadata (0 = no EF tree in the payload).  The
+        worker dim is a function of the saving mesh's replica axes, so
+        this is the one state shape a topology change moves."""
+        state_meta = meta.get("state", meta) if isinstance(meta, dict) else meta
+        ef_meta = (
+            state_meta.get("ef") if isinstance(state_meta, dict)
+            else getattr(state_meta, "ef", None)
         )
+        shapes = [
+            tuple(x.shape)
+            for x in jax.tree.leaves(ef_meta)
+            if hasattr(x, "shape") and len(tuple(x.shape))
+        ]
+        return int(shapes[0][0]) if shapes else 0
 
-        ef_sh = error_feedback_shardings(self.state_sh.params, self.mesh)
-        workers = worker_count(dict(self.mesh.shape))
-        return abstract.replace(ef=jax.tree.map(
-            lambda p, sh: jax.ShapeDtypeStruct(
-                (workers,) + tuple(p.shape), np.float32, sharding=sh,
-            ),
-            abstract.params, ef_sh,
-        )), "drop"
+    def _ef_restore_target(self, abstract, saved_workers: int):
+        """The EF half of the per-step restore target — PR 12's flag-flip
+        ladder generalized to ARBITRARY saved worker counts (ISSUE 14),
+        shared by resume, anomaly-rewind and the topology path so none
+        can drift.  Returns ``(target, ef_mode)``:
 
-    def _apply_ef_mode(self, state, ef_mode: str, step: int):
-        """Finish a flag-flip restore: zero-fill the EF tree (sharded at
-        birth) or drop the restored residual, with the event log."""
+        - saved 0, live on   → ef-less target, then ZERO-FILL ("fill")
+        - saved W, live off  → restore at W, then DROP ("drop")
+        - saved W == live W  → unchanged ("")
+        - saved W != live W  → restore at the SAVED W (worker dim laid
+          over the live replica axes when divisible, replicated
+          otherwise), then RE-TILE when the live count divides the saved
+          one ("retile": merged groups' residuals sum, preserving the
+          total deferred error) or ZERO-FILL otherwise ("zero")."""
+        live_ef = getattr(self.state, "ef", None) is not None
+        live_workers = self._grad_workers if live_ef else 0
+        if saved_workers == 0:
+            return (abstract.replace(ef=None), "fill") if live_ef else (abstract, "")
+        from distributed_llms_example_tpu.parallel.sharding import divisible_spec
+        from distributed_llms_example_tpu.ops.quant_collectives import tiled_spec
+        from jax.sharding import NamedSharding
+
+        def one(p, sh):
+            shape = (int(saved_workers),) + tuple(p.shape)
+            spec = divisible_spec(tiled_spec(sh.spec), shape, self.mesh)
+            return jax.ShapeDtypeStruct(
+                shape, np.float32, sharding=NamedSharding(self.mesh, spec)
+            )
+
+        param_sh = (
+            self.state_sh.params if hasattr(self.state_sh, "params") else self.state_sh
+        )
+        target = abstract.replace(
+            ef=jax.tree.map(one, abstract.params, param_sh)
+        )
+        if not live_ef:
+            return target, "drop"
+        if saved_workers == live_workers:
+            # same worker count: the payload's EF tree restores directly
+            # (the target must still CARRY it — `abstract` is ef-less)
+            return target, ""
+        return target, ("retile" if saved_workers % live_workers == 0 else "zero")
+
+    def _apply_ef_mode(self, state, ef_mode: str, step: int, saved_workers: int = 0):
+        """Finish a flag-flip or reshard restore: zero-fill the EF tree
+        (sharded at birth), drop the restored residual, or re-tile it
+        onto the new worker count — with the event log."""
         if ef_mode == "fill":
             from distributed_llms_example_tpu.ops.quant_collectives import (
                 sharded_zero_error_feedback,
@@ -896,17 +927,278 @@ class Trainer:
                           "error is lost once — the uncompressed run does "
                           "not need it)",
             })
+        elif ef_mode == "retile":
+            from distributed_llms_example_tpu.ops.quant_collectives import (
+                retile_error_feedback,
+            )
+
+            state = state.replace(ef=retile_error_feedback(
+                state.ef, self._grad_workers, self.state_sh.ef,
+            ))
+            log_json({
+                "event": "grad_compression_ef_reshaped",
+                "step": int(step),
+                "mode": "retile",
+                "from_workers": int(saved_workers),
+                "to_workers": int(self._grad_workers),
+                "reason": "topology change: the new worker count divides "
+                          "the saved one, so each new worker group absorbs "
+                          "the summed residuals of the groups it merges "
+                          "(total deferred quantization error preserved)",
+            })
+        elif ef_mode == "zero":
+            from distributed_llms_example_tpu.ops.quant_collectives import (
+                sharded_zero_error_feedback,
+            )
+
+            state = state.replace(ef=sharded_zero_error_feedback(
+                state.params, self._grad_workers, self.state_sh.ef,
+            ))
+            log_json({
+                "event": "grad_compression_ef_reshaped",
+                "step": int(step),
+                "mode": "zero_fill",
+                "from_workers": int(saved_workers),
+                "to_workers": int(self._grad_workers),
+                "reason": "topology change: the new worker count does not "
+                          "divide the saved one — no residual regrouping "
+                          "preserves the per-worker error, so it restarts "
+                          "from zero (step-0 semantics, one residual's "
+                          "worth of deferred error dropped)",
+            })
         return state
 
-    def _with_layout(self, state: Any, abstract: bool = False) -> dict:
-        """Checkpoint payload: the TrainState plus the stacked-block layout
-        identity as an ARRAY leaf, so the identity cannot be separated from
-        the arrays it describes (the sidecar JSON can)."""
-        leaf = (
-            jax.ShapeDtypeStruct(self._layout_leaf.shape, self._layout_leaf.dtype)
-            if abstract else self._layout_leaf
+    def _live_mesh_layout(self) -> dict:
+        return {
+            "axes": {a: int(s) for a, s in self.mesh.shape.items()},
+            "processes": int(jax.process_count()),
+            "ef_workers": (
+                int(self._grad_workers)
+                if getattr(self.state, "ef", None) is not None else 0
+            ),
+        }
+
+    def _check_reshardable(self, saved_layout: dict, step: int) -> None:
+        """Fail FAST, with both factorizations named, when a recorded
+        topology cannot map onto the live mesh (``analysis/spec_lint.py
+        lint_reshard_layout`` is the shared judge) — instead of the
+        opaque orbax structure error the walk-back used to surface."""
+        live = self._live_mesh_layout()
+        axes = saved_layout.get("axes", {})
+        if axes == live["axes"] and saved_layout.get("processes") == live["processes"]:
+            return  # same topology: nothing to judge
+        from distributed_llms_example_tpu.analysis.spec_lint import (
+            lint_reshard_layout,
         )
-        return {"state": state, "stacked_layout": leaf}
+
+        abstract_params = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.state.params
+        )
+        errors = [
+            f for f in lint_reshard_layout(
+                saved_layout, dict(self.mesh.shape), abstract_params,
+                rules=self._rules,
+            )
+            if f.severity == "error"
+        ]
+        if errors:
+            raise ReshardError(
+                f"checkpoint step {step} was saved under "
+                f"{describe_factorization(saved_layout)} and cannot restore "
+                f"onto the live {describe_factorization(live)}: "
+                + "; ".join(f.message for f in errors[:3])
+            )
+
+    def _restore_target_for(self, step: int):
+        """Per-step abstract restore target for the resharding path:
+        structure from the SAVED payload's orbax metadata, shardings from
+        the LIVE mesh.  Records the step's plan (legacy?, ef mode, saved
+        layout) in ``self._reshard_plan`` for ``_finish_restore``."""
+        step = int(step)
+        abstract = abstract_like(
+            self.state.replace(ef=None), self.state_sh.replace(ef=None)
+        )
+        meta = self.checkpointer.payload_metadata(step)
+        side = self._load_recovery_sidecar(step)
+        saved_layout = (side or {}).get("mesh_layout")
+        if saved_layout:
+            # the sidecar names the saving topology WITHOUT a restore —
+            # the fail-fast seam (sidecar-less dirs are judged after the
+            # restore lands, from the payload's own mesh_layout leaf)
+            self._check_reshardable(saved_layout, step)
+        legacy = False
+        structure_unknown = False
+        has_mesh_leaf = False
+        if isinstance(meta, dict) and "state" in meta:
+            has_mesh_leaf = "mesh_layout" in meta
+            saved_workers = self._saved_ef_workers(meta)
+        elif meta is not None:
+            # bare-TrainState payload (pre-layout-leaf checkpoints)
+            legacy = True
+            saved_workers = self._saved_ef_workers(meta)
+        else:
+            # no metadata (foreign/ancient dir): the structure cannot be
+            # classified — assume the live EF shape and try BOTH payload
+            # structures (layout payload first, legacy bare state as the
+            # fallback, exactly the pre-reshard candidate ladder's order)
+            structure_unknown = True
+            saved_workers = (
+                self._grad_workers
+                if getattr(self.state, "ef", None) is not None else 0
+            )
+        target, ef_mode = self._ef_restore_target(abstract, saved_workers)
+        resharded = bool(saved_layout) and (
+            saved_layout.get("axes") != self._live_mesh_layout()["axes"]
+            or saved_layout.get("processes") != jax.process_count()
+        )
+        self._reshard_plan[step] = {
+            "legacy": legacy,
+            "structure_unknown": structure_unknown,
+            "ef_mode": ef_mode,
+            "saved_workers": int(saved_workers),
+            "saved_layout": saved_layout,
+            "resharded": resharded or ef_mode in ("retile", "zero"),
+        }
+        if legacy:
+            return target
+        payload: dict[str, Any] = {
+            "state": target,
+            "stacked_layout": jax.ShapeDtypeStruct(
+                self._layout_leaf.shape, self._layout_leaf.dtype
+            ),
+        }
+        if has_mesh_leaf:
+            payload["mesh_layout"] = jax.ShapeDtypeStruct(
+                self._mesh_layout_leaf.shape, self._mesh_layout_leaf.dtype
+            )
+        if not structure_unknown:
+            return payload
+        # the pre-reshard candidate ladder's order for an unclassifiable
+        # step: layout payload first (mesh-leaf-carrying — the modern
+        # save format — then the pre-mesh-leaf shape, live EF structure
+        # then the --grad-compression flag-flip shape), legacy bare
+        # state last — _finish_restore classifies structure AND EF
+        # transition from what actually landed
+        from distributed_llms_example_tpu.ops.quant_collectives import (
+            worker_count,
+        )
+
+        live_ef = getattr(self.state, "ef", None) is not None
+        flip, _ = self._ef_restore_target(
+            abstract, 0 if live_ef else worker_count(dict(self.mesh.shape))
+        )
+        flip_payload = dict(payload)
+        flip_payload["state"] = flip
+
+        def with_mesh_leaf(p: dict) -> dict:
+            q = dict(p)
+            q["mesh_layout"] = jax.ShapeDtypeStruct(
+                self._mesh_layout_leaf.shape, self._mesh_layout_leaf.dtype
+            )
+            return q
+
+        return [
+            with_mesh_leaf(payload), payload,
+            with_mesh_leaf(flip_payload), flip_payload,
+            target, flip,
+        ]
+
+    def _finish_restore(self, payload: Any, step: int) -> tuple[Any, dict]:
+        """Unwrap a restored payload per its recorded plan: layout-leaf
+        guard, mesh-layout cross-check (the sidecar-less fail path), EF
+        fill/drop/retile/zero-fill.  Returns ``(state, plan)``."""
+        plan = self._reshard_plan.pop(int(step), None) or {
+            "legacy": not isinstance(payload, dict),
+            "ef_mode": "", "saved_workers": 0,
+            "saved_layout": None, "resharded": False,
+        }
+        if plan.get("structure_unknown"):
+            # a metadata-less step offered several candidate structures
+            # — classify the payload shape AND the EF transition by what
+            # actually restored
+            plan["legacy"] = not isinstance(payload, dict)
+            inner = payload if plan["legacy"] else payload["state"]
+            restored_ef = getattr(inner, "ef", None)
+            live_ef = self.cfg.grad_compression == "int8"
+            if live_ef and restored_ef is None:
+                plan["ef_mode"] = "fill"
+            elif not live_ef and restored_ef is not None:
+                plan["ef_mode"] = "drop"
+                plan["saved_workers"] = int(
+                    jax.tree.leaves(restored_ef)[0].shape[0]
+                )
+            else:
+                plan["ef_mode"] = ""
+        if plan["legacy"]:
+            state = payload
+        else:
+            stored_leaf = np.asarray(jax.device_get(payload["stacked_layout"]))
+            if not np.array_equal(stored_leaf, self._layout_leaf):
+                raise ValueError(
+                    f"checkpoint payload records stacked-block layout "
+                    f"[interleaved, virtual_stages, stages] = "
+                    f"{stored_leaf.tolist()}, but this run uses "
+                    f"{self._layout_leaf.tolist()} — resume with the same "
+                    "--pipeline-schedule/--pipeline-virtual-stages flags "
+                    "and stage-axis size (restoring across layouts would "
+                    "silently permute the model's layers)"
+                )
+            if "mesh_layout" in payload and plan["saved_layout"] is None:
+                # no sidecar named the topology pre-restore: the payload
+                # leaf is authoritative — judge it now (still a NAMED
+                # error, just after the arrays landed)
+                saved = parse_mesh_layout(jax.device_get(payload["mesh_layout"]))
+                self._check_reshardable(saved, step)
+                plan["saved_layout"] = saved
+                plan["resharded"] = plan["resharded"] or (
+                    saved["axes"] != self._live_mesh_layout()["axes"]
+                    or saved["processes"] != jax.process_count()
+                )
+            state = payload["state"]
+        state = self._apply_ef_mode(
+            state, plan["ef_mode"], step, saved_workers=plan["saved_workers"]
+        )
+        return state, plan
+
+    def _emit_reshard_restore(self, plan: dict, step: int, **extra: Any) -> None:
+        """The ``reshard_restore`` obs event: a checkpoint crossed a
+        topology boundary on its way back in (old → new factorization,
+        EF handling, wall clock) — what ``obs.report``'s recovery
+        timeline and the MTTR account consume."""
+        from distributed_llms_example_tpu.obs import sink as sink_mod
+
+        saved = plan.get("saved_layout") or {}
+        sink_mod.emit({
+            "event": "reshard_restore",
+            "step": int(step),
+            "old_mesh": saved.get("axes"),
+            "old_processes": saved.get("processes"),
+            "new_mesh": {a: int(s) for a, s in self.mesh.shape.items()},
+            "new_processes": int(jax.process_count()),
+            "ef_mode": plan.get("ef_mode") or "none",
+            **extra,
+        }, local=True)
+
+    def _with_layout(self, state: Any, abstract: bool = False) -> dict:
+        """Checkpoint payload: the TrainState plus the stacked-block
+        layout identity AND the mesh topology (axis sizes, process
+        count, EF workers) as ARRAY leaves, so neither identity can be
+        separated from the arrays it describes (a sidecar JSON can)."""
+        if abstract:
+            return {
+                "state": state,
+                "stacked_layout": jax.ShapeDtypeStruct(
+                    self._layout_leaf.shape, self._layout_leaf.dtype
+                ),
+                "mesh_layout": jax.ShapeDtypeStruct(
+                    self._mesh_layout_leaf.shape, self._mesh_layout_leaf.dtype
+                ),
+            }
+        return {
+            "state": state,
+            "stacked_layout": self._layout_leaf,
+            "mesh_layout": self._mesh_layout_leaf,
+        }
 
     def evaluate(
         self, epoch: int | None = None, step: int | None = None
@@ -1216,35 +1508,31 @@ class Trainer:
             return epoch, pos, step
         if action == "rewind":
             # the rewind target can sit on the far side of a
-            # --grad-compression flip (resume-then-rewind past the flip
-            # boundary): try the current shapes AND the flag-flip shapes
-            # and take whichever reaches the NEWEST pre-anomaly step, so
-            # a mixed retention window never walks back further than it
-            # must (the resume-time loop above has the same contract)
-            abstract = abstract_like(self.state, self.state_sh)
-            flip, flip_mode = self._ef_flip_target(abstract)
-            best = None  # (step, payload, ef_mode)
-            for target, mode in ((abstract, ""), (flip, flip_mode)):
-                try:
-                    r = self.checkpointer.restore_before(
-                        a_step, self._with_layout(target, abstract=True)
-                    )
-                except Exception:
-                    continue
-                if r is not None and (best is None or r[1] > best[0]):
-                    best = (r[1], r[0], mode)
-            restored = None if best is None else (best[1], best[0])
-            ef_mode = "" if best is None else best[2]
+            # --grad-compression flip OR a topology change (a run that
+            # resharded can rewind past its own reshard boundary): the
+            # per-step metadata-driven target builder — the SAME one the
+            # resume and topology paths use — matches each candidate
+            # step's saved shapes, so the walk never skips a newer step
+            # over a shape mismatch
+            self._reshard_plan = {}
+            restored, rewind_err = None, None
+            try:
+                restored = self.checkpointer.restore_before(
+                    a_step, None, target_for=self._restore_target_for
+                )
+            except Exception as e:
+                rewind_err = e
             if restored is None:
                 action = "halt"
                 reason = (
                     f"no verified checkpoint older than anomaly step {a_step}"
+                    + (f" ({str(rewind_err)[:160]})" if rewind_err else "")
                 )
             else:
                 payload, rstep = restored
-                self.state = self._apply_ef_mode(
-                    payload["state"], ef_mode, rstep
-                )
+                self.state, rplan = self._finish_restore(payload, rstep)
+                if rplan["resharded"]:
+                    self._emit_reshard_restore(rplan, rstep)
                 # checkpoints newer than the restore target may hold the
                 # poisoned state (saved between anomaly and detection)
                 # with CLEAN checksums — drop them so the replay re-saves
@@ -1295,6 +1583,227 @@ class Trainer:
         sink_mod.flush(fsync=True)
         return None
 
+    def _check_topology(self, step: int) -> bool:
+        """Topology-change (host-loss) check for the step loop — the
+        same cadence/agreement discipline as ``_check_preemption``:
+        single-process reads the local flag every step; multi-host
+        agrees over an allgather at the bounded cadence so every rank
+        takes the teardown branch at the same step.  (The injected
+        ``host_loss@K`` schedule is deterministic across ranks, so the
+        allgather is the same belt the preemption flag wears, not the
+        mechanism.)"""
+        if jax.process_count() == 1:
+            return self._host_lost
+        if step % self._preempt_sync_every != 0:
+            return False
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(np.asarray([self._host_lost]))
+        return bool(np.asarray(flags).any())
+
+    def _rebuild_for_mesh(self, mesh: Any) -> None:
+        """Swap in a NEW mesh and rebuild everything derived from it —
+        the trainer half of topology-change recovery.  Validates first
+        (named errors, nothing torn down on failure), then replaces:
+        shardings, the abstract state template (EF worker dim follows
+        the new replica axes), the batch iterator (global batch
+        PRESERVED — only the per-host slice and the shard layout move),
+        the jitted train step, the evaluator, the topology payload leaf.
+        ``self.state`` becomes an ABSTRACT template: the caller MUST
+        follow with the resharding restore (a lost host's shards are
+        gone — topology recovery is a restore, not a migration)."""
+        cfg = self.cfg
+        new_shape = {a: int(s) for a, s in mesh.shape.items()}
+        workers = 1
+        if cfg.grad_compression == "int8":
+            from distributed_llms_example_tpu.ops.quant_collectives import (
+                GRAD_WORKER_AXES,
+                worker_count,
+            )
+
+            workers = worker_count(new_shape)
+            if workers <= 1:
+                raise ValueError(
+                    f"--grad-compression int8 cannot continue on the new "
+                    f"mesh {new_shape}: the replica axes "
+                    f"{GRAD_WORKER_AXES} give 1 worker group — resume on "
+                    "the new slice with compression off instead"
+                )
+        from distributed_llms_example_tpu.data.batching import validate_batch_mesh
+
+        validate_batch_mesh(
+            cfg.batch_size, new_shape,
+            process_count=jax.process_count(),
+            grad_accum_steps=cfg.grad_accum_steps,
+        )
+        seq_axis = new_shape.get("sequence", 1)
+        sequence_sharded = seq_axis > 1 and all(
+            dim % seq_axis == 0
+            for dim in (cfg.pad_to_multiple, cfg.max_source_length, self._tgt_cap)
+        )
+        self.mesh = mesh
+        self._grad_workers = workers
+        self.sequence_sharded = sequence_sharded
+        # abstract state template at the NEW topology: params/opt-state
+        # shapes are mesh-invariant, only the EF worker dim moves
+        template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self.state.replace(ef=None),
+        )
+        if cfg.grad_compression == "int8":
+            template = template.replace(ef=jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(
+                    (workers,) + tuple(p.shape), np.float32
+                ),
+                template.params,
+            ))
+        self.state = template
+        self.state_sh = state_shardings(template, mesh, self._rules)
+        self._mesh_layout_leaf = mesh_layout_array(
+            new_shape, jax.process_count(),
+            workers if cfg.grad_compression == "int8" else 0,
+        )
+        # the batch PLAN is a deterministic function of (seed, epoch,
+        # global batch) — all preserved — so the loss trajectory stays
+        # comparable across the change; only this host's slice moves
+        self.batches = BatchIterator(
+            self.train_ds,
+            global_batch=cfg.batch_size,
+            process_count=jax.process_count(),
+            process_index=jax.process_index(),
+            seed=cfg.shuffle_seed,
+            bucket_multiple=cfg.pad_to_multiple,
+            max_source_length=cfg.max_source_length,
+            max_target_length=self._tgt_cap,
+        )
+        self._build_train_step()
+        for attr in ("_val_loss_fn", "_val_unpermute"):
+            if hasattr(self, attr):
+                delattr(self, attr)
+        if self.val_ds:
+            self.evaluator = Evaluator(
+                self.loaded.module,
+                self.config,
+                self.tokenizer,
+                mesh,
+                num_beams=cfg.num_beams,
+                max_new_tokens=cfg.eval_max_new_tokens,
+                is_seq2seq=self.loaded.is_seq2seq,
+            )
+
+    def _handle_topology_change(
+        self, step: int, epoch: int, pos: int
+    ) -> tuple[int, int, int] | None:
+        """The agreed host-loss action (ISSUE 14), on top of PR 6's
+        escalation: tear down collectives, re-run the ``jax.distributed``
+        bootstrap on the surviving slice, rebuild mesh / shardings /
+        train step / batch plan, restore the newest verified checkpoint
+        through the RESHARDING path, and resume from the recovery
+        sidecar's (epoch, pos) cursor with the quarantine set intact.
+        Returns the cursor the loop resumes at, or None to stop
+        (``self._anomaly_action`` set — the evidence-preserving
+        checkpoint policy, like a final-window rewind)."""
+        from distributed_llms_example_tpu.obs import sink as sink_mod
+
+        t0 = time.perf_counter()
+        self._host_lost = False
+        old_layout = self._live_mesh_layout()
+        halt_reason: str | None = None
+        if self.cfg.on_host_loss != "reshard":
+            halt_reason = "--on-host-loss halt: leaving recovery to a resumed run"
+        elif self.pipelined:
+            # the composition table's row IS the message (deep-guard
+            # discipline: the text cannot drift from the table)
+            from distributed_llms_example_tpu.analysis.composition import (
+                reason_for,
+            )
+
+            halt_reason = reason_for("reshard-pipelined")
+        sink_mod.emit({
+            "event": "topology_change",
+            "step": int(step),
+            "old_mesh": old_layout["axes"],
+            "old_processes": old_layout["processes"],
+            "policy": "halt" if halt_reason else "reshard",
+            **({"reason": halt_reason} if halt_reason else {}),
+        }, local=True)
+        sink_mod.flush(fsync=True)
+        if halt_reason:
+            self._anomaly_action = "checkpoint"
+            return None
+        # nothing in flight may straddle the teardown
+        self.checkpointer.wait()
+        if old_layout["processes"] > 1:
+            # the ONE owner of the re-init path (core/mesh.py): shutdown
+            # + fresh bootstrap from the re-read rendezvous facts of the
+            # surviving slice
+            from distributed_llms_example_tpu.core.mesh import (
+                reinitialize_distributed,
+            )
+
+            reinitialize_distributed()
+        try:
+            if self._next_mesh_override is not None:
+                new_mesh = build_mesh(self._next_mesh_override)
+                self._next_mesh_override = None
+            else:
+                from distributed_llms_example_tpu.core.mesh import elastic_mesh_spec
+
+                new_mesh = build_mesh(
+                    elastic_mesh_spec(self.cfg.mesh, jax.device_count())
+                )
+            self._rebuild_for_mesh(new_mesh)
+            self._reshard_plan = {}
+            restored = self.checkpointer.restore_latest(
+                None, target_for=self._restore_target_for
+            )
+        except Exception as e:
+            sink_mod.emit({
+                "event": "recovery", "action": "halt", "step": int(step),
+                "code": "host_loss",
+                "reason": f"topology rebuild/restore failed: {str(e)[:240]}",
+            }, local=True)
+            sink_mod.flush(fsync=True)
+            self._anomaly_action = "halt"
+            return None
+        if restored is None:
+            sink_mod.emit({
+                "event": "recovery", "action": "halt", "step": int(step),
+                "code": "host_loss",
+                "reason": "no verified checkpoint to reshard from",
+            }, local=True)
+            sink_mod.flush(fsync=True)
+            self._anomaly_action = "halt"
+            return None
+        payload, rstep = restored
+        self.state, plan = self._finish_restore(payload, rstep)
+        # exact cursor + quarantine, same ladder as rewind: the in-memory
+        # save snapshot (restores the dropout key too, so an in-process
+        # reshard replays the surviving steps on the same RNG stream),
+        # then the recovery sidecar (cross-run: pos can drift from
+        # step % steps_per_epoch after a quarantine skip), then arithmetic
+        snap = self.recovery.snapshot_for(rstep)
+        side = self._load_recovery_sidecar(rstep)
+        if side is not None:
+            for e, s, rec in side.get("quarantined", []):
+                self.recovery.quarantined.setdefault((int(e), int(s)), rec)
+        if snap is not None:
+            self._rng = snap["rng"]
+            r_epoch, r_pos = snap["epoch"], snap["pos"]
+        elif side is not None:
+            r_epoch, r_pos = int(side["epoch"]), int(side["pos"])
+        else:
+            spe = self.batches.steps_per_epoch()
+            r_epoch, r_pos = rstep // spe, rstep % spe
+        self._emit_reshard_restore(
+            plan, rstep,
+            detected_at_step=int(step),
+            steps_lost=int(step - rstep),
+            reshard_wall_s=round(time.perf_counter() - t0, 4),
+        )
+        sink_mod.flush(fsync=True)
+        return r_epoch, r_pos, int(rstep)
+
     def train(self) -> dict[str, Any]:
         # handlers restored in a finally: a raising train step must not
         # leave the flag-setting handler installed process-wide (it would
@@ -1332,6 +1841,7 @@ class Trainer:
         step = self.start_step
         self._last_step = step
         self._anomaly_action: str | None = None
+        self._host_lost = False
         t0 = time.perf_counter()
         last_eval: dict[str, float] = {}
         last_metrics: dict[str, Any] | None = None
@@ -1368,6 +1878,7 @@ class Trainer:
             if cfg.prefetch_batches > 0:
                 epoch_batches = Prefetcher(epoch_batches, depth=cfg.prefetch_batches)
             rewind_cursor: tuple[int, int, int] | None = None
+            topology_cursor: tuple[int, int, int] | None = None
             try:
                 for batch in obs.wrap_batches(self._with_data_retries(epoch_batches)):
                     pos += 1
@@ -1467,6 +1978,17 @@ class Trainer:
                         import signal as _signal
 
                         os.kill(os.getpid(), _signal.SIGTERM)
+                    if self.chaos.take("host_loss", step):
+                        # chaos: the agreed topology-change signal — the
+                        # deterministic schedule raises it on every rank
+                        # at the same step; _check_topology's allgather
+                        # is the same belt the preemption flag wears
+                        self._host_lost = True
+                    if self._check_topology(step):
+                        topology_cursor = self._handle_topology_change(
+                            step, epoch, pos
+                        )
+                        break
                     if self._check_preemption(step):
                         self._preempted = True  # agreed across hosts
                         break
@@ -1494,6 +2016,16 @@ class Trainer:
                 # replay re-runs the surviving steps bit-identically and
                 # skips the quarantined batch
                 epoch, pos, step = rewind_cursor
+                self._last_step = step
+                obs.spans.mark_step_start()
+                continue
+            if topology_cursor is not None:
+                # resume on the NEW mesh at the resharded checkpoint's
+                # cursor: the epoch re-enters at the top of this loop, so
+                # the batch plan is re-derived from the rebuilt iterator
+                # (same global batch, new per-host slice) and the next
+                # step dispatch compiles the rebuilt program
+                epoch, pos, step = topology_cursor
                 self._last_step = step
                 obs.spans.mark_step_start()
                 continue

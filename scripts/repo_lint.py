@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Ten rules, all born from real regressions at TPU scale:
+Eleven rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -111,6 +111,19 @@ Ten rules, all born from real regressions at TPU scale:
    ``.astype(int8/uint8)`` fails here — creation via ``jnp.zeros(...,
    jnp.int8)`` is allocation, not quantization, and stays legal.
 
+11. **No mesh construction or ``jax.distributed`` lifecycle calls
+   outside ``core/mesh.py``.**  Elastic training (ISSUE 14) makes the
+   distributed bootstrap a thing that happens MID-RUN: the
+   topology-change path shuts the client down and re-initializes it on
+   the surviving slice, and the resharding restore assumes every mesh
+   in the process came from the one constructor (axis names, ICI-aware
+   device order, the gloo-on-CPU flag).  A stray ``Mesh(...)`` or
+   ``jax.distributed.initialize/shutdown`` elsewhere forks that
+   lifecycle: its mesh would skip topology-aware device ordering, and a
+   second initializer would fight the re-init path's teardown ordering.
+   ``build_mesh`` / ``initialize_distributed`` /
+   ``reinitialize_distributed`` in ``core/mesh.py`` are the owners.
+
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
 tests/test_health.py) next to the analysis-CLI smoke run.
@@ -212,6 +225,12 @@ _MANAGER_NAMES = ("manager", "_manager", "checkpoint_manager", "ckpt_manager")
 # exporter — a second producer means a second clock epoch and no
 # cross-rank alignment.
 TRACE_OWNER = os.path.join(PACKAGE, "obs", "trace.py")
+
+# rule 11: the ONE owner of mesh construction and the jax.distributed
+# lifecycle (init/shutdown/reinit) — the elastic-recovery path re-enters
+# both mid-run, so a second constructor/initializer elsewhere would fork
+# the teardown ordering and the device-order contract
+MESH_OWNER = os.path.join(PACKAGE, "core", "mesh.py")
 
 # Rule 9: gradient collectives / quantization are owned by
 # ops/quant_collectives.py, called only from train/step.py — a raw
@@ -402,6 +421,46 @@ def _trace_emit_violations(tree: ast.AST, rel: str) -> list[str]:
                 "obs/trace.py — a rogue trace producer has its own clock "
                 "epoch and no cross-rank step alignment; record spans "
                 "through obs/spans.py and let obs/trace.py export them"
+            )
+    return violations
+
+
+def _mesh_ownership_violations(tree: ast.AST, rel: str) -> list[str]:
+    """Rule 11: ``Mesh(...)`` construction (``jax.sharding.Mesh`` /
+    imported ``Mesh`` — ``AbstractMesh`` and mesh-SHAPED helpers are
+    fine) and any ``jax.distributed.*`` call outside core/mesh.py."""
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # Mesh(...) / jax.sharding.Mesh(...) / sharding.Mesh(...)
+        name = (
+            func.id if isinstance(func, ast.Name)
+            else func.attr if isinstance(func, ast.Attribute)
+            else None
+        )
+        if name == "Mesh":
+            violations.append(
+                f"{rel}:{node.lineno}: raw Mesh(...) construction outside "
+                "core/mesh.py skips the topology-aware device ordering and "
+                "the elastic-recovery lifecycle — build meshes through "
+                "core.mesh.build_mesh"
+            )
+            continue
+        # jax.distributed.initialize/shutdown(...) in any spelling that
+        # goes through an attribute chain ending `.distributed.<fn>`
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Attribute)
+            and func.value.attr == "distributed"
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: jax.distributed.{func.attr}(...) "
+                "outside core/mesh.py forks the distributed lifecycle the "
+                "topology-change path owns (teardown ordering, rendezvous "
+                "facts, the gloo-on-CPU flag) — go through "
+                "core.mesh.initialize_distributed / reinitialize_distributed"
             )
     return violations
 
@@ -597,6 +656,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         violations.extend(_kv_cast_violations(tree, rel))
     if rel != CKPT_OWNER:
         violations.extend(_ckpt_manager_violations(tree, rel))
+    if rel != MESH_OWNER:
+        violations.extend(_mesh_ownership_violations(tree, rel))
     if rel != TRACE_OWNER:
         violations.extend(_trace_emit_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?

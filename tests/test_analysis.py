@@ -834,6 +834,45 @@ def test_repo_lint_kv_cast_rule(tmp_path):
         assert repo_lint.lint_file(str(bad), rel) == []
 
 
+def test_repo_lint_mesh_ownership_rule(tmp_path):
+    """Rule 11 (ISSUE 14): raw ``Mesh(...)`` construction and any
+    ``jax.distributed.*`` call outside core/mesh.py fork the distributed
+    lifecycle the topology-change path owns (teardown ordering, the
+    topology-aware device order, the gloo-on-CPU flag); ``AbstractMesh``
+    (shape-only, no devices) stays legal, and the owner is exempt."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    repo_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repo_lint)
+
+    bad = tmp_path / "m.py"
+    bad.write_text(
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, AbstractMesh\n"
+        "def f(devs):\n"
+        "    m = Mesh(np.array(devs).reshape(2, 4), ('data', 'fsdp'))\n"
+        "    m2 = jax.sharding.Mesh(devs, ('data',))\n"
+        "    jax.distributed.initialize('c:1', 2, 0)\n"
+        "    jax.distributed.shutdown()\n"
+        "    ok = AbstractMesh((2,), ('data',))\n"  # shape-only: legal
+        "    return m, m2, ok\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "train", "m.py")
+    violations = repo_lint.lint_file(str(bad), rel)
+    assert len(violations) == 4, violations
+    assert any("build_mesh" in v for v in violations)
+    assert any("reinitialize_distributed" in v for v in violations)
+    # the owner is exempt
+    rel = os.path.join("distributed_llms_example_tpu", "core", "mesh.py")
+    assert repo_lint.lint_file(str(bad), rel) == []
+
+
 def test_repo_lint_ckpt_manager_rule(tmp_path):
     """Rule 6 (ISSUE 6): bare orbax ``manager.save``/``manager.restore``
     outside io/checkpoint.py bypasses the integrity wrappers (save

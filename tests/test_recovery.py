@@ -408,14 +408,24 @@ def test_recovery_sidecar_round_trip(tmp_path, capsys):
     epoch."""
     from distributed_llms_example_tpu.train.trainer import Trainer
 
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+
     t = object.__new__(Trainer)
     t.checkpointer = Checkpointer(str(tmp_path), save_every_steps=1, async_save=False)
     t.recovery = RecoveryController()
+    t.mesh = build_mesh(MeshConfig(data=-1))
+    t.state = argparse.Namespace(ef=None)  # no error-feedback tree
+    t._grad_workers = 1
     t.recovery.quarantine(1, 0, _fp(), reason="anomaly:nonfinite@3")
     Trainer._write_recovery_sidecar(t, 4, 2, 1)
     side = Trainer._load_recovery_sidecar(t, 4)
     assert (side["epoch"], side["pos"]) == (2, 1)
     assert side["quarantined"] == [[1, 0, t.recovery.quarantined[(1, 0)]]]
+    # the sidecar names the saving topology (ISSUE 14): the resharding
+    # restore's fail-fast pre-check reads it without touching orbax
+    assert side["mesh_layout"]["axes"]["data"] == 8
+    assert side["mesh_layout"]["processes"] == 1
+    assert side["mesh_layout"]["ef_workers"] == 0
     assert Trainer._load_recovery_sidecar(t, 99) is None  # missing = None
     # GC'd with the step: deleting past step 0 drops step 4's sidecar
     t.checkpointer.save(4, _tiny_state())

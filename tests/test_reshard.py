@@ -1,0 +1,922 @@
+"""Elastic pod-scale training (ISSUE 14): resharding restore +
+topology-change recovery.
+
+Acceptance pins: the mesh-layout payload/sidecar round trip; the
+metadata-driven resharding restore across ``data×fsdp`` factorizations
+on the 8-device mesh (2×4 → 4×2 → 8×1, params BIT-EQUAL, same-mesh
+resume untouched); error-feedback re-tile (group sums preserve the
+total deferred error) and zero-fill in both directions; the
+``lint_reshard_layout`` proof pass green on a supported reshard and
+firing on unmappable factorizations (stage/expert moves, unknown axes);
+the ``host_loss@K`` chaos grammar + in-process topology-change path
+(teardown → rebuild → reshard restore → cursor resume); the
+``obs.report`` topology timeline with reshard wall-clock in MTTR and
+the injected-vs-organic split ``--strict`` gates on; repo-lint rule 11
+(mesh construction / ``jax.distributed`` outside core/mesh.py).
+
+The ROADMAP acceptance run — a 2-process CPU run killed down to 1
+process resuming through the resharding restore and matching a clean
+1-process run from the same checkpoint (bit-equal final params) — rides
+the slow tier next to tests/test_multiprocess.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llms_example_tpu.core.config import (
+    CheckpointConfig,
+    MeshConfig,
+    TrainConfig,
+)
+from distributed_llms_example_tpu.core.mesh import MeshSpec, elastic_mesh_spec
+from distributed_llms_example_tpu.io.checkpoint import (
+    describe_factorization,
+    mesh_layout_array,
+    parse_mesh_layout,
+)
+from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.chaos import parse_chaos
+from distributed_llms_example_tpu.obs.report import build_report, render_markdown
+
+
+@pytest.fixture(autouse=True)
+def _default_sink():
+    sink_mod.install_sink(sink_mod.build_sink("stdout", ""))
+    yield
+    sink_mod.install_sink(sink_mod.build_sink("stdout", ""))
+
+
+# ---------------------------------------------------------------------------
+# mesh-layout payload leaf + elastic mesh resolution
+# ---------------------------------------------------------------------------
+
+def test_mesh_layout_leaf_round_trip():
+    leaf = mesh_layout_array({"data": 2, "fsdp": 4}, 2, 8)
+    parsed = parse_mesh_layout(leaf)
+    assert parsed["axes"]["data"] == 2 and parsed["axes"]["fsdp"] == 4
+    assert parsed["axes"]["stage"] == 1  # unnamed axes default to 1
+    assert parsed["processes"] == 2 and parsed["ef_workers"] == 8
+    assert "data=2" in describe_factorization(parsed)
+    assert "2 process(es)" in describe_factorization(parsed)
+    assert describe_factorization(None) == "<unrecorded>"
+    with pytest.raises(ValueError, match="entries"):
+        parse_mesh_layout(np.zeros(3, np.int32))
+
+
+def test_elastic_mesh_spec_rescales_data_axis():
+    # a -1 axis absorbs the change exactly as at startup
+    spec = elastic_mesh_spec(MeshConfig(data=-1, fsdp=2), 4)
+    assert (spec.data, spec.fsdp) == (2, 2)
+    # a fully pinned factorization re-scales DATA onto the survivors
+    spec = elastic_mesh_spec(MeshConfig(data=2, fsdp=4), 4)
+    assert (spec.data, spec.fsdp) == (1, 4)
+    # ...and refuses, named, when the model axes no longer fit
+    with pytest.raises(ValueError, match="surviving"):
+        elastic_mesh_spec(MeshConfig(data=2, fsdp=8), 4)
+
+
+# ---------------------------------------------------------------------------
+# error-feedback re-tile
+# ---------------------------------------------------------------------------
+
+def test_retile_error_feedback_preserves_total_residual():
+    from distributed_llms_example_tpu.ops.quant_collectives import (
+        retile_error_feedback,
+    )
+
+    rng = np.random.RandomState(0)
+    ef = {"w": rng.randn(4, 3, 2).astype(np.float32),
+          "b": rng.randn(4, 5).astype(np.float32)}
+    out = retile_error_feedback(ef, 2)
+    assert {k: v.shape for k, v in out.items()} == {"w": (2, 3, 2), "b": (2, 5)}
+    for k in ef:
+        # each new group = sum of the old groups it merges...
+        np.testing.assert_allclose(
+            np.asarray(out[k]),
+            ef[k].reshape((2, 2) + ef[k].shape[1:]).sum(axis=1),
+            rtol=1e-6,
+        )
+        # ...so the telescoping total is preserved exactly
+        np.testing.assert_allclose(
+            np.asarray(out[k]).sum(axis=0), ef[k].sum(axis=0), rtol=1e-6
+        )
+    with pytest.raises(ValueError, match="divide"):
+        retile_error_feedback(ef, 3)
+
+
+def test_retile_error_feedback_sharded_at_birth(mesh8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_llms_example_tpu.ops.quant_collectives import (
+        retile_error_feedback,
+    )
+
+    ef = {"w": np.arange(4 * 8 * 4, dtype=np.float32).reshape(4, 8, 4)}
+    sh = {"w": NamedSharding(mesh8, P("data", "fsdp", None))}
+    out = retile_error_feedback(ef, 2, sh)
+    assert out["w"].sharding == sh["w"]
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(out["w"])),
+        ef["w"].reshape(2, 2, 8, 4).sum(axis=1),
+        rtol=1e-6,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the spec-lint reshard proof pass
+# ---------------------------------------------------------------------------
+
+def _abstract_params():
+    return {
+        "decoder": {
+            "self_attn": {"q_proj": {"kernel": jax.ShapeDtypeStruct((64, 64), np.float32)}},
+            "mlp": {"wi": {"kernel": jax.ShapeDtypeStruct((64, 128), np.float32)}},
+        }
+    }
+
+
+def test_ef_restore_target_same_workers_keeps_ef(mesh8):
+    """Regression: a SAME-topology --grad-compression int8 resume must
+    hand orbax a target that still CARRIES the error-feedback tree (the
+    payload has one) — the ef-less abstract template would fail every
+    candidate step's restore on structure mismatch."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    @dataclasses.dataclass
+    class FakeState:
+        params: object
+        ef: object
+
+        def replace(self, **kw):
+            return dataclasses.replace(self, **kw)
+
+    params = {"w": jax.ShapeDtypeStruct((8, 16), np.float32)}
+    fake = type("FakeTrainer", (), {})()
+    fake.state = FakeState(params=params, ef={"w": object()})  # live EF on
+    fake._grad_workers = 2
+    fake.mesh = mesh8
+    fake.state_sh = FakeState(
+        params={"w": NamedSharding(mesh8, P("fsdp", None))}, ef=None
+    )
+    abstract = FakeState(params=params, ef=None)  # template is ef-less
+    target, mode = Trainer._ef_restore_target(fake, abstract, saved_workers=2)
+    assert mode == ""
+    assert target.ef is not None
+    (leaf,) = jax.tree.leaves(target.ef)
+    assert tuple(leaf.shape) == (2, 8, 16)
+
+
+def test_reshard_lint_green_on_data_fsdp_refactorization():
+    from distributed_llms_example_tpu.analysis.spec_lint import lint_reshard_layout
+
+    saved = {"axes": {"data": 2, "fsdp": 4}, "processes": 2, "ef_workers": 0}
+    findings = lint_reshard_layout(saved, {"data": 4, "fsdp": 2}, _abstract_params())
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_reshard_lint_errors_on_unmappable_factorizations():
+    from distributed_llms_example_tpu.analysis.spec_lint import lint_reshard_layout
+
+    params = _abstract_params()
+    # expert>1 → expert=1: the satellite fix — a NAMED error instead of
+    # an opaque restore exception deep in the walk-back
+    saved = {"axes": {"data": 2, "expert": 2}, "processes": 2, "ef_workers": 0}
+    codes = [f.code for f in lint_reshard_layout(saved, {"data": 8}, params)
+             if f.severity == "error"]
+    assert "reshard-expert-mismatch" in codes
+    # stage moves are the composition row's territory
+    saved = {"axes": {"stage": 2, "data": 4}, "processes": 1, "ef_workers": 0}
+    codes = [f.code for f in lint_reshard_layout(saved, {"data": 8}, params)
+             if f.severity == "error"]
+    assert "reshard-stage-mismatch" in codes
+    # an axis name this build does not know
+    saved = {"axes": {"hyper": 4}, "processes": 1, "ef_workers": 0}
+    codes = [f.code for f in lint_reshard_layout(saved, {"data": 8}, params)
+             if f.severity == "error"]
+    assert "unknown-saved-axis" in codes
+
+
+def test_reshard_lint_ef_transition_findings():
+    from distributed_llms_example_tpu.analysis.spec_lint import lint_reshard_layout
+
+    params = _abstract_params()
+    saved = {"axes": {"data": 8}, "processes": 2, "ef_workers": 8}
+    # 8 → 4 workers divides: re-tile, info
+    f = [x for x in lint_reshard_layout(saved, {"data": 4, "fsdp": 2}, params)
+         if x.code == "reshard-ef-retile"]
+    assert len(f) == 1 and f[0].severity == "info"
+    # 8 → 3 does not: zero-fill, warning
+    f = [x for x in lint_reshard_layout(saved, {"data": 3}, params)
+         if x.code == "reshard-ef-zero-fill"]
+    assert len(f) == 1 and f[0].severity == "warning"
+
+
+def test_reshard_lint_cli_wiring():
+    from distributed_llms_example_tpu.analysis.lint import main as lint_main
+
+    rc = lint_main([
+        "--model", "t5-test", "--mesh", "data=4,fsdp=2",
+        "--reshard-from", "data=2,fsdp=4", "--reshard-processes", "2",
+        "--no-ir",
+    ])
+    assert rc == 0
+    rc = lint_main([
+        "--model", "t5-test", "--mesh", "data=8",
+        "--reshard-from", "data=2,fsdp=2,expert=2", "--no-ir",
+    ])
+    assert rc == 1  # expert move = error
+    # stage UNCHANGED across a data/fsdp refactorization is the normal
+    # pipelined resume: the reshard-pipelined composition row stays
+    # silent (only a stage MOVE is its territory — matching the
+    # trainer's _check_reshardable judgement)
+    rc = lint_main([
+        "--model", "llama-test", "--mesh", "stage=2,data=4",
+        "--reshard-from", "stage=2,data=2,fsdp=2", "--no-ir",
+    ])
+    assert rc == 0
+    rc = lint_main([
+        "--model", "llama-test", "--mesh", "stage=2,data=4",
+        "--reshard-from", "data=8", "--no-ir",
+    ])
+    assert rc == 1  # stage MOVED (1 → 2): composition row + spec error
+    # the saved topology is a historical fact: an unpinned axis would
+    # resolve against THIS host's device count and lint a factorization
+    # that was never saved — rejected, not guessed
+    rc = lint_main([
+        "--model", "t5-test", "--mesh", "data=8",
+        "--reshard-from", "fsdp=4", "--no-ir",
+    ])
+    assert rc == 1  # data unspecified (-1) in --reshard-from
+
+
+def test_reshard_pipelined_composition_row():
+    from distributed_llms_example_tpu.analysis.composition import (
+        failing_combos,
+        reason_for,
+    )
+
+    assert "stage" in reason_for("reshard-pipelined")
+    rows = failing_combos(
+        family="llama", schedule="gpipe", mesh_axes={"stage": 2, "data": 4},
+        flags=("reshard", "pipelined"),
+    )
+    assert any(r.id == "reshard-pipelined" for r in rows)
+    # without the reshard flag the row stays silent (normal pipelining)
+    rows = failing_combos(
+        family="llama", schedule="gpipe", mesh_axes={"stage": 2, "data": 4},
+        flags=("pipelined",),
+    )
+    assert not any(r.id == "reshard-pipelined" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar + config validation + batching revalidation
+# ---------------------------------------------------------------------------
+
+def test_chaos_grammar_host_loss():
+    s = parse_chaos("host_loss@7,nan_grad@3")
+    assert s.armed_at("host_loss") == [7]
+    with pytest.raises(ValueError, match="host_loss"):
+        parse_chaos("host_loss@")
+
+
+def test_config_host_loss_requires_checkpointing():
+    import argparse
+
+    from distributed_llms_example_tpu.core.config import (
+        add_tpu_args,
+        config_from_args,
+    )
+
+    def cfg_from(*argv):
+        p = argparse.ArgumentParser()
+        add_tpu_args(p)
+        return config_from_args(p.parse_args(list(argv)))
+
+    with pytest.raises(ValueError, match="reshard FROM"):
+        cfg_from("--chaos", "host_loss@3")
+    cfg = cfg_from("--chaos", "host_loss@3", "--save-every-steps", "2")
+    assert cfg.on_host_loss == "reshard"
+    cfg = cfg_from("--chaos", "host_loss@3", "--on-host-loss", "halt")
+    assert cfg.on_host_loss == "halt"  # halt needs no checkpoint cadence
+
+
+def test_validate_batch_mesh():
+    from distributed_llms_example_tpu.data.batching import validate_batch_mesh
+
+    validate_batch_mesh(8, {"data": 4, "fsdp": 2})
+    validate_batch_mesh(8, {"data": 2, "fsdp": 2}, process_count=2,
+                        grad_accum_steps=2)
+    with pytest.raises(ValueError, match="batch shards"):
+        validate_batch_mesh(8, {"data": 4, "fsdp": 4})
+    with pytest.raises(ValueError, match="processes"):
+        validate_batch_mesh(9, {"data": 1}, process_count=2)
+
+
+# ---------------------------------------------------------------------------
+# obs.report topology timeline
+# ---------------------------------------------------------------------------
+
+def _write_jsonl(outdir, events):
+    obs_dir = os.path.join(outdir, "obs")
+    os.makedirs(obs_dir, exist_ok=True)
+    with open(os.path.join(obs_dir, "metrics-p000.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps({"schema_version": 1, **e}) + "\n")
+
+
+_TOPO_EVENTS = [
+    {"event": "topology_change", "step": 3,
+     "old_mesh": {"data": 2, "fsdp": 4}, "old_processes": 2,
+     "policy": "reshard"},
+    {"event": "reshard_restore", "step": 2, "detected_at_step": 3,
+     "old_mesh": {"data": 2, "fsdp": 4}, "old_processes": 2,
+     "new_mesh": {"data": 1, "fsdp": 4}, "new_processes": 1,
+     "ef_mode": "none", "steps_lost": 1, "reshard_wall_s": 0.75},
+]
+
+
+def test_report_topology_timeline_injected(tmp_path):
+    from distributed_llms_example_tpu.obs import report as report_mod
+
+    _write_jsonl(str(tmp_path), [
+        {"event": "chaos_injection", "kind": "host_loss", "step": 3},
+        *_TOPO_EVENTS,
+    ])
+    report = build_report(str(tmp_path))
+    rec = report["recovery"]
+    assert rec["topology"] == [{
+        "step": 3, "policy": "reshard",
+        "old_mesh": {"data": 2, "fsdp": 4}, "old_processes": 2,
+    }]
+    assert len(rec["reshards"]) == 1
+    assert rec["reshards"][0]["new_processes"] == 1
+    # reshard wall-clock counts toward MTTR; its lost steps toward the total
+    assert rec["mttr_s"] == 0.75
+    assert rec["steps_lost_total"] == 1
+    # the injected split: the host_loss firing explains the fault
+    assert [f["kind"] for f in rec["faults"]] == ["topology_change"]
+    assert rec["faults"][0]["injected"] is True
+    assert rec["organic_faults"] == []
+    md = render_markdown(report)
+    assert "topology change" in md and "reshard restore" in md
+    assert report_mod.main([str(tmp_path), "--strict"]) == 0
+
+
+def test_report_topology_organic_fails_strict(tmp_path):
+    from distributed_llms_example_tpu.obs import report as report_mod
+
+    _write_jsonl(str(tmp_path), _TOPO_EVENTS)  # no chaos_injection
+    report = build_report(str(tmp_path))
+    rec = report["recovery"]
+    assert [f["kind"] for f in rec["organic_faults"]] == ["topology_change"]
+    assert report_mod.main([str(tmp_path), "--strict"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# e2e: resharding restore + topology change (slow: trainer compiles)
+# ---------------------------------------------------------------------------
+
+def _records(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        {
+            "dialogue": " ".join(f"w{rng.randint(40)}" for _ in range(12)),
+            "summary": f"w{rng.randint(40)}",
+        }
+        for _ in range(n)
+    ]
+
+
+def _run_cfg(out, mesh, *, resume, epochs=1, **over) -> TrainConfig:
+    kw = dict(
+        model_ckpt="t5-test",
+        output_dir=str(out),
+        batch_size=8,
+        num_epochs=epochs,
+        warmup_steps=1,
+        evaluation_steps=0,
+        max_source_length=32,
+        max_target_length=16,
+        pad_to_multiple=32,
+        log_every_steps=2,
+        num_beams=1,
+        tokenizer="byte",
+        mesh=mesh,
+        checkpoint=CheckpointConfig(save_every_steps=2, resume=resume, async_save=False),
+        obs="jsonl",
+        obs_gauges="off",
+        health="on",
+        recorder_steps=8,
+    )
+    kw.update(over)
+    return TrainConfig(**kw)
+
+
+def _leaves(params):
+    return [np.asarray(x) for x in jax.tree.leaves(jax.device_get(params))]
+
+
+def _events(outdir):
+    path = os.path.join(str(outdir), "obs", "metrics-p000.jsonl")
+    return [json.loads(line) for line in open(path)]
+
+
+@pytest.mark.slow
+def test_reshard_restore_across_factorizations(tmp_path):
+    """Save under data=2×fsdp=4; resume under 4×2, then 8×1 — params
+    BIT-EQUAL after every reshard, ``reshard_restore`` stamped with the
+    old→new factorizations, and a SAME-mesh resume stays on the
+    non-reshard path (no event: zero regressions on PR 6's contract)."""
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    recs = _records()
+    out = tmp_path / "run"
+    t1 = Trainer(_run_cfg(out, MeshConfig(data=2, fsdp=4), resume=False),
+                 train_records=recs)
+    t1.save_final = lambda: None
+    assert t1.train()["steps"] == 2
+    p1 = _leaves(t1.state.params)
+
+    # same-mesh resume first: bit-identical to the pre-reshard behavior,
+    # and NO reshard event
+    t_same = Trainer(_run_cfg(out, MeshConfig(data=2, fsdp=4), resume=True),
+                     train_records=recs)
+    assert t_same.start_step == 2
+    for a, b in zip(p1, _leaves(t_same.state.params)):
+        np.testing.assert_array_equal(a, b)
+    assert not [e for e in _events(out) if e.get("event") == "reshard_restore"]
+
+    # 2×4 → 4×2
+    t2 = Trainer(_run_cfg(out, MeshConfig(data=4, fsdp=2), resume=True),
+                 train_records=recs)
+    assert t2.start_step == 2
+    for a, b in zip(p1, _leaves(t2.state.params)):
+        np.testing.assert_array_equal(a, b)
+    rr = [e for e in _events(out) if e.get("event") == "reshard_restore"]
+    assert len(rr) == 1
+    assert rr[0]["old_mesh"]["data"] == 2 and rr[0]["old_mesh"]["fsdp"] == 4
+    assert rr[0]["new_mesh"]["data"] == 4 and rr[0]["new_mesh"]["fsdp"] == 2
+
+    # 2×4 → 8×1, and TRAIN through the resharded state (epoch 2 runs)
+    t3 = Trainer(_run_cfg(out, MeshConfig(data=8, fsdp=1), resume=True, epochs=2),
+                 train_records=recs)
+    t3.save_final = lambda: None
+    assert t3.start_step == 2
+    r3 = t3.train()
+    assert r3["steps"] == 4
+    losses = [e["loss"] for e in _events(out) if "loss" in e and "step" in e]
+    assert losses and np.isfinite(losses[-1])
+
+
+@pytest.mark.slow
+def test_restore_target_candidates_without_orbax_metadata(tmp_path):
+    """A step whose orbax metadata is unreadable cannot be classified —
+    the target builder must offer the full candidate-structure ladder
+    (modern mesh-leaf payload first, the pre-mesh-leaf and flag-flip
+    shapes, legacy bare state last) instead of one guessed structure,
+    and ``_finish_restore`` must classify by what actually landed.
+    (A restore e2e is unconstructible here: this orbax version stores
+    ALL structure in ``_METADATA``, so a dir without one cannot restore
+    under ANY target — the ladder exists for ancient aggregate-format
+    dirs, whose writer we no longer have.)"""
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    recs = _records()
+    out = tmp_path / "run"
+    t1 = Trainer(_run_cfg(out, MeshConfig(data=2, fsdp=4), resume=False),
+                 train_records=recs)
+    t1.save_final = lambda: None
+    assert t1.train()["steps"] == 2
+
+    t1.checkpointer.payload_metadata = lambda step: None
+    t1._reshard_plan = {}
+    cands = t1._restore_target_for(2)
+    assert isinstance(cands, list) and len(cands) == 6
+    # modern mesh-leaf payload first, then the pre-mesh-leaf shape
+    assert isinstance(cands[0], dict) and "mesh_layout" in cands[0]
+    assert isinstance(cands[1], dict) and "mesh_layout" not in cands[1]
+    # legacy bare states last
+    assert not isinstance(cands[4], dict) and not isinstance(cands[5], dict)
+    plan = t1._reshard_plan[2]
+    assert plan["structure_unknown"] and not plan["legacy"]
+    # a bare TrainState landing is classified as legacy, EF transition
+    # resolved from the restored tree (off run, no EF: mode stays "")
+    state, plan = t1._finish_restore(t1.state, 2)
+    assert plan["legacy"] and state is t1.state
+
+
+@pytest.mark.slow
+def test_reshard_ef_retile_and_zero_fill_directions(tmp_path):
+    """`--grad-compression int8` across a topology change: the EF worker
+    dim follows the replica axes, so the reshard must re-handle it —
+    4→2 workers RE-TILES (merged groups' residuals sum; the telescoping
+    total is preserved, pinned against the saved tree), 4→8 ZERO-FILLS
+    (no regrouping preserves per-worker error), both stamped as
+    ``grad_compression_ef_reshaped``."""
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    recs = _records()
+    out = tmp_path / "run"
+    cfg = _run_cfg(out, MeshConfig(data=4, fsdp=2), resume=False,
+                   grad_compression="int8")
+    t1 = Trainer(cfg, train_records=recs)
+    t1.save_final = lambda: None
+    t1.train()
+    ef_saved = {  # (4, *shape) leaves as saved
+        path: np.asarray(x)
+        for path, x in zip(
+            ("l%d" % i for i in range(10**6)),
+            jax.tree.leaves(jax.device_get(t1.state.ef)),
+        )
+    }
+
+    # same mesh, same workers first (regression: the restore target must
+    # CARRY the EF tree — an ef-less target failed every same-topology
+    # int8 resume on structure mismatch): EF restores bit-equal, no
+    # reshape event
+    t_same = Trainer(
+        _run_cfg(out, MeshConfig(data=4, fsdp=2), resume=True,
+                 grad_compression="int8"),
+        train_records=recs,
+    )
+    assert t_same.start_step == 2
+    for saved, got in zip(
+        ef_saved.values(), jax.tree.leaves(jax.device_get(t_same.state.ef))
+    ):
+        np.testing.assert_array_equal(np.asarray(got), saved)
+    assert not [e for e in _events(out)
+                if e.get("event") == "grad_compression_ef_reshaped"]
+
+    # 4 → 2 workers: re-tile (2 divides 4)
+    t2 = Trainer(
+        _run_cfg(out, MeshConfig(data=2, fsdp=4), resume=True,
+                 grad_compression="int8"),
+        train_records=recs,
+    )
+    assert t2.start_step == 2
+    ev = _events(out)
+    reshaped = [e for e in ev if e.get("event") == "grad_compression_ef_reshaped"]
+    assert len(reshaped) == 1 and reshaped[0]["mode"] == "retile"
+    assert (reshaped[0]["from_workers"], reshaped[0]["to_workers"]) == (4, 2)
+    for saved, got in zip(
+        ef_saved.values(), jax.tree.leaves(jax.device_get(t2.state.ef))
+    ):
+        got = np.asarray(got)
+        assert got.shape[0] == 2
+        # merged groups sum; the telescoping total is preserved (atol:
+        # residual totals near-cancel, where reassociation noise makes a
+        # relative bound meaningless)
+        np.testing.assert_allclose(
+            got, saved.reshape((2, 2) + saved.shape[1:]).sum(axis=1), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            got.sum(axis=0), saved.sum(axis=0), rtol=1e-5, atol=1e-7
+        )
+
+    # 4 → 8 workers: zero-fill (4 % 8 != 0 — no regrouping exists)
+    t3 = Trainer(
+        _run_cfg(out, MeshConfig(data=8, fsdp=1), resume=True,
+                 grad_compression="int8"),
+        train_records=recs,
+    )
+    assert t3.start_step == 2
+    ev = _events(out)
+    zf = [e for e in ev if e.get("event") == "grad_compression_ef_reshaped"
+          and e.get("mode") == "zero_fill"]
+    assert len(zf) == 1 and (zf[0]["from_workers"], zf[0]["to_workers"]) == (4, 8)
+    for got in jax.tree.leaves(jax.device_get(t3.state.ef)):
+        assert np.asarray(got).shape[0] == 8
+        assert not np.asarray(got).any()
+
+    # ...and the flag-flip direction still works ACROSS the reshard:
+    # int8 checkpoint resumed by an OFF run on a different factorization
+    t4 = Trainer(
+        _run_cfg(out, MeshConfig(data=8, fsdp=1), resume=True),
+        train_records=recs,
+    )
+    assert t4.start_step == 2 and t4.state.ef is None
+    dropped = [e for e in _events(out)
+               if e.get("event") == "grad_compression_ef_dropped"]
+    assert dropped
+
+
+@pytest.mark.slow
+def test_reshard_failfast_on_expert_mismatch(tmp_path):
+    """The satellite fix: a checkpoint whose recorded topology names an
+    expert factorization the live mesh cannot map fails FAST with both
+    factorizations in the message — not as an opaque orbax structure
+    error deep in the walk-back."""
+    from distributed_llms_example_tpu.io.checkpoint import ReshardError
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    recs = _records()
+    out = tmp_path / "run"
+    t1 = Trainer(_run_cfg(out, MeshConfig(data=2, fsdp=4), resume=False),
+                 train_records=recs)
+    t1.save_final = lambda: None
+    t1.train()
+    # doctor the recovery sidecar to claim an expert-parallel topology
+    side_path = os.path.join(str(out), "checkpoints", "recovery-2.json")
+    side = json.load(open(side_path))
+    side["mesh_layout"]["axes"]["expert"] = 2
+    side["mesh_layout"]["axes"]["data"] = 1
+    json.dump(side, open(side_path, "w"))
+    with pytest.raises(ReshardError, match="expert") as exc:
+        Trainer(_run_cfg(out, MeshConfig(data=8, fsdp=1), resume=True),
+                train_records=recs)
+    # both factorizations are named in the message
+    assert "expert=2" in str(exc.value)
+    assert "data=8" in str(exc.value)
+
+
+@pytest.mark.slow
+def test_host_loss_topology_change_e2e(tmp_path):
+    """``--chaos host_loss@3`` with the in-process reshard policy: the
+    trainer tears down, rebuilds onto the override mesh (4×2), restores
+    the step-2 checkpoint through the resharding path, resumes from the
+    sidecar cursor, and FINISHES — with the topology timeline strict-
+    green (the one fault is injected) and reshard wall in MTTR."""
+    from distributed_llms_example_tpu.obs import report as report_mod
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    recs = _records()
+    out = tmp_path / "chaos"
+    cfg = _run_cfg(out, MeshConfig(data=2, fsdp=4), resume=False, epochs=3,
+                   chaos="host_loss@3")
+    t = Trainer(cfg, train_records=recs)
+    t.save_final = lambda: None
+    t._next_mesh_override = MeshSpec(data=4, fsdp=2, sequence=1, tensor=1)
+    result = t.train()
+    assert "anomaly" not in result
+    assert result["steps"] == 6  # 3 epochs × 2 steps, one step replayed
+    assert dict(t.mesh.shape)["data"] == 4  # training ENDED on the new mesh
+
+    ev = _events(out)
+    by = {}
+    for e in ev:
+        by.setdefault(e.get("event"), []).append(e)
+    assert [(e["kind"], e["step"]) for e in by["chaos_injection"]] == [
+        ("host_loss", 3)
+    ]
+    tc = by["topology_change"]
+    assert len(tc) == 1 and tc[0]["policy"] == "reshard"
+    assert tc[0]["old_mesh"]["data"] == 2
+    rr = by["reshard_restore"]
+    assert len(rr) == 1
+    assert rr[0]["step"] == 2 and rr[0]["detected_at_step"] == 3
+    assert rr[0]["new_mesh"]["data"] == 4 and rr[0]["steps_lost"] == 1
+    assert rr[0]["reshard_wall_s"] > 0
+    losses = [e["loss"] for e in ev if "loss" in e and "step" in e]
+    assert losses and np.isfinite(losses[-1])
+
+    report = build_report(str(out))
+    rec = report["recovery"]
+    assert len(rec["topology"]) == 1 and len(rec["reshards"]) == 1
+    assert rec["mttr_s"] is not None and rec["mttr_s"] > 0
+    assert rec["organic_faults"] == []
+    assert report_mod.main([str(out), "--strict"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# THE ROADMAP ACCEPTANCE RUN: 2 processes killed down to 1 (slow)
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = "distributed_llms_example_tpu.launch.cli"
+
+# the gloo/coordination-service failure modes this container produces on
+# an otherwise-green run (identical list and rationale as
+# tests/test_multiprocess.py — the rendezvous itself is ~every-other-run
+# flaky here, verified pre-existing): ONLY these retry
+_INFRA_FLAKE_SIGNATURES = (
+    "op.preamble",
+    "Connection closed by peer",
+    "heartbeat timeout",
+    "coordination service",
+    "CoordinationService",
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(n_local_devices: int, *, rank: int | None = None,
+               world: int | None = None, port: int | None = None) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_local_devices}"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for k in ("VH_MASTER_IP", "VH_WORLD_SIZE", "VH_RANK",
+              "MASTER_ADDR", "WORLD_SIZE", "RANK"):
+        env.pop(k, None)
+    if rank is not None:
+        env["VH_MASTER_IP"] = f"127.0.0.1:{port}"
+        env["VH_WORLD_SIZE"] = str(world)
+        env["VH_RANK"] = str(rank)
+    return env
+
+
+def _cli_args(outdir: str, train: str, **over) -> list[str]:
+    opts = {
+        "model-ckpt": "t5-test",
+        "output-dir": outdir,
+        "batch-size": 8,
+        "num-epochs": 2,
+        "train-file": train,
+        # data absorbs the process count: 2 procs × 4 devices → data=2,
+        # 1 proc × 4 devices → data=1 — the reshard under test
+        "mesh": "data=-1,fsdp=4",
+        "compute-dtype": "float32",
+        "log-every-steps": 1,
+        "save-every-steps": 2,
+        "evaluation-steps": 0,
+        "tokenizer": "byte",
+        "max-source-length": 32,
+        "max-target-length": 16,
+        "pad-to-multiple": 32,
+        "num-beams": 1,
+    }
+    opts.update(over)
+    args = [sys.executable, "-m", CLI]
+    for k, v in opts.items():
+        args += [f"--{k}", str(v)]
+    return args
+
+
+def _stdout_events(text: str) -> list[dict]:
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def _final_safetensors(outdir: str) -> dict:
+    from safetensors.numpy import load_file
+
+    return load_file(os.path.join(outdir, "model", "model.safetensors"))
+
+
+@pytest.mark.slow
+def test_two_process_killed_to_one_process_resharding_resume(tmp_path):
+    """The ROADMAP acceptance run: a 2-process CPU run is killed; a
+    1-process run over the same output dir resumes THROUGH the
+    resharding restore (data=2×2procs → data=1×1proc) and matches the
+    clean 1-process run from the same checkpoint — identical loss
+    trajectory, bit-equal final params.  Bounded targeted retry for the
+    container's known gloo rendezvous flake, exactly like
+    tests/test_multiprocess.py."""
+    last: Exception | None = None
+    for attempt in range(4):
+        root = tmp_path / f"attempt{attempt}"
+        root.mkdir()
+        try:
+            _two_to_one_cycle(root)
+            return
+        except (Exception, pytest.fail.Exception) as e:
+            text = str(e)
+            if not any(sig in text for sig in _INFRA_FLAKE_SIGNATURES):
+                raise
+            last = e
+    assert last is not None
+    raise last
+
+
+def _two_to_one_cycle(tmp_path):
+    recs = _records(40)
+    train = str(tmp_path / "train.json")
+    with open(train, "w") as f:
+        json.dump(recs, f)
+    outdir = str(tmp_path / "out")
+    port = _free_port()
+    errs = [open(str(tmp_path / f"err{r}.log"), "w") for r in range(2)]
+
+    # ---- leg A: the 2-process run (data=2, fsdp=4 over 2×4 devices),
+    # killed via SIGTERM on rank 0 after a few steps — the preemption
+    # path checkpoints at the agreed step with the recovery sidecar
+    procs = [
+        subprocess.Popen(
+            _cli_args(outdir, train, **{"num-epochs": 40}),
+            env=_child_env(4, rank=r, world=2, port=port),
+            cwd=REPO, stdout=subprocess.PIPE, stderr=errs[r], text=True,
+        )
+        for r in range(2)
+    ]
+    buf = []
+    deadline = time.time() + 420
+    while time.time() < deadline:
+        line = procs[0].stdout.readline()
+        if not line:
+            break
+        buf.append(line)
+        if '"step": 3' in line:
+            procs[0].send_signal(signal.SIGTERM)
+            break
+    else:
+        pytest.fail("rank 0 never reached step 3")
+    rest0, _ = procs[0].communicate(timeout=420)
+    procs[1].communicate(timeout=420)
+    for f in errs:
+        f.close()
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, open(str(tmp_path / f"err{r}.log")).read()[-3000:]
+    ev0 = _stdout_events("".join(buf) + rest0)
+    pre = [e for e in ev0 if e.get("event") == "preempted"]
+    assert pre, "rank 0 did not checkpoint-and-exit on SIGTERM"
+    stopped_at = pre[0]["step"]
+    ckpt_dir = os.path.join(outdir, "checkpoints")
+    assert os.path.isdir(os.path.join(ckpt_dir, str(stopped_at)))
+    # the recovery sidecar recorded the 2-process topology
+    side = json.load(open(os.path.join(ckpt_dir, f"recovery-{stopped_at}.json")))
+    assert side["mesh_layout"]["processes"] == 2
+    assert side["mesh_layout"]["axes"]["data"] == 2
+
+    # the CLEAN copy: the same checkpoint, untouched by the kill's dir
+    clean_out = outdir + "-clean"
+    shutil.copytree(outdir, clean_out)
+
+    # ---- leg B: killed dir resumed by ONE process on 4 devices —
+    # through the resharding restore (data=2×2p → data=1×1p)
+    def one_proc_resume(d: str) -> tuple[list[dict], dict]:
+        r = subprocess.run(
+            _cli_args(d, train, **{"num-epochs": 2}),
+            env=_child_env(4), cwd=REPO,
+            capture_output=True, text=True, timeout=600,
+        )
+        assert r.returncode == 0, r.stderr[-3000:]
+        return _stdout_events(r.stdout), _final_safetensors(d)
+
+    ev_b, params_b = one_proc_resume(outdir)
+    # ---- leg C: the clean 1-process run from the SAME checkpoint
+    ev_c, params_c = one_proc_resume(clean_out)
+
+    for ev in (ev_b, ev_c):
+        assert any(
+            e.get("event") == "resumed" and e["step"] == stopped_at for e in ev
+        )
+        rr = [e for e in ev if e.get("event") == "reshard_restore"]
+        assert len(rr) == 1
+        assert rr[0]["old_processes"] == 2 and rr[0]["new_processes"] == 1
+        assert rr[0]["old_mesh"]["data"] == 2 and rr[0]["new_mesh"]["data"] == 1
+        assert any(e.get("event") == "done" for e in ev)
+
+    # identical loss trajectory, step for step...
+    losses_b = {e["step"]: e["loss"] for e in ev_b if "loss" in e and "step" in e}
+    losses_c = {e["step"]: e["loss"] for e in ev_c if "loss" in e and "step" in e}
+    assert losses_b and losses_b == losses_c
+    assert min(losses_b) > stopped_at  # the resumes CONTINUED, not restarted
+    # ...and bit-equal final params: the resharding path introduced no
+    # numeric drift over the clean run from the same checkpoint
+    assert set(params_b) == set(params_c)
+    for k in params_b:
+        np.testing.assert_array_equal(params_b[k], params_c[k])
+
+
+@pytest.mark.slow
+def test_host_loss_halt_policy(tmp_path):
+    """``--on-host-loss halt``: the evidence-preserving stop — a
+    resumable checkpoint lands, the run ends with the anomaly marker,
+    and a later resume (on any factorization) reshards its way back."""
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    recs = _records()
+    out = tmp_path / "halt"
+    cfg = _run_cfg(out, MeshConfig(data=2, fsdp=4), resume=False, epochs=3,
+                   chaos="host_loss@3", on_host_loss="halt")
+    t = Trainer(cfg, train_records=recs)
+    t.save_final = lambda: None
+    result = t.train()
+    assert result.get("anomaly") == "checkpoint"
+    ev = _events(out)
+    tc = [e for e in ev if e.get("event") == "topology_change"]
+    assert len(tc) == 1 and tc[0]["policy"] == "halt"
+    # the halted run's checkpoint resumes on a re-factorized mesh
+    t2 = Trainer(_run_cfg(out, MeshConfig(data=8, fsdp=1), resume=True),
+                 train_records=recs)
+    assert t2.start_step == 3

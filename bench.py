@@ -1593,6 +1593,171 @@ def _serve_main() -> None:
     }))
 
 
+def _router_measure(
+    lm, mesh, sharded, *,
+    replicas: int, slots: int, src: int, new_tokens: int, n_req: int,
+) -> dict:
+    """Degraded-mode serving throughput (ISSUE 15): the same workload
+    through the replica router twice — an unfailed ORACLE pass, then a
+    pass with ``replica_crash`` injected at the oracle's halfway tick —
+    stamping p99 TTFT and goodput BEFORE / DURING / AFTER the kill
+    (phases cut at the router's failure / recovered instants), the
+    request-level MTTR and retry counts, and the bit-identity verdict
+    (greedy tokens of the failed run == the unfailed oracle's).  Engines
+    are built once and reused across both passes (compiled programs are
+    per-engine; a router 'crash' discards only session state)."""
+    import numpy as np
+
+    from distributed_llms_example_tpu.obs.chaos import parse_chaos
+    from distributed_llms_example_tpu.serving.engine import (
+        ServeConfig,
+        ServingEngine,
+    )
+    from distributed_llms_example_tpu.serving.router import (
+        ReplicaRouter,
+        RouterConfig,
+    )
+
+    rng = np.random.RandomState(0)
+    vocab_hi = min(lm.config.vocab_size, 30000)
+    requests = [
+        list(rng.randint(4, vocab_hi, rng.randint(max(src // 2, 8), src + 1)))
+        for _ in range(n_req)
+    ]
+    budgets = [
+        int(b)
+        for b in rng.randint(max(new_tokens // 4, 1), new_tokens + 1, n_req)
+    ]
+    engines = [
+        ServingEngine(
+            lm.module, lm.config, mesh,
+            ServeConfig(
+                max_slots=slots, prefill_batch=slots,
+                max_new_tokens=new_tokens, max_source_length=src,
+                log_every_steps=0, request_spans=False,
+            ),
+            is_seq2seq=lm.is_seq2seq,
+        )
+        for _ in range(replicas)
+    ]
+    # oracle pass: unfailed run — the bit-identity reference AND the
+    # compile/warm pass (both routers share the engines' programs)
+    oracle = ReplicaRouter(engines, sharded, RouterConfig(log_every_ticks=0))
+    oracle_outs = oracle.serve(requests, max_new=budgets)
+    kill_tick = max(2, oracle.ticks // 2)
+    for r in oracle.replicas:
+        # only ticks + outputs are needed past this point: drop the
+        # oracle sessions' serving state so the injected pass doesn't
+        # hold 2x replicas worth of KV cache resident
+        r.session = None
+    injected = ReplicaRouter(
+        engines, sharded,
+        RouterConfig(
+            log_every_ticks=0,
+            chaos=parse_chaos(f"replica_crash@{kill_tick}"),
+        ),
+    )
+    t0 = time.perf_counter()
+    outs = injected.serve(requests, max_new=budgets)
+    wall = time.perf_counter() - t0
+    summary = injected.last_stats or {}
+    rows = [r for r in injected.request_rows() if not r["synthetic"]]
+    t_fail = summary.get("t_fail_s")
+    t_rec = summary.get("t_recovered_s", t_fail)
+
+    def phase_stats(lo: float, hi: float) -> dict:
+        from distributed_llms_example_tpu.obs.spans import percentiles
+
+        done = [
+            r for r in rows
+            if r["done_s"] is not None and lo <= r["done_s"] < hi
+        ]
+        ttfts = [r["ttft_s"] for r in done if r["ttft_s"] is not None]
+        dur = max(hi - lo, 1e-9)
+        (p99,) = percentiles(ttfts, (0.99,))
+        return {
+            "requests": len(done),
+            "ttft_p99_ms": round(p99 * 1e3, 1) if ttfts else None,
+            "goodput_tokens_per_sec": round(
+                sum(r["tokens"] for r in done) / dur, 1
+            ),
+        }
+
+    out: dict = {
+        "replicas": replicas,
+        "kill_tick": kill_tick,
+        "retries": summary.get("retries"),
+        "request_retry_rate": summary.get("request_retry_rate"),
+        "request_mttr_s": summary.get("request_mttr_s"),
+        "goodput_frac": summary.get("goodput_frac"),
+        "completed": summary.get("completed"),
+        "shed": summary.get("shed"),
+        # the acceptance verdict: a mid-decode replica kill loses nothing
+        # and changes no tokens (greedy re-prefill == unfailed oracle)
+        "tokens_identical": outs == oracle_outs,
+        "requests_lost": sum(
+            1 for r in rows if r["done_s"] is None and not r["shed"]
+        ),
+        "wall_s": round(wall, 3),
+    }
+    if t_fail is not None:
+        out["degraded"] = {
+            "t_fail_s": t_fail,
+            "t_recovered_s": t_rec,
+            "before": phase_stats(0.0, t_fail),
+            "during": phase_stats(t_fail, t_rec if t_rec > t_fail else t_fail),
+            "after": phase_stats(t_rec, wall + 1e-9),
+        }
+    return out
+
+
+def _router_main() -> None:
+    """BENCH_MODE=serve-router: the standalone degraded-mode serving
+    record — replica router over the flagship model, p99 TTFT + goodput
+    before/during/after an injected replica kill."""
+    import jax
+
+    from distributed_llms_example_tpu.core.config import MeshConfig, parse_mesh_arg
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.parallel.sharding import shard_params
+
+    name, lm, _ = _flagship()
+    n_chips = jax.device_count()
+    mesh_spec = os.environ.get("BENCH_SERVE_MESH", "")
+    mesh = build_mesh(parse_mesh_arg(mesh_spec) if mesh_spec else MeshConfig(data=-1))
+    batch_shards = 1
+    for a in ("data", "fsdp", "expert"):
+        batch_shards *= mesh.shape.get(a, 1)
+    src = int(os.environ.get("BENCH_ROUTER_SRC", "256"))
+    new_tokens = int(os.environ.get("BENCH_ROUTER_NEW", "32"))
+    slots = int(os.environ.get("BENCH_ROUTER_SLOTS_PER_SHARD", "2")) * batch_shards
+    replicas = int(os.environ.get("BENCH_ROUTER_REPLICAS", "2"))
+    n_req = int(os.environ.get("BENCH_ROUTER_REQUESTS", str(4 * replicas * slots)))
+    params = lm.params if lm.params is not None else jax.device_get(lm.init_params(0))
+    sharded = shard_params(params, mesh)
+    record = _router_measure(
+        lm, mesh, sharded,
+        replicas=replicas, slots=slots, src=src, new_tokens=new_tokens,
+        n_req=n_req,
+    )
+    print(json.dumps({
+        "grad_compression": "off",
+        "metric": f"{name} serve-router degraded-mode serving "
+                  f"({replicas} replicas x {slots} slots, src {src} / "
+                  f"max_new {new_tokens}, {n_req} requests, one replica "
+                  "killed mid-decode) — serving/router.py on mesh "
+                  f"{mesh_spec or 'data=-1'}; no reference number exists",
+        "value": (record.get("degraded") or {}).get("after", {}).get(
+            "goodput_tokens_per_sec"
+        ),
+        "unit": "goodput tokens/sec after recovery",
+        "vs_baseline": None,
+        **record,
+        "chips": n_chips,
+        "backend": jax.default_backend(),
+    }))
+
+
 def main() -> None:
     # Child-side wall-clock budget: the add-on measurements (grad-accum,
     # dropout, rbg-dropout, trainer loop, trainer-rbg) each compile their
@@ -2339,6 +2504,8 @@ if __name__ == "__main__":
             _generate_main()
         elif os.environ.get("BENCH_MODE", "") == "serve":
             _serve_main()
+        elif os.environ.get("BENCH_MODE", "") == "serve-router":
+            _router_main()
         elif os.environ.get("BENCH_MODE", "") == "host-input":
             _host_input_main()
         else:

@@ -31,7 +31,8 @@ the flag because it resolves to xla wherever fused cannot run),
 collectives of ops/quant_collectives.py),
 ``decode`` (the KV-cache serving workload: prefill/decode split +
 continuous batching — serving/engine.py and the Evaluator's split
-path).
+path), ``router`` (the serve-router replica tier above the engines —
+serving/router.py; implies ``decode`` per replica).
 """
 
 from __future__ import annotations
@@ -157,6 +158,18 @@ KNOWN_BAD: tuple[BadCombo, ...] = (
             "(stage-sharded leading layer dim, schedule-dependent storage "
             "order) is unproven under it — use --optim-impl auto (which "
             "resolves to the optax chain under a pipeline) or xla"
+        ),
+    ),
+    BadCombo(
+        id="router-pipelined",
+        flags=("router",),
+        axes_over_1=("stage",),
+        reason=(
+            "the serve-router replica pool stands on KV-cache decode "
+            "engines, which stage>1 pipelines cannot run "
+            "(decode-pipelined): replicas shard the REQUEST stream, not "
+            "the model schedule — unstack pipelined params onto an "
+            "fsdp/tensor mesh before serving, then replicate"
         ),
     ),
     BadCombo(
@@ -287,6 +300,16 @@ KNOWN_GOOD: tuple[GoodCombo, ...] = (
               "data×fsdp×expert and heads over tensor (CACHE_RULES); "
               "pinned by the continuous-batching determinism test on the "
               "8-device mesh",
+    ),
+    GoodCombo(
+        id="router-gspmd",
+        flags=("decode", "router"),
+        axes=("data", "fsdp", "tensor", "expert"),
+        notes="serve-router replica pool over N engines sharing one GSPMD "
+              "mesh: session-affinity + queue-depth dispatch, "
+              "crash/stall re-prefill pinned bit-identical to the "
+              "single-engine oracle, graceful drain loses zero requests "
+              "(tests/test_router.py)",
     ),
     GoodCombo(
         id="fused-optim-gspmd",

@@ -224,6 +224,12 @@ class TrainConfig:
     # heartbeat cadence in steps (0 = off).  Multi-host: every process
     # probes at the same global step, process 0 reports skew/laggards
     obs_heartbeat_steps: int = 0
+    # persistent-laggard classification (obs/health.py LaggardStreaks):
+    # a rank named laggard this many CONSECUTIVE heartbeats becomes a
+    # pod-agreed host_loss_suspect event — organic host-loss DETECTION
+    # only (report row; the --on-host-loss policy is unchanged).  0 =
+    # classification off, same convention as the heartbeat cadence
+    obs_heartbeat_suspect_beats: int = 3
     # step-time budget accounting (obs/budget.py): each logging window's
     # wall time decomposed into data_wait / dispatch / device_busy /
     # sync_block / host_overhead (additive — the unattributed remainder
@@ -456,6 +462,13 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
              "collective-traffic account (auto = only under --obs jsonl)",
     )
     p.add_argument("--obs-heartbeat-steps", type=int, default=_D.obs_heartbeat_steps)
+    p.add_argument(
+        "--obs-heartbeat-suspect-beats", type=int,
+        default=_D.obs_heartbeat_suspect_beats,
+        help="consecutive heartbeats a rank must be named laggard before "
+             "the pod-agreed host_loss_suspect event fires (detection + "
+             "report row only; --on-host-loss policy unchanged; 0 = off)",
+    )
     p.add_argument(
         "--obs-budget", type=str, default=_D.obs_budget,
         choices=("auto", "on", "off"),

@@ -56,7 +56,7 @@ def load_json_records(
     a counter surfaced as a ``data_skipped_records`` event instead of
     killing the epoch — one corrupt line in a million-record corpus is a
     data bug to report, not a reason to lose the pod reservation."""
-    import time
+    from distributed_llms_example_tpu.utils.backoff import sleep_backoff
 
     delay = float(backoff_s)
     for attempt in range(max(0, retries) + 1):
@@ -77,8 +77,7 @@ def load_json_records(
                 "backoff_s": round(delay, 3),
                 "error": str(e)[:200],
             })
-            time.sleep(delay)
-            delay = min(delay * 2, 2.0)
+            delay = sleep_backoff(delay, cap_s=2.0)
     raise AssertionError("unreachable")
 
 

@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 import zlib
 from typing import Any, Callable
 
@@ -46,6 +45,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from distributed_llms_example_tpu.core.config import AXES
+from distributed_llms_example_tpu.utils.backoff import sleep_backoff
 from distributed_llms_example_tpu.utils.jsonlog import log_json
 
 # sidecars live next to the step dirs, never inside them: orbax owns the
@@ -266,8 +266,7 @@ class Checkpointer:
                     "backoff_s": round(delay, 3),
                     "error": str(e)[:200],
                 })
-                time.sleep(delay)
-                delay = min(delay * 2, 8.0)
+                delay = sleep_backoff(delay, cap_s=8.0)
         return False  # unreachable
 
     def _finalize_manifests(self) -> None:

@@ -192,9 +192,10 @@ def _prompt_text(record, source_column: str) -> str:
     )
 
 
-def serve_main(argv: list[str] | None = None) -> int:
-    """The ``serve`` subcommand: load → shard → continuous-batching decode."""
-    args = build_serve_parser().parse_args(argv)
+def _serve_setup(args, *, extra_flags: tuple = ()):
+    """The shared serve/serve-router prologue: prompts → model → mesh →
+    startup lint → tokenizer → sharded params → encoded requests.
+    Returns (lm, mesh, tok, params, prompts, requests)."""
     import jax
 
     from distributed_llms_example_tpu.core.config import parse_mesh_arg
@@ -203,17 +204,12 @@ def serve_main(argv: list[str] | None = None) -> int:
     from distributed_llms_example_tpu.data.tokenizer import get_tokenizer
     from distributed_llms_example_tpu.models.registry import load_model
     from distributed_llms_example_tpu.parallel.sharding import shard_params
-    from distributed_llms_example_tpu.serving.engine import (
-        ServeConfig,
-        ServingEngine,
-        trim_eos,
-    )
-    from distributed_llms_example_tpu.utils.jsonlog import log_json
 
     if jax.process_count() > 1:
         raise SystemExit(
             "the serving engine is single-controller; run one process "
-            "(multi-host serving is a router above it, not a collective)"
+            "(the serve-router replica pool is in-process — multi-host "
+            "serving is a network tier above it, not a collective)"
         )
     records = load_json_records(args.prompts_file)
     if args.num_prompts > 0:
@@ -279,7 +275,8 @@ def serve_main(argv: list[str] | None = None) -> int:
             )
         findings += check_composition(
             family=lm.family, mesh_axes=dict(mesh.shape),
-            flags=("decode", "seq2seq" if lm.is_seq2seq else "causal"),
+            flags=("decode", "seq2seq" if lm.is_seq2seq else "causal")
+            + tuple(extra_flags),
         )
         emit_findings(findings, as_json=True)
         if args.lint == "strict" and has_errors(findings):
@@ -292,42 +289,163 @@ def serve_main(argv: list[str] | None = None) -> int:
     params = shard_params(params, mesh)
     encode = tok.encode_source if lm.is_seq2seq else tok.encode_prompt
     requests = [encode(t, args.max_source_length) for t in prompts]
-    engine = ServingEngine(
-        lm.module, lm.config, mesh,
-        ServeConfig(
-            max_slots=args.max_slots,
-            prefill_batch=args.prefill_batch,
-            max_new_tokens=args.max_new_tokens,
-            max_source_length=args.max_source_length,
-            log_every_steps=args.log_every_steps,
-            ttft_slo_ms=args.ttft_slo_ms,
-            kv_cache_dtype=args.kv_cache_dtype,
-            prefill_buckets=tuple(
-                int(b) for b in args.prefill_buckets.split(",") if b.strip()
-            ),
-            paged_kv=args.paged_kv,
-            pool_blocks=args.pool_blocks,
-            kv_block_size=args.kv_block_size,
+    return lm, mesh, tok, params, prompts, requests
+
+
+def _serve_config_from_args(args):
+    from distributed_llms_example_tpu.serving.engine import ServeConfig
+
+    return ServeConfig(
+        max_slots=args.max_slots,
+        prefill_batch=args.prefill_batch,
+        max_new_tokens=args.max_new_tokens,
+        max_source_length=args.max_source_length,
+        log_every_steps=args.log_every_steps,
+        ttft_slo_ms=args.ttft_slo_ms,
+        kv_cache_dtype=args.kv_cache_dtype,
+        prefill_buckets=tuple(
+            int(b) for b in args.prefill_buckets.split(",") if b.strip()
         ),
+        paged_kv=args.paged_kv,
+        pool_blocks=args.pool_blocks,
+        kv_block_size=args.kv_block_size,
+    )
+
+
+def _write_serve_output(args, lm, tok, prompts, outputs, *, extra=None):
+    """Request OUTPUTS (the served product), not telemetry: a plain
+    JSONL document through the crash-safe product writer (obs/sink.py
+    ``ProductJsonlWriter``: one os-level write per line + fsync on
+    close), so a killed serve run leaves no torn output lines — the
+    metric/obs channel stays log_json's."""
+    from distributed_llms_example_tpu.obs.sink import ProductJsonlWriter
+    from distributed_llms_example_tpu.serving.engine import trim_eos
+    from distributed_llms_example_tpu.utils.jsonlog import log_json
+
+    eos, pad = lm.config.eos_token_id, lm.config.pad_token_id
+    lines = []
+    for i, (prompt, ids) in enumerate(zip(prompts, outputs)):
+        kept = [t for t in trim_eos(ids, eos, pad) if t != eos]
+        rec = {"prompt": prompt, "output": tok.decode(kept), "tokens": len(kept)}
+        if extra is not None:
+            rec.update(extra[i])
+        lines.append(rec)
+    if not args.output_file:
+        for rec in lines:
+            sys.stdout.write(json.dumps(rec) + "\n")
+        return
+    writer = ProductJsonlWriter(args.output_file)
+    try:
+        for rec in lines:
+            writer.write(rec)
+    finally:
+        writer.close()
+    log_json({
+        "event": "serve_output",
+        "path": args.output_file,
+        "records": len(lines),
+    })
+
+
+def serve_main(argv: list[str] | None = None) -> int:
+    """The ``serve`` subcommand: load → shard → continuous-batching decode."""
+    args = build_serve_parser().parse_args(argv)
+    from distributed_llms_example_tpu.serving.engine import ServingEngine
+
+    lm, mesh, tok, params, prompts, requests = _serve_setup(args)
+    engine = ServingEngine(
+        lm.module, lm.config, mesh, _serve_config_from_args(args),
         is_seq2seq=lm.is_seq2seq,
     )
     outputs = engine.generate(params, requests)
-    eos, pad = lm.config.eos_token_id, lm.config.pad_token_id
-    lines = []
-    for prompt, ids in zip(prompts, outputs):
-        kept = [t for t in trim_eos(ids, eos, pad) if t != eos]
-        lines.append({"prompt": prompt, "output": tok.decode(kept), "tokens": len(kept)})
-    # request OUTPUTS (the served product), not telemetry: they go to the
-    # chosen sink as a plain JSONL document — the metric/obs channel is
-    # log_json's, which already carried serve_window/serve_summary above
-    out = open(args.output_file, "w") if args.output_file else sys.stdout
-    try:
-        for rec in lines:
-            out.write(json.dumps(rec) + "\n")
-    finally:
-        if out is not sys.stdout:
-            out.close()
-            log_json({"event": "serve_output", "path": args.output_file, "records": len(lines)})
+    _write_serve_output(args, lm, tok, prompts, outputs)
+    return 0
+
+
+def build_router_parser() -> argparse.ArgumentParser:
+    """``serve-router`` = every serve flag + the router tier's knobs."""
+    p = build_serve_parser()
+    p.prog = "dllm-train serve-router"
+    p.description = (
+        "fault-tolerant serving tier (serving/router.py): N in-process "
+        "engine replicas behind a router with session affinity, "
+        "queue-depth dispatch, bounded retry/re-prefill on replica "
+        "failure, admission control, graceful drain, and the serving "
+        "chaos kinds (replica_crash/replica_stall/request_storm)"
+    )
+    p.add_argument("--replicas", type=int, default=2,
+                   help="engine replicas in the pool (each owns its own "
+                        "compiled programs and slot state)")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="re-dispatch budget per request after replica "
+                        "failures; exceeding it sheds the request")
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="per-request wall deadline while waiting for "
+                        "dispatch (0 = none); expired requests shed with "
+                        "reason 'deadline'")
+    p.add_argument("--router-max-queue", type=int, default=0,
+                   help="router queue bound (0 = unbounded); submissions "
+                        "over it shed or defer per --shed-policy")
+    p.add_argument("--shed-policy", type=str, default="defer",
+                   choices=("defer", "shed"),
+                   help="what happens to a submission over the queue "
+                        "bound: defer parks it client-side, shed rejects")
+    p.add_argument("--suspect-after-ticks", type=int, default=3,
+                   help="missed heartbeats (router ticks without replica "
+                        "progress) before live -> suspect")
+    p.add_argument("--dead-after-ticks", type=int, default=6,
+                   help="missed heartbeats before suspect -> dead "
+                        "(in-flight requests re-prefill elsewhere)")
+    p.add_argument("--chaos", type=str, default="",
+                   help="serving chaos grammar (obs/chaos.py): "
+                        "replica_crash@K,replica_stall@K,request_storm@K "
+                        "with K a router scheduler tick")
+    return p
+
+
+def serve_router_main(argv: list[str] | None = None) -> int:
+    """The ``serve-router`` subcommand: load once, shard once, N engine
+    replicas over the one mesh, route to completion."""
+    args = build_router_parser().parse_args(argv)
+    from distributed_llms_example_tpu.obs.chaos import parse_chaos
+    from distributed_llms_example_tpu.serving.engine import ServingEngine
+    from distributed_llms_example_tpu.serving.router import (
+        ReplicaRouter,
+        RouterConfig,
+    )
+
+    if args.replicas < 1:
+        raise SystemExit("--replicas must be >= 1")
+    lm, mesh, tok, params, prompts, requests = _serve_setup(
+        args, extra_flags=("router",)
+    )
+    serve_cfg = _serve_config_from_args(args)
+    engines = [
+        ServingEngine(
+            lm.module, lm.config, mesh, serve_cfg, is_seq2seq=lm.is_seq2seq
+        )
+        for _ in range(args.replicas)
+    ]
+    router = ReplicaRouter(
+        engines, params,
+        RouterConfig(
+            max_retries=args.max_retries,
+            deadline_s=args.deadline_ms / 1e3,
+            max_queue=args.router_max_queue,
+            shed_policy=args.shed_policy,
+            suspect_after_ticks=args.suspect_after_ticks,
+            dead_after_ticks=args.dead_after_ticks,
+            log_every_ticks=args.log_every_steps,
+            chaos=parse_chaos(args.chaos) if args.chaos else None,
+        ),
+    )
+    outputs = router.serve(requests)
+    extra = [
+        {"shed": q.shed_reason} if q.shed else {}
+        for q in router.requests
+        if not q.synthetic
+    ]
+    _write_serve_output(args, lm, tok, prompts, outputs, extra=extra)
     return 0
 
 
@@ -336,6 +454,8 @@ def main(argv: list[str] | None = None) -> int:
         argv = sys.argv[1:]
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "serve-router":
+        return serve_router_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.source_column:

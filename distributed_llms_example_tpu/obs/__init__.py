@@ -111,7 +111,14 @@ class TrainerObs:
             getattr(cfg, "obs_peak_tflops", 197.0)
         ) * 1e12
         hb_every = int(getattr(cfg, "obs_heartbeat_steps", 0) or 0)
-        self.heartbeat = Heartbeat(every_steps=hb_every) if (
+        self.heartbeat = Heartbeat(
+            every_steps=hb_every,
+            # 0 = classification off (the knob's own convention); only a
+            # MISSING config field falls back to the default of 3
+            suspect_beats=int(
+                getattr(cfg, "obs_heartbeat_suspect_beats", 3)
+            ),
+        ) if (
             self.enabled and hb_every > 0
         ) else None
         # training-health layer: the watchdog consumes the in-graph

@@ -33,6 +33,24 @@ the save cadence maps to steps).  Kinds:
                       agreed over the same heartbeat-cadence allgather,
                       so every rank takes the topology branch together
 
+Serving kinds (the router tier, serving/router.py — ticks are **router
+scheduler ticks**, the serving counterpart of optimizer steps; they fire
+only under ``serve-router``, a training run never consults them):
+
+- ``replica_crash@K`` kill the busiest engine replica at router tick K
+                      (most active decode slots, ties to the lowest
+                      replica id — deterministic): its step raises, the
+                      router marks it dead and RE-PREFILLS every request
+                      it held on a surviving replica
+- ``replica_stall@K`` wedge the busiest replica at tick K: it stops
+                      making progress without raising, so the router's
+                      heartbeat-miss / step-stall detector (live →
+                      suspect → dead) must catch it
+- ``request_storm@K`` inject a burst of synthetic requests at tick K —
+                      exercises admission control (shed/defer under
+                      pool pressure) without letting the storm starve
+                      real traffic
+
 Every injection is **one-shot** (armed → fired): a rewind replaying the
 same steps does not re-inject, so a recovered run stays recovered.  Each
 firing is logged as a schema-stamped ``chaos_injection`` obs event, which
@@ -46,13 +64,21 @@ import dataclasses
 import os
 from typing import Iterable
 
-KINDS = ("nan_grad", "ckpt_corrupt", "data_error", "sigterm", "host_loss")
+KINDS = (
+    "nan_grad", "ckpt_corrupt", "data_error", "sigterm", "host_loss",
+    "replica_crash", "replica_stall", "request_storm",
+)
+# the serving subset: ticks are router scheduler ticks, consumed only by
+# serving/router.py (a training run leaves them armed and unfired)
+SERVING_KINDS = ("replica_crash", "replica_stall", "request_storm")
 
 GRAMMAR_HELP = (
     "expected a comma list of kind@tick with kind in "
     f"{'/'.join(KINDS)} and tick a positive integer "
-    "(global step; for ckpt_corrupt the Nth checkpoint save), "
-    "e.g. 'nan_grad@120,ckpt_corrupt@2,sigterm@240'"
+    "(global step; for ckpt_corrupt the Nth checkpoint save; for the "
+    "replica_*/request_storm serving kinds a router scheduler tick), "
+    "e.g. 'nan_grad@120,ckpt_corrupt@2,sigterm@240' or "
+    "'replica_crash@40,request_storm@10'"
 )
 
 

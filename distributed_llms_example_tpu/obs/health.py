@@ -199,6 +199,51 @@ class HealthWatchdog:
         return out
 
 
+class LaggardStreaks:
+    """Persistent heartbeat-laggard classification — the first slice of
+    ORGANIC host-loss detection (ISSUE 15 satellite; the ROADMAP's PR 14
+    caveat).  A rank named laggard in one heartbeat is a wobble; a rank
+    named laggard in ``suspect_beats`` CONSECUTIVE heartbeats is a
+    ``host_loss_suspect`` — the operator's "go look at host N before the
+    next collective hangs" signal.
+
+    Pod-agreed by construction: every rank feeds this the SAME gathered
+    probe (the heartbeat allgather is a barrier returning identical data
+    everywhere), so every rank computes the same streaks and the same
+    suspects — no second collective.  Detection + report row ONLY: the
+    ``--on-host-loss`` policy still fires on the agreed signal path
+    (chaos, scheduler restart), never on this classifier.
+    """
+
+    def __init__(self, *, suspect_beats: int = 3):
+        self.suspect_beats = max(1, int(suspect_beats))
+        self.streaks: dict[int, int] = {}
+        self._suspected: set[int] = set()
+
+    def update(self, laggards: Sequence[int], step: int) -> list[dict]:
+        """Fold one heartbeat's laggard set; returns the NEW suspects
+        crossing the streak threshold this beat (each as an event-ready
+        record).  A rank that recovers (one clean beat) resets its
+        streak and re-arms — a later persistent lag re-fires."""
+        lag = {int(r) for r in laggards}
+        out: list[dict] = []
+        for r in list(self.streaks):
+            if r not in lag:
+                self.streaks.pop(r)
+                self._suspected.discard(r)
+        for r in sorted(lag):
+            self.streaks[r] = self.streaks.get(r, 0) + 1
+            if self.streaks[r] >= self.suspect_beats and r not in self._suspected:
+                self._suspected.add(r)
+                out.append({
+                    "event": "host_loss_suspect",
+                    "rank": r,
+                    "step": int(step),
+                    "consecutive_beats": self.streaks[r],
+                })
+        return out
+
+
 def agree_and_emit(
     anomalies: Sequence[Anomaly],
     *,

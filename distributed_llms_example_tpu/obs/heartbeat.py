@@ -91,14 +91,33 @@ class Heartbeat:
         every_steps: int,
         *,
         laggard_threshold_s: float = DEFAULT_LAGGARD_THRESHOLD_S,
+        suspect_beats: int = 3,
     ):
+        from distributed_llms_example_tpu.obs.health import LaggardStreaks
+
         self.every = max(1, int(every_steps))
         self.laggard_threshold_s = float(laggard_threshold_s)
+        # persistent-laggard classification (obs/health.py): a rank
+        # named laggard ``suspect_beats`` heartbeats in a row becomes a
+        # pod-agreed host_loss_suspect event — organic host-loss
+        # DETECTION, report row only (--on-host-loss policy unchanged).
+        # 0 = off, the same convention as the heartbeat cadence itself.
+        self.streaks = (
+            LaggardStreaks(suspect_beats=suspect_beats)
+            if int(suspect_beats) > 0
+            else None
+        )
 
     def beat(self, step: int) -> dict | None:
         """Contribute this process's probe and, on process 0, emit the
         heartbeat record.  MUST be called by every process at the same
-        global step.  Returns the record on process 0 (None elsewhere)."""
+        global step.  Returns the record on process 0 (None elsewhere).
+
+        Every rank folds the SAME gathered probe into the laggard-streak
+        classifier (the gather is a barrier returning identical data
+        everywhere — agreement without a second collective), so a
+        persistent laggard becomes a pod-agreed ``host_loss_suspect``
+        event in every rank's local stream."""
         import jax
 
         t = time.time()
@@ -106,17 +125,24 @@ class Heartbeat:
             [int(step), int(t), int((t % 1.0) * 1e6)], dtype=np.int32
         )
         gathered = gather_probe(local)
-        if jax.process_index() != 0:
-            return None
         steps = gathered[:, 0]
         arrivals = gathered[:, 1].astype(np.float64) + gathered[:, 2] / 1e6
+        analysis = detect_laggards(
+            steps, arrivals, laggard_threshold_s=self.laggard_threshold_s
+        )
+        if self.streaks is not None:
+            for suspect in self.streaks.update(analysis["laggards"], step):
+                # local: each rank's file carries the agreed verdict (the
+                # suspect's own file may be the last thing it ever
+                # writes); stdout stays process-0-only via the sink gate
+                sink_mod.emit(suspect, local=True)
+        if jax.process_index() != 0:
+            return None
         record = {
             "event": "heartbeat",
             "step": int(step),
             "process_count": int(gathered.shape[0]),
-            **detect_laggards(
-                steps, arrivals, laggard_threshold_s=self.laggard_threshold_s
-            ),
+            **analysis,
         }
         sink_mod.emit(record)
         return record

@@ -418,6 +418,11 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
     anomalies: list[dict] = []
     topo_changes: list[dict] = []
     reshards: list[dict] = []
+    replica_events: list[dict] = []
+    serve_retries: list[dict] = []
+    serve_sheds: list[dict] = []
+    router_summaries: list[dict] = []
+    suspects: list[dict] = []
     # injections/recoveries/quarantines are ``local`` events (every
     # rank's file carries its own copy — the schedule and the escalation
     # are deterministic across the pod): dedup to per-run rows
@@ -453,6 +458,17 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
             # ranks' local copies; wall clock differs per rank, so it
             # must stay OUT of the key
             dedup(reshards, r, "step", "detected_at_step", "new_processes")
+        for r in ev.get("replica_health", []):
+            # local events: every rank's file may carry a copy (single-
+            # process today, per-host tomorrow) — one row per transition
+            dedup(replica_events, r, "replica", "from", "to", "tick")
+        for r in ev.get("serve_retry", []):
+            dedup(serve_retries, r, "request", "retries", "tick", "reason")
+        for r in ev.get("serve_shed", []):
+            dedup(serve_sheds, r, "request", "tick")
+        for r in ev.get("host_loss_suspect", []):
+            dedup(suspects, r, "rank", "step")
+        router_summaries.extend(ev.get("router_summary", []))
         for kind in ("ckpt_verify_failed", "ckpt_restore_failed"):
             verify_failures.extend(ev.get(kind, []))
         for kind in ("data_retry", "data_skipped_records"):
@@ -509,6 +525,30 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
             f"policy {t.get('policy')}: "
             f"{t.get('old_mesh')} → {t.get('reason', 'reshard')}"[:120],
         ))
+    # serving tier (ISSUE 15): a replica DYING is a fault even when every
+    # request re-prefilled cleanly — the crash kind matches the injection
+    # at its tick exactly; a stall's death tick trails its injection (the
+    # heartbeat-miss detector needs dead_after ticks), so the match
+    # window is [since_tick, tick] (since_tick = the replica's last
+    # progress, stamped on the transition event)
+    for r in replica_events:
+        if r.get("to") != "dead":
+            continue
+        cause = r.get("cause", "crash")
+        tick = r.get("tick")
+        if cause == "stall":
+            lo = r.get("since_tick", tick)
+            injected = any(
+                s is not None and lo is not None and tick is not None
+                and lo <= s <= tick
+                for s in injected_at.get("replica_stall", set())
+            )
+        else:
+            injected = tick in injected_at.get("replica_crash", set())
+        faults.append(fault_row(
+            f"replica_{cause}", tick, injected,
+            f"replica {r.get('replica')}: {str(r.get('reason', ''))}"[:120],
+        ))
     organic = [f for f in faults if not f["injected"]]
     rewinds = [r for r in recoveries if r.get("action") == "rewind"]
     # reshard wall-clock counts toward MTTR: a topology recovery is a
@@ -522,6 +562,43 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
         for r in reshards
         if isinstance(r.get("reshard_wall_s"), (int, float))
     ]
+    serving = None
+    if replica_events or serve_retries or serve_sheds or router_summaries:
+        rs = router_summaries[-1] if router_summaries else {}
+        serving = {
+            "replica_transitions": [
+                {
+                    k: r.get(k)
+                    for k in ("replica", "from", "to", "tick", "reason", "cause")
+                    if k in r
+                }
+                for r in replica_events
+            ],
+            "replicas_lost": sum(
+                1 for r in replica_events if r.get("to") == "dead"
+            ),
+            # failure retries of REAL traffic only: a drain re-dispatch
+            # lost no work (the router doesn't count it either), and a
+            # synthetic storm request's retries are injected load — both
+            # would overstate failures next to the summary's rate
+            "retries": sum(
+                1 for r in serve_retries
+                if r.get("reason") != "drain" and not r.get("synthetic")
+            ),
+            "redispatches": len(serve_retries),
+            "shed": sum(
+                1 for r in serve_sheds if not r.get("synthetic")
+            ),
+            "shed_total": len(serve_sheds),  # synthetic storm included
+            "shed_by_reason": rs.get("shed_by_reason"),
+            # the request-level recovery numbers the acceptance pins:
+            # finite MTTR for re-prefilled requests + the gate inputs
+            "request_mttr_s": rs.get("request_mttr_s"),
+            "request_retry_rate": rs.get("request_retry_rate"),
+            "goodput_frac": rs.get("goodput_frac"),
+            "requests": rs.get("requests"),
+            "completed": rs.get("completed"),
+        }
     return {
         "injections": [
             {"kind": i.get("kind"), "step": i.get("step")} for i in injections
@@ -570,6 +647,15 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
         "mttr_s": (
             round(sum(mttr_vals) / len(mttr_vals), 4) if mttr_vals else None
         ),
+        "serving": serving,
+        "host_loss_suspects": [
+            {
+                k: s.get(k)
+                for k in ("rank", "step", "consecutive_beats")
+                if k in s
+            }
+            for s in suspects
+        ],
         "faults": faults,
         "organic_faults": organic,
     }
@@ -890,6 +976,31 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
             f"- {rec['rewinds']} rewind(s), {rec['steps_lost_total']} optimizer "
             f"steps lost, MTTR {_fmt(rec.get('mttr_s'))} s"
         )
+    serving = rec.get("serving")
+    if serving:
+        for t in serving.get("replica_transitions", []):
+            add(
+                f"- **replica {t.get('replica')}** {t.get('from')} → "
+                f"{t.get('to')} at tick {t.get('tick')}"
+                + (f" [{t['cause']}]" if t.get("cause") else "")
+                + f": {t.get('reason', '')}"
+            )
+        add(
+            f"- serving tier: {serving.get('replicas_lost', 0)} replica(s) "
+            f"lost, {serving.get('retries', 0)} request retr"
+            f"{'y' if serving.get('retries', 0) == 1 else 'ies'}, "
+            f"{serving.get('shed', 0)} shed "
+            f"({serving.get('shed_by_reason') or {}}), request MTTR "
+            f"{_fmt(serving.get('request_mttr_s'))} s, retry rate "
+            f"{_fmt(serving.get('request_retry_rate'))}, goodput frac "
+            f"{_fmt(serving.get('goodput_frac'))}"
+        )
+    for s in rec.get("host_loss_suspects", []):
+        add(
+            f"- **host_loss_suspect**: rank {s.get('rank')} named laggard "
+            f"{s.get('consecutive_beats')} consecutive heartbeat(s) by "
+            f"step {s.get('step')} (detection only — go look at that host)"
+        )
     injected = [f for f in rec.get("faults", []) if f["injected"]]
     organic = rec.get("organic_faults", [])
     if not rec.get("faults"):
@@ -954,6 +1065,22 @@ def main(argv: list[str] | None = None) -> int:
              "--grad-compression (flag ignored, partitioner folded the "
              "wire back to fp32) fails here instead of passing on "
              "wall-clock luck",
+    )
+    p.add_argument(
+        "--max-request-retry-rate", type=float, default=-1.0,
+        help="with --strict: fail when the serving router's "
+             "request_retry_rate (router_summary) exceeds this ceiling, "
+             "or when NO router_summary exists (-1 = the gate is off; 0 "
+             "is a valid ceiling: any retry fails) — the serve-router "
+             "retry-storm gate",
+    )
+    p.add_argument(
+        "--min-serve-goodput-frac", type=float, default=0.0,
+        help="with --strict: fail when the serving router's goodput_frac "
+             "(requests completed within the TTFT SLO over requests "
+             "submitted, router_summary) falls below this floor, or when "
+             "NO router_summary exists (0 = the gate is off) — a missing "
+             "serving measurement must never read as a pass",
     )
     p.add_argument(
         "--trace", type=str, default="",
@@ -1021,6 +1148,42 @@ def main(argv: list[str] | None = None) -> int:
                     "never engaged (check grad_compression in the "
                     "obs_gauges record)",
                     file=sys.stderr,
+                )
+                rc = 1
+        serving = report["recovery"].get("serving")
+        if args.max_request_retry_rate >= 0:
+            rate = (serving or {}).get("request_retry_rate")
+            if rate is None:
+                print(
+                    "strict: --max-request-retry-rate set but no "
+                    "router_summary record found (serve-router run "
+                    "required) — a missing measurement must never read "
+                    "as a pass", file=sys.stderr,
+                )
+                rc = 1
+            elif rate > args.max_request_retry_rate:
+                print(
+                    f"strict: request_retry_rate {rate} exceeds the "
+                    f"{args.max_request_retry_rate} ceiling — the pool is "
+                    "retry-storming (dying replicas or a too-tight "
+                    "deadline/backoff config)", file=sys.stderr,
+                )
+                rc = 1
+        if args.min_serve_goodput_frac > 0:
+            frac = (serving or {}).get("goodput_frac")
+            if frac is None:
+                print(
+                    "strict: --min-serve-goodput-frac set but no "
+                    "router_summary record found (serve-router run "
+                    "required) — a missing measurement must never read "
+                    "as a pass", file=sys.stderr,
+                )
+                rc = 1
+            elif frac < args.min_serve_goodput_frac:
+                print(
+                    f"strict: goodput_frac {frac} below the "
+                    f"{args.min_serve_goodput_frac} floor — requests are "
+                    "being shed or missing the TTFT SLO", file=sys.stderr,
                 )
                 rc = 1
         ov_floor = args.min_overlap_frac

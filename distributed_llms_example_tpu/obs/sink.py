@@ -139,6 +139,48 @@ class JsonlFileSink:
             self._f = None
 
 
+class ProductJsonlWriter:
+    """Crash-safe JSONL writer for PRODUCT output — the serve CLI's
+    request records, NOT a metric channel (no ``schema_version`` stamp,
+    no process gate; the caller owns what goes in the file).
+
+    Stronger than ``JsonlFileSink``'s line-buffered discipline: each
+    record is encoded once and pushed through ``os.write`` on the raw
+    fd, so even a line larger than the TextIOWrapper chunk (~8 KiB)
+    reaches the OS in one syscall — a ``kill -9`` mid-run can drop only
+    records never written, never interleave or tear a line (the only
+    residual window is a kernel short write on a regular file, which the
+    loop below completes and which does not occur outside signals/ENOSPC)
+    — plus an fsync on ``close()`` so a completed run's output survives
+    a machine-level interruption too.  Errors raise (this is the served
+    product: losing it silently is not "best effort", it is data loss
+    the caller must see)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fd: int | None = os.open(
+            path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644
+        )
+        self.records = 0
+
+    def write(self, record: Mapping[str, Any]) -> None:
+        data = (json.dumps(record) + "\n").encode("utf-8")
+        while data:
+            n = os.write(self._fd, data)
+            data = data[n:]
+        self.records += 1
+
+    def close(self) -> None:
+        if self._fd is None:
+            return
+        os.fsync(self._fd)
+        os.close(self._fd)
+        self._fd = None
+
+
 class TeeSink:
     def __init__(self, sinks: list):
         self.sinks = list(sinks)

@@ -688,6 +688,15 @@ class ServingEngine:
         return state
 
     # ---------------------------------------------------------------- loop
+    def open(self, params: Any, *, replica: int | None = None) -> "ServeSession":
+        """Open a stepwise serving session over this engine: ``submit``
+        requests as they arrive, drive ``step()`` per scheduler round,
+        ``finalize()`` at end of life.  ``generate`` below is the batch
+        wrapper; the replica router (serving/router.py) drives one open
+        session per replica.  ``replica`` stamps the serve events so the
+        router tier's streams stay attributable per engine."""
+        return ServeSession(self, params, replica=replica)
+
     def generate(
         self,
         params: Any,
@@ -703,308 +712,466 @@ class ServingEngine:
         real serving API — and the lever continuous batching exists for:
         a short request frees its slot the step it finishes).  Fills
         ``self.last_stats`` and emits serve_window / serve_summary obs
-        events."""
-        S, L, W, C = self.S, self.L, self.W, self.prefill_batch
-        budgets = (
-            [min(int(m), L) for m in max_new]
-            if max_new is not None
-            else [L] * len(requests)
-        )
-        if len(budgets) != len(requests):
+        events.  Thin wrapper over a ``ServeSession``: submit everything,
+        step until drained, finalize."""
+        if max_new is not None and len(max_new) != len(requests):
             raise ValueError(
-                f"max_new has {len(budgets)} entries for {len(requests)} requests"
+                f"max_new has {len(max_new)} entries for {len(requests)} requests"
             )
-        n_chips = max(jax.device_count(), 1)
-        stats = ServeStats(sequences=len(requests))
-        outputs: list[list[int]] = [[] for _ in requests]
-        ttft: list[float | None] = [None] * len(requests)
-        # per-request lifecycle (queue-wait → prefill → first-token →
-        # decode → evict): admit instant + this request's prefill-call
-        # duration, all relative to the batch's submit instant so the
-        # serve_request records line up on one timeline
-        admit_t: list[float | None] = [None] * len(requests)
-        prefill_dt = [0.0] * len(requests)
-        pending = list(range(len(requests)))[::-1]  # pop() preserves order
-        slot_req = np.full(S, -1, np.int64)  # request index per slot
-        emitted = np.zeros(S, np.int64)
-        lengths = np.zeros(S, np.int64)  # true prompt lengths (both families)
-        base = np.full(S, W, np.int64)  # causal: decode tail start (= the
-        #                                 slot's admission-bucket width)
-        active = np.zeros(S, bool)
-        # paged bookkeeping: block ownership per slot + the block table the
-        # step program reads (sentinel = num_blocks → reads fill zeros,
-        # writes drop)
-        slot_blocks: list[list[int]] = [[] for _ in range(S)]
-        slot_bt = (
-            np.full((S, self.n_tiles), self.pool.num_blocks, np.int32)
-            if self.paged
+        sess = self.open(params)
+        for i, req in enumerate(requests):
+            sess.submit(
+                req,
+                max_new=(max_new[i] if max_new is not None else None),
+                attention_mask=(
+                    attention_masks[i] if attention_masks is not None else None
+                ),
+            )
+        while sess.has_work():
+            sess.step()
+        sess.finalize()
+        return list(sess.outputs)
+
+
+class ServeSession:
+    """One serving lifetime over an engine, stepwise.
+
+    The engine's former monolithic ``generate`` loop, split at the
+    scheduler-round boundary so a tier ABOVE the engine can drive it:
+    ``submit`` enqueues a request (any time, not just up front),
+    ``step()`` runs one admit-then-decode round and returns the requests
+    that finished during it, ``finalize()`` closes the books
+    (serve_summary, ``engine.last_stats``).  All compiled programs, slot
+    bookkeeping, byte accounting, and obs events are exactly the
+    engine's — the split moves control flow, not semantics, which is why
+    the engine-vs-static determinism pins keep covering every driver.
+
+    The replica router (serving/router.py) opens one session per engine
+    replica; ``progress`` (bumped on every admit chunk and decode step)
+    is its per-replica heartbeat, ``take_pending`` is its drain path,
+    and ``label`` lets it thread router-global request ids through the
+    ``serve_request`` span stream."""
+
+    def __init__(self, engine: ServingEngine, params: Any,
+                 *, replica: int | None = None):
+        import collections
+
+        eng = self.eng = engine
+        self.params = params
+        self.replica = replica
+        self.n_chips = max(jax.device_count(), 1)
+        S = eng.S
+        # per-request tables, session-local rid = index (grow on submit)
+        self.requests: list[list[int]] = []
+        self.attn_masks: list[Sequence[int] | None] = []
+        self.budgets: list[int] = []
+        self.labels: list[Any] = []
+        self.outputs: list[list[int]] = []
+        self.ttft: list[float | None] = []
+        self.submit_t: list[float] = []
+        self.first_tok_wall: list[float | None] = []
+        self.admit_t: list[float | None] = []
+        self.prefill_dt: list[float] = []
+        self.pending: "collections.deque[int]" = collections.deque()
+        self.stats = ServeStats()
+        # the router's heartbeat: bumps on every admit chunk and decode
+        # step — a replica whose counter stops moving while it has work
+        # is stalled (live → suspect → dead in the router's machine)
+        self.progress = 0
+        # slot bookkeeping (the generate loop's former closure state)
+        self.slot_req = np.full(S, -1, np.int64)  # request index per slot
+        self.emitted = np.zeros(S, np.int64)
+        self.lengths = np.zeros(S, np.int64)  # true prompt lengths
+        self.base = np.full(S, eng.W, np.int64)  # causal: decode tail start
+        self.active = np.zeros(S, bool)
+        # paged bookkeeping: block ownership per slot + the block table
+        # the step program reads (sentinel = num_blocks → reads fill
+        # zeros, writes drop)
+        self.slot_blocks: list[list[int]] = [[] for _ in range(S)]
+        self.slot_bt = (
+            np.full((S, eng.n_tiles), eng.pool.num_blocks, np.int32)
+            if eng.paged
             else None
         )
-        state = self._init_state(params)
-        state = self.warm(params, state)
-        t_submit = time.perf_counter()
-        stats.cache_bytes_resident, per_block = self._state_byte_account(state)
-        bpt_samples: list[float] = []
-        win_tokens, win_t0, win_occ = 0, time.perf_counter(), 0.0
-        win_prefill, win_decode = 0.0, 0.0
+        self.state = eng._init_state(params)
+        self.state = eng.warm(params, self.state)
+        self.t_open = time.perf_counter()
+        self.stats.cache_bytes_resident, self._per_block = (
+            eng._state_byte_account(self.state)
+        )
+        self._bpt_samples: list[float] = []
+        self._win_tokens, self._win_occ = 0, 0.0
+        self._win_t0 = time.perf_counter()
+        self._win_prefill, self._win_decode = 0.0, 0.0
+        self._finalized = False
 
-        def bytes_in_use() -> int:
-            if self.paged:
-                return self.pool.blocks_in_use * per_block
-            return stats.cache_bytes_resident
+    # ------------------------------------------------------------- intake
+    def submit(
+        self,
+        tokens: Sequence[int],
+        *,
+        max_new: int | None = None,
+        attention_mask: Sequence[int] | None = None,
+        label: Any = None,
+    ) -> int:
+        """Enqueue one request; returns the session-local rid.  ``label``
+        (default: the rid) is what the ``serve_request`` event carries as
+        ``request`` — the router passes its global request id."""
+        if self._finalized:
+            raise RuntimeError("session already finalized")
+        rid = len(self.requests)
+        self.requests.append(list(tokens))
+        self.attn_masks.append(
+            list(attention_mask) if attention_mask is not None else None
+        )
+        self.budgets.append(
+            min(int(max_new), self.eng.L) if max_new is not None else self.eng.L
+        )
+        self.labels.append(rid if label is None else label)
+        self.outputs.append([])
+        self.ttft.append(None)
+        self.submit_t.append(time.perf_counter())
+        self.first_tok_wall.append(None)
+        self.admit_t.append(None)
+        self.prefill_dt.append(0.0)
+        self.pending.append(rid)
+        self.stats.sequences += 1
+        return rid
 
-        def live_tokens() -> int:
-            # tokens the serving state holds for live requests: true
-            # prompt + generated so far, per active slot
-            return int((lengths[active] + emitted[active]).sum())
+    def take_pending(self) -> list[Any]:
+        """Remove every not-yet-admitted request and return their labels
+        — the router's drain path (re-dispatch elsewhere; live slots keep
+        decoding to completion here).  The removed requests' outputs stay
+        empty and they never reach the serve_request stream."""
+        labels = [self.labels[rid] for rid in self.pending]
+        self.pending.clear()
+        return labels
 
-        def finish_request(req: int, slot: int, now: float) -> None:
-            """Evict-time lifecycle record — the trace exporter's feed and
-            the post-hoc 'why was THIS request's TTFT fat' answer."""
-            if not self.serve.request_spans:
-                return
-            t_admit = admit_t[req] if admit_t[req] is not None else t_submit
-            queue_wait = t_admit - t_submit
-            t = ttft[req]
-            log_json({
-                "event": "serve_request",
-                "request": int(req),
-                "slot": int(slot),
-                "queue_wait_ms": round(queue_wait * 1e3, 3),
-                "prefill_ms": round(prefill_dt[req] * 1e3, 3),
-                "ttft_ms": round(t * 1e3, 3) if t is not None else None,
-                "decode_ms": round((now - t_submit - (t or queue_wait)) * 1e3, 3),
-                "tokens": len(outputs[req]),
-                "t_admit_s": round(t_admit - t_submit, 6),
-                "t_done_s": round(now - t_submit, 6),
-                "finished_at_step": int(stats.decode_steps),
-            })
+    # ------------------------------------------------------------- gauges
+    @property
+    def queue_depth(self) -> int:
+        return len(self.pending)
 
-        def evict_slot(slot: int) -> None:
-            """Free the slot NOW — and, paged, return every block it held
-            to the pool (the evict-returns-all-blocks contract)."""
-            active[slot] = False
-            slot_req[slot] = -1
-            if self.paged and slot_blocks[slot]:
-                self.pool.free(slot_blocks[slot])
-                slot_blocks[slot] = []
-                slot_bt[slot, :] = self.pool.num_blocks
+    @property
+    def active_count(self) -> int:
+        return int(self.active.sum())
 
-        def admit_now() -> None:
-            nonlocal state
-            free = [i for i in range(S) if not active[i]]
-            n = min(len(free), C, len(pending))
+    def has_work(self) -> bool:
+        return bool(self.pending) or bool(self.active.any())
+
+    def output(self, rid: int) -> list[int]:
+        return self.outputs[rid]
+
+    def first_token_wall(self, rid: int) -> float | None:
+        """Absolute perf_counter instant of the request's first token —
+        the router computes its own TTFT from its own submit instant."""
+        return self.first_tok_wall[rid]
+
+    def _bytes_in_use(self) -> int:
+        if self.eng.paged:
+            return self.eng.pool.blocks_in_use * self._per_block
+        return self.stats.cache_bytes_resident
+
+    def _live_tokens(self) -> int:
+        # tokens the serving state holds for live requests: true prompt
+        # + generated so far, per active slot
+        return int((self.lengths[self.active] + self.emitted[self.active]).sum())
+
+    # ---------------------------------------------------------- lifecycle
+    def _finish_request(self, rid: int, slot: int, now: float) -> None:
+        """Evict-time lifecycle record — the trace exporter's feed and
+        the post-hoc 'why was THIS request's TTFT fat' answer."""
+        if not self.eng.serve.request_spans:
+            return
+        t_sub = self.submit_t[rid]
+        t_admit = self.admit_t[rid] if self.admit_t[rid] is not None else t_sub
+        queue_wait = t_admit - t_sub
+        t = self.ttft[rid]
+        record = {
+            "event": "serve_request",
+            "request": self.labels[rid],
+            "slot": int(slot),
+            "queue_wait_ms": round(queue_wait * 1e3, 3),
+            "prefill_ms": round(self.prefill_dt[rid] * 1e3, 3),
+            "ttft_ms": round(t * 1e3, 3) if t is not None else None,
+            "decode_ms": round(
+                (now - t_sub - (t if t is not None else queue_wait)) * 1e3, 3
+            ),
+            "tokens": len(self.outputs[rid]),
+            "t_admit_s": round(t_admit - self.t_open, 6),
+            "t_done_s": round(now - self.t_open, 6),
+            "finished_at_step": int(self.stats.decode_steps),
+        }
+        if self.replica is not None:
+            record["replica"] = int(self.replica)
+        log_json(record)
+
+    def _evict_slot(self, slot: int) -> None:
+        """Free the slot NOW — and, paged, return every block it held to
+        the pool (the evict-returns-all-blocks contract)."""
+        self.active[slot] = False
+        self.slot_req[slot] = -1
+        if self.eng.paged and self.slot_blocks[slot]:
+            self.eng.pool.free(self.slot_blocks[slot])
+            self.slot_blocks[slot] = []
+            self.slot_bt[slot, :] = self.eng.pool.num_blocks
+
+    def _admit_now(self, finished: list) -> None:
+        eng = self.eng
+        S, W, C = eng.S, eng.W, eng.prefill_batch
+        free = [i for i in range(S) if not self.active[i]]
+        n = min(len(free), C, len(self.pending))
+        if n == 0:
+            return
+        plen = lambda rid: min(len(self.requests[rid]), W)  # noqa: E731
+        if eng.paged:
+            # shrink the chunk until the free list funds it: admission
+            # DEFERS on a short pool instead of over-committing — every
+            # eviction frees blocks, so deferred requests admit later
+            while n > 0:
+                needed = sum(
+                    cache_pool.blocks_needed(
+                        plen(self.pending[i]), self.budgets[self.pending[i]],
+                        eng.block_size,
+                    )
+                    for i in range(n)
+                )
+                if eng.pool.can_alloc(needed):
+                    break
+                n -= 1
             if n == 0:
+                self.stats.admit_deferrals += 1
                 return
-            plen = lambda req: min(len(requests[req]), W)  # noqa: E731
-            if self.paged:
-                # shrink the chunk until the free list funds it: admission
-                # DEFERS on a short pool instead of over-committing — every
-                # eviction frees blocks, so deferred requests admit later
-                while n > 0:
-                    needed = sum(
-                        cache_pool.blocks_needed(
-                            plen(pending[-1 - i]), budgets[pending[-1 - i]],
-                            self.block_size,
-                        )
-                        for i in range(n)
+        reqs = [self.pending.popleft() for _ in range(n)]
+        # the smallest compiled admission width covering this chunk —
+        # short prompts stop paying the max_source_length program
+        bucket = next(
+            b for b in eng.buckets if b >= max(plen(rid) for rid in reqs)
+        )
+        ids = np.full((C, bucket), eng.pad, np.int32)
+        mask = np.zeros((C, bucket), np.int32)
+        for r, rid in enumerate(reqs):
+            toks = self.requests[rid][:bucket]
+            ids[r, : len(toks)] = toks
+            mask[r, : len(toks)] = 1
+            if self.attn_masks[rid] is not None:
+                m = self.attn_masks[rid][:bucket]
+                mask[r, : len(m)] = m
+        slot_idx = np.full(C, S, np.int32)  # padding rows drop
+        slot_idx[:n] = free[:n]
+        admit_rows = None
+        if eng.paged:
+            # fund + map each row's blocks BEFORE the program runs: the
+            # flat (chunk × chunk-tiles) assignment carries sentinels for
+            # tiles that must not copy (padding rows, prompt gap)
+            ntc = (bucket + eng.L) // eng.block_size
+            admit_rows = np.full((C, ntc), eng.pool.num_blocks, np.int32)
+            for r, rid in enumerate(reqs):
+                blocks = eng.pool.alloc(
+                    cache_pool.blocks_needed(
+                        plen(rid), self.budgets[rid], eng.block_size
                     )
-                    if self.pool.can_alloc(needed):
-                        break
-                    n -= 1
-                if n == 0:
-                    stats.admit_deferrals += 1
-                    return
-            reqs = [pending.pop() for _ in range(n)]
-            # the smallest compiled admission width covering this chunk —
-            # short prompts stop paying the max_source_length program
-            bucket = next(
-                b for b in self.buckets if b >= max(plen(req) for req in reqs)
-            )
-            ids = np.full((C, bucket), self.pad, np.int32)
-            mask = np.zeros((C, bucket), np.int32)
-            for r, req in enumerate(reqs):
-                toks = list(requests[req])[:bucket]
-                ids[r, : len(toks)] = toks
-                mask[r, : len(toks)] = 1
-                if attention_masks is not None:
-                    m = list(attention_masks[req])[:bucket]
-                    mask[r, : len(m)] = m
-            slot_idx = np.full(C, S, np.int32)  # padding rows drop
-            slot_idx[:n] = free[:n]
-            admit_rows = None
-            if self.paged:
-                # fund + map each row's blocks BEFORE the program runs: the
-                # flat (chunk × chunk-tiles) assignment carries sentinels
-                # for tiles that must not copy (padding rows, prompt gap)
-                ntc = (bucket + self.L) // self.block_size
-                admit_rows = np.full((C, ntc), self.pool.num_blocks, np.int32)
-                for r, req in enumerate(reqs):
-                    blocks = self.pool.alloc(
-                        cache_pool.blocks_needed(
-                            plen(req), budgets[req], self.block_size
-                        )
-                    )
-                    assert blocks is not None  # funded above
-                    slot = free[r]
-                    slot_blocks[slot] = blocks
-                    row = cache_pool.build_block_row(
-                        self.n_tiles, blocks,
-                        prompt_len=plen(req), bucket_width=bucket,
-                        budget=budgets[req], block_size=self.block_size,
-                        sentinel=self.pool.num_blocks,
-                    )
-                    slot_bt[slot, :] = row
-                    admit_rows[r, :] = row[:ntc]
-            t0 = time.perf_counter()
-            pre = self._prefill(params, jnp.asarray(ids), jnp.asarray(mask))
-            if self.is_seq2seq:
-                enc, pmask, ckv = pre
-                state = self._admit(state, enc, pmask, ckv, jnp.asarray(slot_idx))
-            else:
-                cache, full_mask, plens, first = pre
-                if self.paged:
-                    state = self._admit(
-                        state, cache, full_mask, first, jnp.asarray(slot_idx),
-                        jnp.asarray(admit_rows.reshape(-1)),
-                    )
-                else:
-                    state = self._admit(
-                        state, cache, full_mask, first, jnp.asarray(slot_idx)
-                    )
-                plens_h = np.asarray(jax.device_get(plens))
-                first_h = np.asarray(jax.device_get(first))
-            dt = time.perf_counter() - t0
-            stats.prefill_seconds += dt
-            nonlocal win_prefill
-            win_prefill += dt
-            now = time.perf_counter()
-            for r, req in enumerate(reqs):
+                )
+                assert blocks is not None  # funded above
                 slot = free[r]
-                slot_req[slot] = req
-                emitted[slot] = 0
-                lengths[slot] = plen(req)
-                base[slot] = bucket
-                active[slot] = True
-                admit_t[req] = t0
-                prefill_dt[req] = dt
-                if not self.is_seq2seq:
-                    lengths[slot] = int(plens_h[r])
-                    # the causal prefill already produced token #1
-                    outputs[req].append(int(first_h[r]))
-                    emitted[slot] = 1
-                    ttft[req] = now - t_submit
-                    if int(first_h[r]) == self.eos or emitted[slot] >= budgets[req]:
-                        evict_slot(slot)
-                        finish_request(req, slot, now)
-            stats.peak_cache_bytes_in_use = max(
-                stats.peak_cache_bytes_in_use, bytes_in_use()
-            )
-
-        while pending or active.any():
-            admit_now()
-            if not active.any():
-                continue  # every admitted sequence finished at prefill
-            offsets = emitted if self.is_seq2seq else (base + emitted - 1)
-            t0 = time.perf_counter()
-            if self.is_seq2seq:
-                tokens, state = self._step(
-                    params, state,
-                    jnp.asarray(offsets.astype(np.int32)),
-                    jnp.asarray(active),
+                self.slot_blocks[slot] = blocks
+                row = cache_pool.build_block_row(
+                    eng.n_tiles, blocks,
+                    prompt_len=plen(rid), bucket_width=bucket,
+                    budget=self.budgets[rid], block_size=eng.block_size,
+                    sentinel=eng.pool.num_blocks,
                 )
-            elif self.paged:
-                rope = lengths + emitted - 1
-                tokens, state = self._step(
-                    params, state,
-                    jnp.asarray(slot_bt),
-                    jnp.asarray(offsets.astype(np.int32)),
-                    jnp.asarray(rope.astype(np.int32)),
-                    jnp.asarray(active),
+                self.slot_bt[slot, :] = row
+                admit_rows[r, :] = row[:ntc]
+        t0 = time.perf_counter()
+        pre = eng._prefill(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        if eng.is_seq2seq:
+            enc, pmask, ckv = pre
+            self.state = eng._admit(
+                self.state, enc, pmask, ckv, jnp.asarray(slot_idx)
+            )
+        else:
+            cache, full_mask, plens, first = pre
+            if eng.paged:
+                self.state = eng._admit(
+                    self.state, cache, full_mask, first, jnp.asarray(slot_idx),
+                    jnp.asarray(admit_rows.reshape(-1)),
                 )
             else:
-                rope = lengths + emitted - 1
-                tokens, state = self._step(
-                    params, state,
-                    jnp.asarray(offsets.astype(np.int32)),
-                    jnp.asarray(rope.astype(np.int32)),
-                    jnp.asarray(active),
+                self.state = eng._admit(
+                    self.state, cache, full_mask, first, jnp.asarray(slot_idx)
                 )
-            toks = np.asarray(jax.device_get(tokens))
-            dt = time.perf_counter() - t0
-            stats.decode_seconds += dt
-            stats.decode_steps += 1
-            win_decode += dt
-            n_active = int(active.sum())
-            stats.decode_tokens += n_active
-            stats.slot_occupancy += n_active / S
-            win_tokens += n_active
-            win_occ += n_active / S
-            bpt_samples.append(bytes_in_use() / max(live_tokens(), 1))
-            now = time.perf_counter()
-            for slot in np.nonzero(active)[0]:
-                req = int(slot_req[slot])
-                tok = int(toks[slot])
-                outputs[req].append(tok)
-                if ttft[req] is None:
-                    ttft[req] = now - t_submit
-                emitted[slot] += 1
-                if tok == self.eos or emitted[slot] >= budgets[req]:
-                    evict_slot(slot)  # the slot (and its blocks) free NOW
-                    finish_request(req, slot, now)
-            if (
-                self.serve.log_every_steps
-                and stats.decode_steps % self.serve.log_every_steps == 0
-            ):
-                w_dt = max(now - win_t0, 1e-9)
-                window = {
-                    "event": "serve_window",
-                    "step": stats.decode_steps,
-                    "decode_tokens_per_sec": round(win_tokens / w_dt, 1),
-                    "decode_tokens_per_sec_chip": round(win_tokens / w_dt / n_chips, 1),
-                    "slot_occupancy": round(
-                        win_occ / self.serve.log_every_steps, 4
-                    ),
-                    "queue_depth": len(pending),
-                    # the window's wall split: admission prefill vs decode
-                    # steps — a window whose prefill share balloons is
-                    # paying admission on the decode critical path
-                    "prefill_ms": round(win_prefill * 1e3, 1),
-                    "decode_ms": round(win_decode * 1e3, 1),
-                    # capacity gauges: what the cache state holds RIGHT NOW
-                    # per live token — the number the paged pool shrinks
-                    "cache_bytes_in_use": bytes_in_use(),
-                    "cache_bytes_per_token": round(
-                        bytes_in_use() / max(live_tokens(), 1), 1
-                    ),
-                }
-                if self.paged:
-                    window["pool_blocks_in_use"] = self.pool.blocks_in_use
-                    window["pool_blocks_free"] = self.pool.blocks_free
-                log_json(window)
-                win_tokens, win_t0, win_occ = 0, now, 0.0
-                win_prefill, win_decode = 0.0, 0.0
+            plens_h = np.asarray(jax.device_get(plens))
+            first_h = np.asarray(jax.device_get(first))
+        dt = time.perf_counter() - t0
+        self.stats.prefill_seconds += dt
+        self._win_prefill += dt
+        self.progress += 1
+        now = time.perf_counter()
+        for r, rid in enumerate(reqs):
+            slot = free[r]
+            self.slot_req[slot] = rid
+            self.emitted[slot] = 0
+            self.lengths[slot] = plen(rid)
+            self.base[slot] = bucket
+            self.active[slot] = True
+            self.admit_t[rid] = t0
+            self.prefill_dt[rid] = dt
+            if not eng.is_seq2seq:
+                self.lengths[slot] = int(plens_h[r])
+                # the causal prefill already produced token #1
+                self.outputs[rid].append(int(first_h[r]))
+                self.emitted[slot] = 1
+                self.ttft[rid] = now - self.submit_t[rid]
+                self.first_tok_wall[rid] = now
+                if (
+                    int(first_h[r]) == eng.eos
+                    or self.emitted[slot] >= self.budgets[rid]
+                ):
+                    self._evict_slot(slot)
+                    self._finish_request(rid, slot, now)
+                    finished.append(rid)
+        self.stats.peak_cache_bytes_in_use = max(
+            self.stats.peak_cache_bytes_in_use, self._bytes_in_use()
+        )
 
-        stats.ttft_s = [t for t in ttft if t is not None]
+    def step(self) -> list[int]:
+        """One scheduler round: admit into free slots, then — if any slot
+        is live — one decode step.  Returns the session-local rids of
+        requests that finished during this call (finish-at-prefill
+        included).  The batch ``generate`` loop is
+        ``while has_work(): step()``."""
+        if self._finalized:
+            raise RuntimeError("session already finalized")
+        eng = self.eng
+        finished: list[int] = []
+        self._admit_now(finished)
+        if not self.active.any():
+            return finished  # every admitted sequence finished at prefill
+        offsets = (
+            self.emitted if eng.is_seq2seq else (self.base + self.emitted - 1)
+        )
+        t0 = time.perf_counter()
+        if eng.is_seq2seq:
+            tokens, self.state = eng._step(
+                self.params, self.state,
+                jnp.asarray(offsets.astype(np.int32)),
+                jnp.asarray(self.active),
+            )
+        elif eng.paged:
+            rope = self.lengths + self.emitted - 1
+            tokens, self.state = eng._step(
+                self.params, self.state,
+                jnp.asarray(self.slot_bt),
+                jnp.asarray(offsets.astype(np.int32)),
+                jnp.asarray(rope.astype(np.int32)),
+                jnp.asarray(self.active),
+            )
+        else:
+            rope = self.lengths + self.emitted - 1
+            tokens, self.state = eng._step(
+                self.params, self.state,
+                jnp.asarray(offsets.astype(np.int32)),
+                jnp.asarray(rope.astype(np.int32)),
+                jnp.asarray(self.active),
+            )
+        toks = np.asarray(jax.device_get(tokens))
+        dt = time.perf_counter() - t0
+        self.stats.decode_seconds += dt
+        self.stats.decode_steps += 1
+        self.progress += 1
+        self._win_decode += dt
+        n_active = self.active_count
+        self.stats.decode_tokens += n_active
+        self.stats.slot_occupancy += n_active / eng.S
+        self._win_tokens += n_active
+        self._win_occ += n_active / eng.S
+        self._bpt_samples.append(
+            self._bytes_in_use() / max(self._live_tokens(), 1)
+        )
+        now = time.perf_counter()
+        for slot in np.nonzero(self.active)[0]:
+            rid = int(self.slot_req[slot])
+            tok = int(toks[slot])
+            self.outputs[rid].append(tok)
+            if self.ttft[rid] is None:
+                self.ttft[rid] = now - self.submit_t[rid]
+                self.first_tok_wall[rid] = now
+            self.emitted[slot] += 1
+            if tok == eng.eos or self.emitted[slot] >= self.budgets[rid]:
+                self._evict_slot(slot)  # slot (and its blocks) free NOW
+                self._finish_request(rid, slot, now)
+                finished.append(rid)
+        every = eng.serve.log_every_steps
+        if every and self.stats.decode_steps % every == 0:
+            w_dt = max(now - self._win_t0, 1e-9)
+            window = {
+                "event": "serve_window",
+                "step": self.stats.decode_steps,
+                "decode_tokens_per_sec": round(self._win_tokens / w_dt, 1),
+                "decode_tokens_per_sec_chip": round(
+                    self._win_tokens / w_dt / self.n_chips, 1
+                ),
+                "slot_occupancy": round(self._win_occ / every, 4),
+                "queue_depth": len(self.pending),
+                # the window's wall split: admission prefill vs decode
+                # steps — a window whose prefill share balloons is paying
+                # admission on the decode critical path
+                "prefill_ms": round(self._win_prefill * 1e3, 1),
+                "decode_ms": round(self._win_decode * 1e3, 1),
+                # capacity gauges: what the cache state holds RIGHT NOW
+                # per live token — the number the paged pool shrinks
+                "cache_bytes_in_use": self._bytes_in_use(),
+                "cache_bytes_per_token": round(
+                    self._bytes_in_use() / max(self._live_tokens(), 1), 1
+                ),
+            }
+            if eng.paged:
+                window["pool_blocks_in_use"] = eng.pool.blocks_in_use
+                window["pool_blocks_free"] = eng.pool.blocks_free
+            if self.replica is not None:
+                window["replica"] = int(self.replica)
+            log_json(window)
+            self._win_tokens, self._win_t0, self._win_occ = 0, now, 0.0
+            self._win_prefill, self._win_decode = 0.0, 0.0
+        return finished
+
+    # ------------------------------------------------------------ closing
+    def finalize(self) -> ServeStats:
+        """Close the books: TTFT decomposition, goodput, the
+        serve_summary event; sets ``engine.last_stats``.  Safe to call
+        once per session; requests still pending (a drained replica) stay
+        unfinished and count against goodput, never silently vanish."""
+        if self._finalized:
+            return self.stats
+        self._finalized = True
+        eng, stats = self.eng, self.stats
+        stats.ttft_s = [t for t in self.ttft if t is not None]
         # TTFT decomposition rows, kept in ttft_s order (finished requests)
-        for req, t in enumerate(ttft):
+        for rid, t in enumerate(self.ttft):
             if t is None:
                 continue
-            t_admit = admit_t[req] if admit_t[req] is not None else t_submit
-            stats.queue_wait_s.append(t_admit - t_submit)
-            stats.prefill_share_s.append(prefill_dt[req])
+            t_admit = (
+                self.admit_t[rid]
+                if self.admit_t[rid] is not None
+                else self.submit_t[rid]
+            )
+            stats.queue_wait_s.append(t_admit - self.submit_t[rid])
+            stats.prefill_share_s.append(self.prefill_dt[rid])
         stats.slot_occupancy = (
             stats.slot_occupancy / stats.decode_steps if stats.decode_steps else 0.0
         )
         stats.goodput = compute_goodput(
-            ttft,
-            [len(o) for o in outputs],
-            wall_s=time.perf_counter() - t_submit,
-            ttft_slo_ms=self.serve.ttft_slo_ms,
-            n_chips=n_chips,
+            self.ttft,
+            [len(o) for o in self.outputs],
+            wall_s=time.perf_counter() - self.t_open,
+            ttft_slo_ms=eng.serve.ttft_slo_ms,
+            n_chips=self.n_chips,
         )
         stats.bytes_per_live_token = (
-            sum(bpt_samples) / len(bpt_samples) if bpt_samples else 0.0
+            sum(self._bpt_samples) / len(self._bpt_samples)
+            if self._bpt_samples
+            else 0.0
         )
         p50, p95 = stats.ttft_percentiles()
         summary = {
@@ -1013,36 +1180,40 @@ class ServingEngine:
             "decode_steps": stats.decode_steps,
             "decode_tokens": stats.decode_tokens,
             "decode_tokens_per_sec": round(stats.tokens_per_sec(), 1),
-            "decode_tokens_per_sec_chip": round(stats.tokens_per_sec() / n_chips, 1),
+            "decode_tokens_per_sec_chip": round(
+                stats.tokens_per_sec() / self.n_chips, 1
+            ),
             "ttft_p50_ms": round(p50 * 1e3, 1),
             "ttft_p95_ms": round(p95 * 1e3, 1),
             **stats.ttft_decomposition(),
             **stats.goodput,
             "slot_occupancy": round(stats.slot_occupancy, 4),
             "prefill_seconds": round(stats.prefill_seconds, 3),
-            "slots": S,
-            "chips": n_chips,
+            "slots": eng.S,
+            "chips": self.n_chips,
             # capacity block: config knobs + the measured static account —
             # so capacity claims are read off the log, not inferred
-            "kv_cache_dtype": self.serve.kv_cache_dtype,
-            "paged_kv": self.paged,
-            "prefill_buckets": list(self.buckets),
+            "kv_cache_dtype": eng.serve.kv_cache_dtype,
+            "paged_kv": eng.paged,
+            "prefill_buckets": list(eng.buckets),
             "cache_bytes_resident": stats.cache_bytes_resident,
             "peak_cache_bytes_in_use": stats.peak_cache_bytes_in_use,
             "cache_bytes_per_token": round(stats.bytes_per_live_token, 1),
         }
-        if self.paged:
-            summary["pool_blocks"] = self.pool.num_blocks
-            summary["kv_block_size"] = self.block_size
+        if eng.paged:
+            summary["pool_blocks"] = eng.pool.num_blocks
+            summary["kv_block_size"] = eng.block_size
             summary["admit_deferrals"] = stats.admit_deferrals
+        if self.replica is not None:
+            summary["replica"] = int(self.replica)
         peak_hbm = device_peak_bytes()
         if peak_hbm is not None:
             # live allocator peak where the backend supports memory_stats
             # (TPU); the static account above is the portable fallback
             summary["peak_hbm_bytes"] = peak_hbm
         log_json(summary)
-        self.last_stats = stats
-        return outputs
+        eng.last_stats = stats
+        return stats
 
 
 def make_static_runner(

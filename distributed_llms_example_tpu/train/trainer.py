@@ -66,6 +66,7 @@ from distributed_llms_example_tpu.train.step import (
     put_batch,
     state_shardings,
 )
+from distributed_llms_example_tpu.utils.backoff import sleep_backoff
 from distributed_llms_example_tpu.utils.jsonlog import MetricLogger, log_json
 
 
@@ -833,8 +834,7 @@ class Trainer:
                         "backoff_s": round(delay, 3),
                         "error": str(e)[:200],
                     })
-                    time.sleep(delay)
-                    delay = min(delay * 2, 2.0)
+                    delay = sleep_backoff(delay, cap_s=2.0)
             yield batch
 
     def _saved_ef_workers(self, meta: Any) -> int:
@@ -1677,6 +1677,15 @@ class Trainer:
             max_target_length=self._tgt_cap,
         )
         self._build_train_step()
+        # the startup obs gauges (MFU FLOPs numerator, the static
+        # collective-traffic account, devprof's instruction→bucket index)
+        # were compiled against the OLD mesh — recompute them from the
+        # rebuilt step so post-reshard windows stop reporting a stale MFU
+        # and the byte account matches the live program (the PR 14
+        # caveat).  Same gating/failure-isolation as startup: an
+        # obs_gauges_skipped event, never a failed recovery.
+        if not self.pipelined:
+            self.obs.startup_gauges(mesh, tgt_cap=self._tgt_cap)
         for attr in ("_val_loss_fn", "_val_unpermute"):
             if hasattr(self, attr):
                 delattr(self, attr)

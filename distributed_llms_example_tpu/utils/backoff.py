@@ -1,0 +1,41 @@
+"""Capped-exponential retry backoff — the ONE owner of retry sleeps.
+
+Every transient-failure retry in the package (checkpoint save, dataset
+reads, the trainer's injected data-error path, the serving router's
+wall-clock waits) sleeps through ``sleep_backoff`` so the retry policy
+has a single definition: capped exponential growth, never negative,
+always logged by the caller BEFORE the sleep (the event carries the
+delay, so a stuck run's log says what it is waiting for).
+
+Repo-lint rule 12 enforces the ownership: a ``time.sleep`` inside an
+``except`` handler anywhere else in the package is an ad-hoc retry loop
+— unbounded, uncapped, invisible to this policy — and fails the lint.
+The serving router's retry backoff is TICK-based (deterministic router
+scheduling, no wall sleeps); this module is for the paths that genuinely
+wait on wall-clock external state (storage, filesystems).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def sleep_backoff(delay_s: float, *, cap_s: float, factor: float = 2.0) -> float:
+    """Sleep ``delay_s`` seconds and return the NEXT delay in the capped
+    exponential schedule (``min(delay_s * factor, cap_s)``) — callers
+    fold it back into their loop variable:
+
+        delay = sleep_backoff(delay, cap_s=2.0)
+    """
+    time.sleep(max(0.0, float(delay_s)))
+    return min(float(delay_s) * float(factor), float(cap_s))
+
+
+def backoff_ticks(retries: int, *, base: int = 2, cap: int = 16) -> int:
+    """The deterministic (tick-unit) twin of ``sleep_backoff`` for the
+    serving router: how many scheduler ticks a request waits before its
+    ``retries``-th re-dispatch.  No wall clock, no sleep — the router's
+    failure handling stays reproducible under test."""
+    if retries <= 0:
+        return 0
+    return min(int(base) * (2 ** (int(retries) - 1)), int(cap))

@@ -51,6 +51,21 @@ def main(argv: list[str] | None = None) -> int:
              "a profile was captured but no device_account was emitted "
              "(0 = the device gate is off)",
     )
+    p.add_argument(
+        "--max-request-retry-rate", type=float, default=-1.0,
+        help="optional serving gate: fail when the router_summary's "
+             "request_retry_rate exceeds this ceiling, or when no "
+             "router_summary was emitted — a serve-router round whose "
+             "pool is retry-storming fails instead of passing on "
+             "wall-clock luck (-1 = off; 0 means any retry fails)",
+    )
+    p.add_argument(
+        "--min-serve-goodput-frac", type=float, default=0.0,
+        help="optional serving gate: fail when the router_summary's "
+             "goodput_frac (requests completed within the TTFT SLO over "
+             "requests submitted) falls below this floor, or when no "
+             "router_summary was emitted (0 = off)",
+    )
     args = p.parse_args(argv)
     from distributed_llms_example_tpu.obs.report import main as report_main
 
@@ -66,6 +81,14 @@ def main(argv: list[str] | None = None) -> int:
         flags += [
             "--max-gradient-bytes-per-step",
             str(args.max_gradient_bytes_per_step),
+        ]
+    if args.max_request_retry_rate >= 0:
+        flags += [
+            "--max-request-retry-rate", str(args.max_request_retry_rate),
+        ]
+    if args.min_serve_goodput_frac > 0:
+        flags += [
+            "--min-serve-goodput-frac", str(args.min_serve_goodput_frac),
         ]
     return report_main(flags)
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Eleven rules, all born from real regressions at TPU scale:
+Twelve rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -123,6 +123,17 @@ Eleven rules, all born from real regressions at TPU scale:
    second initializer would fight the re-init path's teardown ordering.
    ``build_mesh`` / ``initialize_distributed`` /
    ``reinitialize_distributed`` in ``core/mesh.py`` are the owners.
+
+12. **No ad-hoc retry loops — ``time.sleep`` inside an ``except``
+   handler — outside the designated backoff helper
+   (``utils/backoff.py``).**  A hand-rolled sleep-in-except is a retry
+   loop with its own (usually unbounded, uncapped) policy: invisible to
+   the shared capped-exponential schedule, no ``*_retry`` event before
+   the sleep, and in the serving tier it would block the router's
+   single scheduler thread where the tick-unit backoff
+   (``backoff_ticks``) is the sanctioned form.  Retry sleeps go through
+   ``utils.backoff.sleep_backoff``; any call named ``sleep`` lexically
+   inside an except handler elsewhere fails here.
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -265,6 +276,11 @@ KV_CAST_OWNERS = {
     os.path.join(PACKAGE, "serving", "cache_pool.py"),
 }
 
+# Rule 12: retry sleeps are owned by utils/backoff.py (capped
+# exponential schedule, one definition); a sleep inside an except
+# handler anywhere else is an ad-hoc retry loop.
+BACKOFF_OWNER = os.path.join(PACKAGE, "utils", "backoff.py")
+
 
 def _names_contain_lr(node: ast.AST) -> bool:
     return any(
@@ -401,6 +417,36 @@ def _kv_cast_violations(tree: ast.AST, rel: str) -> list[str]:
                 "dequant identity; route through "
                 "ops.flash_attention.quantize_kv / dequantize_kv"
             )
+    return violations
+
+
+def _retry_sleep_violations(tree: ast.AST, rel: str) -> list[str]:
+    """Rule 12: any call named ``sleep`` (``time.sleep``, an aliased
+    ``sleep``, a method ``.sleep``) lexically inside an ``except``
+    handler, outside utils/backoff.py."""
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        for inner in ast.walk(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            fn = inner.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name)
+                else None
+            )
+            if name == "sleep":
+                violations.append(
+                    f"{rel}:{inner.lineno}: sleep(...) inside an except "
+                    "handler outside utils/backoff.py is an ad-hoc retry "
+                    "loop — no capped schedule, no retry event, and it "
+                    "would block the serving router's scheduler thread; "
+                    "route wall-clock retry waits through "
+                    "utils.backoff.sleep_backoff (tick-based paths use "
+                    "backoff_ticks)"
+                )
     return violations
 
 
@@ -660,6 +706,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         violations.extend(_mesh_ownership_violations(tree, rel))
     if rel != TRACE_OWNER:
         violations.extend(_trace_emit_violations(tree, rel))
+    if rel != BACKOFF_OWNER:
+        violations.extend(_retry_sleep_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)

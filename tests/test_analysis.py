@@ -568,6 +568,33 @@ def test_repo_lint_clean_and_catches_violations(tmp_path):
     rel = os.path.join("distributed_llms_example_tpu", "models", "okmodel.py")
     assert repo_lint.lint_file(str(ok_drop), rel) == []
 
+    # rule 12: time.sleep inside an except handler is an ad-hoc retry
+    # loop — any spelling (time.sleep, aliased sleep, bare sleep)
+    bad_retry = tmp_path / "retry.py"
+    bad_retry.write_text(
+        "import time\nfrom time import sleep\n"
+        "try:\n    f()\nexcept OSError:\n"
+        "    time.sleep(1)\n    sleep(2)\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "io", "retry.py")
+    assert len(repo_lint.lint_file(str(bad_retry), rel)) == 2
+    # ...the designated backoff helper is the owner; and a sleep OUTSIDE
+    # an except handler (a poll cadence, not a retry) stays legal
+    rel = os.path.join("distributed_llms_example_tpu", "utils", "backoff.py")
+    assert repo_lint.lint_file(str(bad_retry), rel) == []
+    ok_poll = tmp_path / "poll.py"
+    ok_poll.write_text("import time\nwhile x:\n    time.sleep(0.1)\n")
+    rel = os.path.join("distributed_llms_example_tpu", "obs", "poll.py")
+    assert repo_lint.lint_file(str(ok_poll), rel) == []
+    # the sanctioned call site: sleep_backoff in an except handler
+    ok_retry = tmp_path / "okretry.py"
+    ok_retry.write_text(
+        "from distributed_llms_example_tpu.utils.backoff import sleep_backoff\n"
+        "try:\n    f()\nexcept OSError:\n    d = sleep_backoff(d, cap_s=2.0)\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "io", "okretry.py")
+    assert repo_lint.lint_file(str(ok_retry), rel) == []
+
 
 # ---------------------------------------------------------------------------
 # grad accumulation (ISSUE 5): accumulator-mirror spec lint, the

@@ -238,6 +238,126 @@ def test_health_enabled_tristate():
 
 
 # ---------------------------------------------------------------------------
+# organic host-loss detection, first slice (ISSUE 15 satellite):
+# persistent heartbeat laggards → host_loss_suspect
+# ---------------------------------------------------------------------------
+
+
+def test_laggard_streaks_classification():
+    from distributed_llms_example_tpu.obs.health import LaggardStreaks
+
+    st = LaggardStreaks(suspect_beats=3)
+    assert st.update([1], step=10) == []
+    assert st.update([1, 2], step=20) == []
+    out = st.update([1], step=30)  # rank 1 hits 3 consecutive; rank 2 reset
+    assert [s["rank"] for s in out] == [1]
+    assert out[0]["event"] == "host_loss_suspect"
+    assert out[0]["consecutive_beats"] == 3 and out[0]["step"] == 30
+    # already suspected: no re-fire while the streak continues
+    assert st.update([1], step=40) == []
+    # recovery re-arms; a NEW persistent lag fires again
+    assert st.update([], step=50) == []
+    for step in (60, 70):
+        assert st.update([1], step=step) == []
+    assert [s["rank"] for s in st.update([1], step=80)] == [1]
+
+
+def test_heartbeat_emits_host_loss_suspect(monkeypatch, capsys):
+    """The wired path: a rank persistently late at the heartbeat gather
+    becomes one pod-agreed host_loss_suspect event (detection + report
+    row only — no policy action), computed from the SAME gathered probe
+    on every rank."""
+    from distributed_llms_example_tpu.obs import heartbeat as hb_mod
+    from distributed_llms_example_tpu.obs.heartbeat import Heartbeat
+
+    base = 1_700_000_000
+    clock = {"t": 0}
+
+    def fake_gather(local):
+        # rank 0 = this process's probe; rank 1 arrives 9 s late (over
+        # the 5 s laggard threshold) at every beat
+        t = base + clock["t"]
+        return np.asarray(
+            [[local[0], t, 0], [local[0], t + 9, 0]], np.int32
+        )
+
+    monkeypatch.setattr(hb_mod, "gather_probe", fake_gather)
+    hb = Heartbeat(every_steps=2, suspect_beats=2)
+    recs = []
+    for step in (2, 4, 6):
+        clock["t"] += 60
+        recs.append(hb.beat(step))
+    assert all(r is not None and r["laggards"] == [1] for r in recs)
+    events = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    suspects = [e for e in events if e.get("event") == "host_loss_suspect"]
+    assert len(suspects) == 1  # fires once at the threshold, not per beat
+    assert suspects[0]["rank"] == 1
+    assert suspects[0]["consecutive_beats"] == 2 and suspects[0]["step"] == 4
+
+
+def test_heartbeat_suspect_beats_zero_disables(monkeypatch, capsys):
+    """Review fix: 0 = classification off (the heartbeat knob
+    convention) — no host_loss_suspect ever fires, instead of 0
+    silently meaning the default."""
+    from distributed_llms_example_tpu.obs import heartbeat as hb_mod
+    from distributed_llms_example_tpu.obs.heartbeat import Heartbeat
+
+    monkeypatch.setattr(
+        hb_mod, "gather_probe",
+        lambda local: np.asarray(
+            [[local[0], 1_700_000_000, 0],
+             [local[0], 1_700_000_009, 0]], np.int32
+        ),
+    )
+    hb = Heartbeat(every_steps=2, suspect_beats=0)
+    assert hb.streaks is None
+    for step in (2, 4, 6, 8):
+        hb.beat(step)
+    events = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    assert not [e for e in events if e.get("event") == "host_loss_suspect"]
+    assert [e for e in events if e.get("event") == "heartbeat"]
+
+
+def test_report_renders_host_loss_suspects(tmp_path):
+    from distributed_llms_example_tpu.obs.report import (
+        build_report,
+        render_markdown,
+    )
+    from distributed_llms_example_tpu.obs.sink import SCHEMA_VERSION
+
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    lines = [
+        {"schema_version": SCHEMA_VERSION, "event": "host_loss_suspect",
+         "rank": 1, "step": 40, "consecutive_beats": 3},
+        # a second rank's copy of the SAME verdict dedups to one row
+        {"schema_version": SCHEMA_VERSION, "event": "host_loss_suspect",
+         "rank": 1, "step": 40, "consecutive_beats": 3},
+    ]
+    (obs / "metrics-p000.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in lines[:1]) + "\n"
+    )
+    (obs / "metrics-p001.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in lines[1:]) + "\n"
+    )
+    report = build_report(str(tmp_path))
+    sus = report["recovery"]["host_loss_suspects"]
+    assert sus == [{"rank": 1, "step": 40, "consecutive_beats": 3}]
+    # detection only: NOT a fault, so --strict stays green on it
+    assert report["recovery"]["organic_faults"] == []
+    md = render_markdown(report)
+    assert "host_loss_suspect" in md and "rank 1" in md
+
+
+# ---------------------------------------------------------------------------
 # the zero-extra-syncs invariant: conversions pinned to the log cadence
 # ---------------------------------------------------------------------------
 

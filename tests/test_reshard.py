@@ -286,6 +286,40 @@ def test_reshard_pipelined_composition_row():
     assert not any(r.id == "reshard-pipelined" for r in rows)
 
 
+def test_rebuild_for_mesh_recomputes_startup_gauges(tmp_path):
+    """The PR 14 caveat, fixed and pinned: an in-process reshard rebuilds
+    the train step against the NEW mesh, so the startup obs gauges (MFU
+    FLOPs numerator, collective-traffic account, devprof's
+    instruction→bucket index) must be recomputed from the rebuilt step —
+    `_rebuild_for_mesh` re-invokes `startup_gauges` with the new mesh
+    instead of leaving the old mesh's numbers live until restart."""
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.obs import TrainerObs
+    from distributed_llms_example_tpu.train.trainer import Trainer
+
+    calls: list[dict] = []
+
+    real = TrainerObs.startup_gauges
+
+    def recording(self, mesh, *, tgt_cap):
+        calls.append({"mesh": dict(mesh.shape), "tgt_cap": tgt_cap})
+
+    TrainerObs.startup_gauges = recording
+    try:
+        t = Trainer(
+            _run_cfg(tmp_path / "run", MeshConfig(data=2, fsdp=4),
+                     resume=False),
+            train_records=_records(),
+        )
+        assert len(calls) == 1  # the normal startup compile
+        t._rebuild_for_mesh(build_mesh(MeshConfig(data=8, fsdp=1)))
+    finally:
+        TrainerObs.startup_gauges = real
+    assert len(calls) == 2
+    assert calls[1]["mesh"]["data"] == 8 and calls[1]["mesh"]["fsdp"] == 1
+    assert calls[1]["tgt_cap"] == calls[0]["tgt_cap"]
+
+
 # ---------------------------------------------------------------------------
 # chaos grammar + config validation + batching revalidation
 # ---------------------------------------------------------------------------

@@ -1,0 +1,666 @@
+"""Layer 1 of the pod-agreement static analysis: the SPMD divergence lint.
+
+The deadliest bug class at pod scale is a *rank-divergent branch feeding a
+collective*: one process takes a path the others don't, reaches (or skips)
+a collective, and the pod deadlocks with every other rank parked inside an
+all-reduce that will never complete.  PRs 6, 14, and 15 each shipped
+review fixes for exactly this shape — a one-rank restore exception walking
+only that rank back, a p0-only verify verdict never broadcast, a
+metadata-less fallback ladder retrying a rank-varying number of times
+before a collective.  This pass turns that hand-review discipline into a
+machine check over the host-side Python of ``distributed_llms_example_tpu``.
+
+The model is classic taint analysis, with the three registries **owned by
+this spec** (not by convention — a helper is an agreement sanitizer
+because it is listed here, and review of this file is review of the
+pod-agreement contract):
+
+- *Sources* (``SOURCES``): expressions whose value can differ per rank —
+  ``jax.process_index()``, local file I/O results (``open``, ``os.path.
+  exists``, ``os.listdir``...), and exception bindings (``except E as e``
+  — an exception object exists only on the ranks that threw).  Note that
+  ``jax.process_count()`` is deliberately NOT a source: it is pod-uniform
+  (every rank computes the same value), so branches on it are taken
+  identically everywhere.  The lexical rule 13 in scripts/repo_lint.py
+  still fences WHERE such branches may be written.
+- *Sanitizers* (``SANITIZERS``): the agreement helpers.  A value produced
+  by (or an expression containing a call to) one of these is pod-agreed:
+  every rank holds the same verdict afterwards, so branching on it is
+  safe.  These are the heartbeat allgather channel and the MIN/MAX/
+  broadcast-verdict helpers built on it.
+- *Sinks* (``SINKS``): calls that execute or imply a collective — the
+  compiled train/prefill/decode step invocations, checkpoint save/
+  restore (orbax-style multi-host commit), the heartbeat channel itself,
+  mesh (re)bootstrap, and global-batch assembly.  Reaching a sink on a
+  rank-divergent path is an error.
+
+Waiver: a line (the sink call, or the divergent branch header) annotated
+``# pod-agreed: <mechanism>`` is exempt — the comment must NAME the
+agreement mechanism, and rule 13 enforces the same pragma grammar
+lexically.  The pragma is the paper trail the next reviewer reads.
+
+Findings ride analysis/findings.py (pass_name ``"divergence"``) and the
+lint driver (``analysis/lint.py --divergence``, on by default under
+``--strict``); Layer 2 — the cross-program HLO collective census — lives
+in analysis/ir_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable
+
+from distributed_llms_example_tpu.analysis.findings import Finding
+
+# --------------------------------------------------------------------------
+# The registries.  Owned by spec: a name is a source/sanitizer/sink because
+# it appears here, with the rationale next to it.
+# --------------------------------------------------------------------------
+
+#: Rank-local value producers — call names whose result can differ per rank.
+SOURCES: dict[str, str] = {
+    "process_index": "jax.process_index() — the rank identity itself",
+    "open": "local file handle/content — disk state is per-host",
+    "exists": "os.path.exists — per-host filesystem probe",
+    "isfile": "os.path.isfile — per-host filesystem probe",
+    "isdir": "os.path.isdir — per-host filesystem probe",
+    "listdir": "os.listdir — per-host directory listing",
+    "scandir": "os.scandir — per-host directory listing",
+    "glob": "glob.glob — per-host directory listing",
+    "iglob": "glob.iglob — per-host directory listing",
+    "stat": "os.stat — per-host file metadata",
+    "getmtime": "os.path.getmtime — per-host file metadata",
+    "getsize": "os.path.getsize — per-host file metadata",
+    "read_text": "Path.read_text — per-host file content",
+    "read_bytes": "Path.read_bytes — per-host file content",
+}
+
+#: Agreement helpers: expressions passing through these are pod-agreed.
+#: The heartbeat allgather channel is the transport for all of them.
+SANITIZERS: dict[str, str] = {
+    "gather_probe": "obs/heartbeat.py — THE pod allgather channel; every "
+                    "rank receives every rank's row",
+    "process_allgather": "jax.experimental.multihost_utils — the primitive "
+                         "under gather_probe",
+    "agree_and_emit": "obs/health.py — anomaly agreement over gather_probe",
+    "_agreed_step": "io/checkpoint.py — p0 verdict broadcast over the "
+                    "heartbeat channel (row 0 IS the verdict)",
+    "_agreed_count": "io/checkpoint.py — MAX across ranks; pod-aligned "
+                     "attempt counts",
+    "_agreed_ok": "io/checkpoint.py — MIN across ranks; one rank's failure "
+                  "fails everyone together",
+    "_preemption_agreed": "train/trainer.py — preemption verdict agreed "
+                          "over process_allgather",
+    "sync_global_devices": "jax.experimental.multihost_utils — a named "
+                           "barrier every rank must reach",
+    "broadcast_one_to_all": "jax.experimental.multihost_utils — p0's value "
+                            "to every rank",
+    "BatchIterator": "data/batching.py — pod-uniform by construction: the "
+                     "epoch schedule derives from global facts (seed, "
+                     "dataset length, global batch); process_index only "
+                     "selects the local slice, so trip counts agree on "
+                     "every rank",
+}
+
+#: Collective-implying calls: every rank must reach these together.
+SINKS: dict[str, str] = {
+    # compiled SPMD program invocations — jax.jit'd multi-host programs
+    "train_step": "the compiled train step (train/step.py make_train_step)",
+    "prefill": "the compiled prefill program (evaluation/generation.py)",
+    "decode_step": "the compiled decode step (evaluation/generation.py)",
+    "generate": "the prefill+decode loop (evaluation/generation.py)",
+    "_generate": "the prefill+decode loop (evaluation/evaluate.py wrapper)",
+    # checkpoint commit/restore — multi-host directory rename + agreement
+    "save": "checkpoint save (io/checkpoint.py) — all ranks write, then "
+            "agree on the commit",
+    "restore_latest": "checkpoint restore (io/checkpoint.py) — all ranks "
+                      "read the same agreed step",
+    "restore_before": "checkpoint walk-back restore (io/checkpoint.py)",
+    "delete_after": "checkpoint GC after walk-back (io/checkpoint.py)",
+    "wait_until_finished": "async checkpoint barrier (io/checkpoint.py)",
+    # the heartbeat/agreement channel itself IS a collective
+    "beat": "obs/heartbeat.py — per-step pod heartbeat allgather",
+    "gather_probe": "obs/heartbeat.py — pod allgather channel",
+    "process_allgather": "multihost allgather primitive",
+    "agree_and_emit": "anomaly agreement ride on gather_probe",
+    "_agreed_step": "p0-verdict broadcast (heartbeat channel)",
+    "_agreed_count": "MAX agreement (heartbeat channel)",
+    "_agreed_ok": "MIN agreement (heartbeat channel)",
+    "_preemption_agreed": "preemption agreement (process_allgather)",
+    "sync_global_devices": "named multihost barrier",
+    "broadcast_one_to_all": "p0 broadcast (multihost_utils)",
+    # mesh lifecycle — every rank must (re)bootstrap together
+    "build_mesh": "core/mesh.py — device mesh construction",
+    "initialize_distributed": "jax.distributed init (core/mesh.py)",
+    "reinitialize_distributed": "elastic rebootstrap (core/mesh.py)",
+    # global batch assembly — make_array_from_process_local_data is a
+    # cross-host rendezvous on the addressable-shard layout
+    "put_batch": "train/step.py — global array assembly from local rows",
+    "make_array_from_process_local_data": "jax global-array rendezvous",
+}
+
+#: Receivers whose methods never imply pod collectives even when the
+#: attribute name collides with a sink (``np.save``, ``json.load``...).
+_NONPOD_RECEIVERS = frozenset({
+    "np", "numpy", "json", "jnp", "os", "io", "pickle", "plt", "math",
+    "struct", "shutil", "logging", "re", "random",
+})
+
+_PRAGMA_RE = re.compile(r"#\s*pod-agreed:\s*(\S.*)")
+
+_FUNCLIKE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _receiver_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return fn.value.id
+    return None
+
+
+def _dotted_name(node: ast.AST) -> str | None:
+    """``self.batches`` → "self.batches"; None for non-Name-based chains.
+    Taint is tracked on these dotted strings so assigning to one instance
+    attribute never taints the whole object."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_no_funcs(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class bodies —
+    nested defs are analyzed as their own scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, _FUNCLIKE + (ast.ClassDef,)):
+                continue
+            stack.append(child)
+
+
+def pragma_lines(src: str) -> dict[int, str]:
+    """Line number → ``# pod-agreed:`` mechanism text."""
+    out: dict[int, str] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if m:
+            out[i] = m.group(1).strip()
+    return out
+
+
+class _Region:
+    """One rank-divergent control region: why, where, and which finding
+    code a sink inside it produces."""
+
+    __slots__ = ("code", "reason", "line")
+
+    def __init__(self, code: str, reason: str, line: int):
+        self.code = code
+        self.reason = reason
+        self.line = line
+
+
+class _FunctionPass:
+    """Analyze ONE function body (or the module top level).
+
+    Flow-insensitive taint: two convergence sweeps over assignments, then
+    a structured walk of the statements tracking divergent regions and
+    divergent early exits.  Nested function bodies are skipped — the
+    driver analyzes them as their own scopes (closure taint is out of
+    scope for this pass; rank-divergent closures have no instance in the
+    tree and would taint through SOURCES locally anyway)."""
+
+    def __init__(self, rel: str, pragmas: dict[int, str], qualname: str):
+        self.rel = rel
+        self.pragmas = pragmas
+        self.qualname = qualname
+        self.tainted: dict[str, str] = {}  # name → why it is rank-local
+        self.findings: list[Finding] = []
+
+    # -- taint over expressions ------------------------------------------
+
+    def _expr_sanitized(self, expr: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Call) and _callee_name(n) in SANITIZERS
+            for n in _walk_no_funcs(expr)
+        )
+
+    def _expr_taint(self, expr: ast.AST) -> str | None:
+        """Why this expression is rank-local, or None.  An expression that
+        routes through a sanitizer call is pod-agreed regardless of what
+        feeds it — that is the whole point of the sanitizers."""
+        if self._expr_sanitized(expr):
+            return None
+        for n in _walk_no_funcs(expr):
+            if isinstance(n, ast.Name) and n.id in self.tainted:
+                return self.tainted[n.id]
+            if isinstance(n, ast.Attribute):
+                dotted = _dotted_name(n)
+                if dotted is not None and dotted in self.tainted:
+                    return self.tainted[dotted]
+            if isinstance(n, ast.Call):
+                name = _callee_name(n)
+                if name == "process_index":
+                    return "jax.process_index()"
+                if (
+                    name in SOURCES
+                    and name != "process_index"
+                    and _receiver_name(n) not in ("json", "pickle", "struct")
+                ):
+                    return f"local file I/O ({name})"
+        return None
+
+    def _assign_names(self, target: ast.AST) -> list[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, ast.Attribute):
+            dotted = _dotted_name(target)
+            return [dotted] if dotted is not None else []
+        if isinstance(target, (ast.Tuple, ast.List)):
+            names: list[str] = []
+            for elt in target.elts:
+                names += self._assign_names(elt)
+            return names
+        if isinstance(target, ast.Starred):
+            return self._assign_names(target.value)
+        return []
+
+    def _sweep_taint(self, body: list[ast.stmt]) -> None:
+        """Two passes so taint assigned below a use still propagates.
+
+        Tracks IMPLICIT flow as well as data flow: a name assigned under
+        a rank-divergent branch (or inside an except handler — the
+        per-rank exception path) holds a rank-dependent value even when
+        the right-hand side is itself pod-uniform.  This is exactly the
+        p0-only-verdict bug shape: ``ok`` computed only where
+        ``process_index() == 0`` differs per rank until broadcast."""
+        for _ in range(2):
+            self._sweep(body, None)
+
+    def _sweep(self, stmts: list[ast.stmt], ctx: str | None) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, _FUNCLIKE + (ast.ClassDef,)):
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                inner = ctx
+                why = self._expr_taint(stmt.test)
+                if why is not None and not self._waived(stmt.lineno):
+                    inner = ctx or (
+                        f"assigned under a rank-divergent branch "
+                        f"(line {stmt.lineno}: {why})"
+                    )
+                self._sweep(stmt.body, inner)
+                self._sweep(stmt.orelse, inner)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                inner = ctx
+                why = self._expr_taint(stmt.iter)
+                if why is not None and not self._waived(stmt.lineno):
+                    for name in self._assign_names(stmt.target):
+                        self.tainted.setdefault(name, why)
+                    inner = ctx or (
+                        f"assigned under a rank-divergent loop "
+                        f"(line {stmt.lineno}: {why})"
+                    )
+                self._sweep(stmt.body, inner)
+                self._sweep(stmt.orelse, inner)
+                continue
+            if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+                self._sweep(stmt.body, ctx)
+                for handler in stmt.handlers:
+                    if handler.name:
+                        self.tainted.setdefault(
+                            handler.name,
+                            f"per-rank exception binding {handler.name!r}",
+                        )
+                    inner = ctx
+                    if not self._waived(handler.lineno):
+                        inner = ctx or (
+                            "assigned inside an `except` handler (line "
+                            f"{handler.lineno}) — the per-rank exception "
+                            "path"
+                        )
+                    self._sweep(handler.body, inner)
+                self._sweep(stmt.orelse, ctx)
+                self._sweep(stmt.finalbody, ctx)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        why = self._expr_taint(item.context_expr)
+                        if why is not None:
+                            for name in self._assign_names(item.optional_vars):
+                                self.tainted.setdefault(name, why)
+                self._sweep(stmt.body, ctx)
+                continue
+            for node in _walk_no_funcs(stmt):
+                value = targets = None
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    value, targets = node.value, [node.target]
+                elif isinstance(node, ast.AugAssign):
+                    value, targets = node.value, [node.target]
+                elif isinstance(node, ast.NamedExpr):
+                    value, targets = node.value, [node.target]
+                if value is None:
+                    continue
+                names = []
+                for t in targets:
+                    names += self._assign_names(t)
+                if self._expr_sanitized(value):
+                    for name in names:
+                        self.tainted.pop(name, None)
+                    continue
+                why = self._expr_taint(value) or ctx
+                if why is not None:
+                    for name in names:
+                        self.tainted.setdefault(name, why)
+
+    # -- the structured walk ---------------------------------------------
+
+    def _waived(self, *lines: int) -> bool:
+        return any(ln in self.pragmas for ln in lines)
+
+    def _sink_calls(self, stmt: ast.stmt) -> list[tuple[str, int]]:
+        out = []
+        for n in _walk_no_funcs(stmt):
+            if isinstance(n, ast.Call):
+                name = _callee_name(n)
+                if name in SINKS and _receiver_name(n) not in _NONPOD_RECEIVERS:
+                    out.append((name, n.lineno))
+        return out
+
+    def _has_early_exit(self, body: list[ast.stmt]) -> int | None:
+        """Line of a statement that escapes ``body`` early — return/raise
+        anywhere (nested functions excluded), break/continue only when NOT
+        swallowed by a loop inside the body itself."""
+
+        def scan(stmts: list[ast.stmt], in_loop: bool) -> int | None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.Return, ast.Raise)):
+                    return stmt.lineno
+                if isinstance(stmt, (ast.Break, ast.Continue)) and not in_loop:
+                    return stmt.lineno
+                if isinstance(stmt, _FUNCLIKE + (ast.ClassDef,)):
+                    continue
+                enters_loop = isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
+                for field in ("body", "orelse", "finalbody"):
+                    hit = scan(getattr(stmt, field, []) or [],
+                               in_loop or enters_loop)
+                    if hit is not None:
+                        return hit
+                for handler in getattr(stmt, "handlers", []) or []:
+                    hit = scan(handler.body, in_loop)
+                    if hit is not None:
+                        return hit
+            return None
+
+        return scan(body, False)
+
+    def _report(self, code: str, sink: str, line: int, region: _Region) -> None:
+        self.findings.append(Finding(
+            severity="error",
+            pass_name="divergence",
+            code=code,
+            message=(
+                f"{self.rel}:{line}: collective-implying call `{sink}` "
+                f"({SINKS[sink]}) on a rank-divergent path — "
+                f"{region.reason} (branch at line {region.line}, in "
+                f"{self.qualname}); every process must reach a collective "
+                "together or the pod deadlocks.  Route the decision "
+                "through an agreement sanitizer (see "
+                "analysis/divergence.py SANITIZERS) or annotate the line "
+                "`# pod-agreed: <mechanism>`."
+            ),
+            context={
+                "file": self.rel, "line": line, "sink": sink,
+                "divergent_line": region.line, "function": self.qualname,
+            },
+        ))
+
+    def run(self, body: list[ast.stmt]) -> list[Finding]:
+        self._sweep_taint(body)
+        self._visit_body(body, None)
+        return self.findings
+
+    def _visit_body(self, body: list[ast.stmt], region: _Region | None) -> None:
+        exited: _Region | None = None
+        for stmt in body:
+            if exited is not None and not self._waived(stmt.lineno):
+                for sink, line in self._sink_calls(stmt):
+                    if not self._waived(line):
+                        self._report(
+                            "rank-divergent-early-exit", sink, line, exited,
+                        )
+            self._visit_stmt(stmt, region)
+            exited = exited or self._early_exit_region(stmt)
+
+    def _early_exit_region(self, stmt: ast.stmt) -> _Region | None:
+        """A tainted `if` whose body exits early splits the ranks: the
+        survivors run everything after it, the exiting ranks don't."""
+        if not isinstance(stmt, ast.If):
+            return None
+        why = self._expr_taint(stmt.test)
+        if why is None or self._waived(stmt.lineno):
+            return None
+        exit_line = self._has_early_exit(stmt.body)
+        if exit_line is None and stmt.orelse:
+            exit_line = self._has_early_exit(stmt.orelse)
+        if exit_line is None:
+            return None
+        return _Region(
+            "rank-divergent-early-exit",
+            f"ranks where `{ast.unparse(stmt.test)}` holds exit early "
+            f"(line {exit_line}) on a rank-local condition ({why}) while "
+            "the rest continue",
+            stmt.lineno,
+        )
+
+    def _visit_stmt(self, stmt: ast.stmt, region: _Region | None) -> None:
+        if isinstance(stmt, _FUNCLIKE + (ast.ClassDef,)):
+            return  # own scope, analyzed separately
+        if isinstance(stmt, ast.If):
+            why = self._expr_taint(stmt.test)
+            inner = region
+            if why is not None and not self._waived(stmt.lineno):
+                inner = region or _Region(
+                    "rank-divergent-collective",
+                    f"branch condition `{ast.unparse(stmt.test)}` is "
+                    f"rank-local ({why})",
+                    stmt.lineno,
+                )
+            self._visit_body(stmt.body, inner)
+            self._visit_body(stmt.orelse, inner)
+            return
+        if isinstance(stmt, ast.While):
+            why = self._expr_taint(stmt.test)
+            inner = region
+            if why is not None and not self._waived(stmt.lineno):
+                inner = region or _Region(
+                    "rank-divergent-loop",
+                    f"loop condition `{ast.unparse(stmt.test)}` is "
+                    f"rank-local ({why}) — ranks run different trip counts",
+                    stmt.lineno,
+                )
+            self._visit_body(stmt.body, inner)
+            self._visit_body(stmt.orelse, inner)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            why = self._expr_taint(stmt.iter)
+            inner = region
+            if why is not None and not self._waived(stmt.lineno):
+                inner = region or _Region(
+                    "rank-divergent-loop",
+                    f"loop iterates over `{ast.unparse(stmt.iter)}`, which "
+                    f"is rank-local ({why}) — ranks run different trip "
+                    "counts",
+                    stmt.lineno,
+                )
+            self._visit_body(stmt.body, inner)
+            self._visit_body(stmt.orelse, inner)
+            return
+        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            self._visit_body(stmt.body, region)
+            for handler in stmt.handlers:
+                inner = region
+                if not self._waived(handler.lineno):
+                    inner = region or _Region(
+                        "rank-divergent-collective",
+                        "inside an `except` handler — an exception exists "
+                        "only on the ranks that threw, so this path runs "
+                        "on a strict subset of the pod (capture the error "
+                        "and agree on it after the try/except instead)",
+                        handler.lineno,
+                    )
+                self._visit_body(handler.body, inner)
+            self._visit_body(stmt.orelse, region)
+            self._visit_body(stmt.finalbody, region)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._visit_body(stmt.body, region)
+            return
+        # leaf statement: report sinks when we are inside a divergent region
+        if region is not None and not self._waived(stmt.lineno):
+            for sink, line in self._sink_calls(stmt):
+                if not self._waived(line):
+                    self._report(region.code, sink, line, region)
+
+
+def _functions(tree: ast.Module) -> Iterable[tuple[str, list[ast.stmt]]]:
+    """Every analyzable scope in the module: the top level plus each
+    (possibly nested) function body, with a readable qualname."""
+    yield "<module>", tree.body
+
+    def rec(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child.body
+                yield from rec(child, f"{q}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, f"{prefix}{child.name}.")
+            else:
+                yield from rec(child, prefix)
+
+    yield from rec(tree, "")
+
+
+def _may_diverge(body: list[ast.stmt]) -> bool:
+    """One cheap pre-walk: a scope with no rank-local SOURCE call and no
+    ``try`` (the per-rank exception path) can produce no taint, hence no
+    divergent region, hence no finding — skip the full pass.  Nested
+    function bodies are excluded exactly as the pass excludes them."""
+    for stmt in body:
+        for n in _walk_no_funcs(stmt):
+            if isinstance(n, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+                return True
+            if isinstance(n, ast.Call) and _callee_name(n) in SOURCES:
+                return True
+    return False
+
+
+def analyze_source(src: str, rel: str) -> list[Finding]:
+    """Run the divergence pass over one file's source text."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(
+            "warning", "divergence", "unparseable",
+            f"{rel}: not analyzable: {e}",
+            context={"file": rel},
+        )]
+    pragmas = pragma_lines(src)
+    findings: list[Finding] = []
+    for qualname, body in _functions(tree):
+        if _may_diverge(body):
+            findings += _FunctionPass(rel, pragmas, qualname).run(body)
+    findings.sort(key=lambda f: f.context.get("line", 0))
+    return findings
+
+
+def analyze_file(path: str, rel: str | None = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    return analyze_source(src, rel or path)
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+_TREE_CACHE: dict[str, tuple[tuple[Finding, ...], int]] = {}
+
+
+def analyze_tree(root: str | None = None) -> tuple[list[Finding], int]:
+    """The whole-package pass: (findings, files_scanned).  ``root``
+    defaults to the installed ``distributed_llms_example_tpu`` package.
+    Results are cached per root: the startup lint runs once per trainer
+    AND once per serve engine in the same process, over a tree that
+    cannot change under a running process."""
+    root = os.path.abspath(root or package_root())
+    if root in _TREE_CACHE:
+        cached, scanned = _TREE_CACHE[root]
+        return list(cached), scanned
+    base = os.path.dirname(root)
+    findings: list[Finding] = []
+    scanned = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            findings += analyze_file(path, os.path.relpath(path, base))
+            scanned += 1
+    _TREE_CACHE[root] = (tuple(findings), scanned)
+    return findings, scanned
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone entry point (the lint driver is the normal surface)."""
+    import argparse
+
+    from distributed_llms_example_tpu.analysis.findings import (
+        count_by_severity, emit,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="dllm-divergence",
+        description="SPMD divergence lint (Layer 1 of the pod-agreement "
+                    "static analysis)",
+    )
+    p.add_argument("--root", default="", help="tree to scan (default: the "
+                   "distributed_llms_example_tpu package)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    findings, scanned = analyze_tree(args.root or None)
+    emit(findings, as_json=args.json)
+    counts = count_by_severity(findings)
+    print(
+        f"divergence: {scanned} file(s), {counts['error']} error(s), "
+        f"{counts['warning']} warning(s)"
+    )
+    return 1 if counts["error"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

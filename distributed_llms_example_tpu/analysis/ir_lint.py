@@ -1091,8 +1091,14 @@ def lint_train_step(
     optim_impl: str = "",
     grad_compression: str = "",
     gather_bytes_threshold: int = 16 * 1024**2,
+    collect: "dict[str, str] | None" = None,
+    program: str = "train_step",
 ) -> list[Finding]:
     """AOT-compile the sharded train step from abstract args and scan it.
+
+    ``collect`` (divergence census mode): the post-optimization HLO text
+    is stored under ``collect[program]`` so the cross-program collective
+    census reads the SAME compile this pass scanned.
 
     ``optim_impl`` builds the step with that optimizer apply (e.g.
     ``"fused"`` — the Pallas clip+AdamW path); the fused program is
@@ -1123,6 +1129,8 @@ def lint_train_step(
         optim_impl=optim_impl, grad_compression=grad_compression,
     )
     text = compiled.as_text()
+    if collect is not None:
+        collect[program] = text
     leaves = jax.tree.leaves(a_params)
     largest_param = max(
         (int(math.prod(x.shape)) * x.dtype.itemsize for x in leaves),
@@ -1196,6 +1204,9 @@ def lint_decode_step(
     max_new_tokens: int = 16,
     dtype: str = "float32",
     kv_cache_dtype: str = "",
+    collect: "dict[str, str] | None" = None,
+    program: str = "decode",
+    prefill_program: str = "",
 ) -> list[Finding]:
     """AOT-compile the SERVING decode step (the per-token program of the
     prefill/decode split, evaluation/generation.py) from abstract args and
@@ -1233,7 +1244,17 @@ def lint_decode_step(
     with activation_mesh(mesh), kv_cache_context(kv_cache_dtype or "f32"):
         a_carry = jax.eval_shape(gen.prefill, a_params, ids, mask)
         compiled = jax.jit(gen.decode_step).lower(a_params, a_carry).compile()
+        if collect is not None and prefill_program:
+            # census mode also wants the PREFILL program's signature —
+            # compiled from the same abstract args, the other half of the
+            # prefill/decode pair the census cross-checks
+            collect[prefill_program] = (
+                jax.jit(gen.prefill).lower(a_params, ids, mask)
+                .compile().as_text()
+            )
     text = compiled.as_text()
+    if collect is not None:
+        collect[program] = text
     # causal decode attends the full prompt+generation cache width; a
     # re-run prompt pass shows up at the same width
     enc_len = src_len if lm.is_seq2seq else src_len + max_new_tokens
@@ -1263,3 +1284,222 @@ def skipped(reason: str) -> list[Finding]:
         code="ir-pass-skipped",
         message=f"lowered-program lint skipped: {reason}",
     )]
+
+
+# --------------------------------------------------------------------------
+# Layer 2 of the pod-agreement static analysis: the cross-program
+# collective-matching census.  Every AOT-compiled program in the lint set
+# (train step across accum/compression variants, prefill, decode, the
+# reshard-restore target) is reduced to its ORDERED collective signature —
+# (op kind, replica_groups, channel id, operand bytes) in program text
+# order — and the census errors on nondeterministic ordering (two compiles
+# of the same program disagree) or on paired programs whose worker-group
+# factorizations are incompatible (e.g. expert all-to-all groups vs
+# --grad-compression worker groups slicing the same devices differently).
+# Layer 1 — the host-AST divergence lint — lives in analysis/divergence.py.
+# --------------------------------------------------------------------------
+
+_CHANNEL_ID_RE = re.compile(r"channel_id=(\d+)")
+# newer XLA also prints the iota form: replica_groups=[4,2]<=[8]
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(?P<dims>[0-9,]+)\]<=\[(?P<perm>[0-9,()T]+)\]"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSig:
+    """One collective in a compiled program's ordered signature."""
+
+    op: str            # base kind ("all-reduce", "reduce-scatter", ...)
+    groups: str        # canonical replica_groups text ("" when absent)
+    channel_id: int    # -1 when the op carries no channel
+    operand_bytes: int  # summed operand buffer bytes (wire payload proxy)
+
+
+def _canonical_groups(line: str) -> str:
+    m = _REPLICA_GROUPS_RE.search(line)
+    if m:
+        return m.group(1).replace(" ", "")
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        return f"[{m.group('dims')}]<=[{m.group('perm')}]"
+    return ""
+
+
+def collective_signature(
+    hlo: "str | Mapping[str, HloInstr]",
+) -> tuple[CollectiveSig, ...]:
+    """The ordered collective signature of one compiled program.
+
+    Order is post-optimization text order (the scheduler's order — what
+    every device executes); ``-done`` halves of async pairs are dropped so
+    each collective counts once, at its issue point."""
+    instrs = parse_hlo_instructions(hlo) if isinstance(hlo, str) else hlo
+    sigs: list[CollectiveSig] = []
+    for instr in instrs.values():
+        base = base_collective_op(instr.op)
+        if base is None or instr.op.split(".", 1)[0].endswith("-done"):
+            continue
+        ch = _CHANNEL_ID_RE.search(instr.line)
+        operand_bytes = sum(
+            instrs[o].bytes for o in instr.operands if o in instrs
+        ) or instr.bytes
+        sigs.append(CollectiveSig(
+            op=base,
+            groups=_canonical_groups(instr.line),
+            channel_id=int(ch.group(1)) if ch else -1,
+            operand_bytes=operand_bytes,
+        ))
+    return tuple(sigs)
+
+
+def parse_group_partition(groups: str) -> tuple[tuple[int, ...], ...] | None:
+    """Explicit replica_groups text → partition of device ids, or None
+    for empty/iota/world groups (world groups partition trivially)."""
+    if not groups or "<=" in groups:
+        return None
+    out = []
+    for grp in re.findall(r"\{([0-9,\s]*)\}", groups):
+        ids = tuple(int(x) for x in grp.split(",") if x.strip())
+        if ids:
+            out.append(ids)
+    return tuple(out) or None
+
+
+def canonical_partition_text(partition: tuple[tuple[int, ...], ...]) -> str:
+    """Order-independent rendering: groups sorted by first member, ids
+    sorted within each group — two collectives whose groups enumerate the
+    same partition in different order are the SAME factorization."""
+    groups = sorted(tuple(sorted(g)) for g in partition)
+    return ",".join("{" + ",".join(str(i) for i in g) + "}" for g in groups)
+
+
+def partitions_compatible(
+    p: tuple[tuple[int, ...], ...], q: tuple[tuple[int, ...], ...],
+) -> bool:
+    """Two worker-group factorizations of the same device set commute iff
+    every pairwise intersection has ONE uniform size (mesh-axis-derived
+    partitions always do: |p∩q| is 0 or the product of the shared axes).
+    A hand-rolled grouping that straddles the other's groups unevenly —
+    the expert-a2a-vs-compression-worker hazard — fails this."""
+    sizes = {
+        len(set(a) & set(b))
+        for a in p for b in q
+        if set(a) & set(b)
+    }
+    return len(sizes) <= 1
+
+
+def signature_order_finding(
+    program: str,
+    first: tuple[CollectiveSig, ...],
+    second: tuple[CollectiveSig, ...],
+) -> Finding | None:
+    """Two independent compiles of the same program must schedule the same
+    collective sequence — rank k's executable is built on rank k from the
+    same inputs, so ANY compile-time nondeterminism here is a pod-scale
+    mismatched-collective hang waiting for a cache miss."""
+    if first == second:
+        return None
+    diverge = next(
+        (i for i, (a, b) in enumerate(zip(first, second)) if a != b),
+        min(len(first), len(second)),
+    )
+    return Finding(
+        severity="error",
+        pass_name="ir",
+        code="nondeterministic-collective-order",
+        message=(
+            f"{program}: two compiles of the same program disagree on the "
+            f"collective sequence (lengths {len(first)} vs {len(second)}, "
+            f"first divergence at position {diverge}) — per-rank "
+            "compilation would execute mismatched collectives and hang "
+            "the pod",
+        ),
+        context={"program": program, "position": diverge},
+    )
+
+
+def census_findings(
+    signatures: Mapping[str, tuple[CollectiveSig, ...]],
+    pairs: Iterable[tuple[str, str]] = (),
+) -> list[Finding]:
+    """The cross-program collective-matching census.
+
+    - per program: an info ``collective-signature`` row (count + op
+      histogram + distinct factorizations) — the operator-readable census.
+    - within each program: every pair of distinct explicit factorizations
+      must be compatible (``partitions_compatible``) — error
+      ``collective-group-incompatible``.
+    - across each requested pair of programs: the union of their
+      factorizations must stay pairwise compatible — error
+      ``collective-group-mismatch`` (paired programs run back-to-back
+      over the same devices; incompatible worker groupings mean the two
+      programs disagree about which ranks move together).
+    """
+    findings: list[Finding] = []
+    facts: dict[str, dict[str, tuple[tuple[int, ...], ...]]] = {}
+    for name, sigs in signatures.items():
+        ops: dict[str, int] = {}
+        for s in sigs:
+            ops[s.op] = ops.get(s.op, 0) + 1
+        fact: dict[str, tuple[tuple[int, ...], ...]] = {}
+        for s in sigs:
+            partition = parse_group_partition(s.groups)
+            if partition is not None:
+                fact[canonical_partition_text(partition)] = partition
+        facts[name] = fact
+        findings.append(Finding(
+            severity="info",
+            pass_name="ir",
+            code="collective-signature",
+            message=(
+                f"{name}: {len(sigs)} collective(s) "
+                f"[{', '.join(f'{k}x{v}' for k, v in sorted(ops.items()))}]"
+                f", {len(fact)} distinct replica-group factorization(s)"
+            ),
+            context={
+                "program": name,
+                "collectives": len(sigs),
+                "ops": ops,
+                "factorizations": sorted(fact),
+            },
+        ))
+        keys = sorted(fact)
+        for i, ga in enumerate(keys):
+            for gb in keys[i + 1:]:
+                if not partitions_compatible(fact[ga], fact[gb]):
+                    findings.append(Finding(
+                        severity="error",
+                        pass_name="ir",
+                        code="collective-group-incompatible",
+                        message=(
+                            f"{name}: replica-group factorizations "
+                            f"{ga} and {gb} straddle each other unevenly "
+                            "— two collectives in ONE program disagree "
+                            "about which ranks move together (the "
+                            "expert-all-to-all vs compression-worker "
+                            "hazard)"
+                        ),
+                        context={"program": name, "groups": [ga, gb]},
+                    ))
+    for a, b in pairs:
+        if a not in facts or b not in facts:
+            continue
+        for ga, pa in sorted(facts[a].items()):
+            for gb, pb in sorted(facts[b].items()):
+                if not partitions_compatible(pa, pb):
+                    findings.append(Finding(
+                        severity="error",
+                        pass_name="ir",
+                        code="collective-group-mismatch",
+                        message=(
+                            f"{a} and {b}: worker-group factorizations "
+                            f"disagree ({ga} vs {gb}) — paired programs "
+                            "run over the same devices and must slice "
+                            "them compatibly, or the two programs' "
+                            "collectives imply different pod groupings"
+                        ),
+                        context={"programs": [a, b], "groups": [ga, gb]},
+                    ))
+    return findings

@@ -21,7 +21,12 @@ import argparse
 import json
 from typing import Any
 
-from distributed_llms_example_tpu.analysis import composition, ir_lint, spec_lint
+from distributed_llms_example_tpu.analysis import (
+    composition,
+    divergence as divergence_mod,
+    ir_lint,
+    spec_lint,
+)
 from distributed_llms_example_tpu.analysis.findings import (
     Finding,
     count_by_severity,
@@ -85,8 +90,15 @@ def run_passes(
     kv_cache_dtype: str = "",
     prefill_buckets: tuple = (),
     reshard_from: Any = None,
+    divergence: bool = False,
 ) -> list[Finding]:
-    """The three passes over one (model, mesh, config) triple."""
+    """The analysis passes over one (model, mesh, config) triple.
+
+    ``divergence`` adds the pod-agreement analysis: Layer 1 (the host-AST
+    SPMD divergence lint, analysis/divergence.py) always; Layer 2 (the
+    cross-program collective census over extra AOT-compiled variants,
+    ir_lint.census_findings) when the IR pass runs.  On by default under
+    ``--strict``."""
     import jax
 
     from distributed_llms_example_tpu.models.registry import load_model
@@ -230,31 +242,103 @@ def run_passes(
         ) | set(serve_flags),
     )
 
+    # Layer 1 of the pod-agreement analysis — the host-AST SPMD divergence
+    # lint over the whole package.  Pure AST, no devices, milliseconds:
+    # runs on every surface that asks for it (CLI --divergence/--strict,
+    # trainer/serve startup lint).
+    if divergence:
+        div_findings, div_files = divergence_mod.analyze_tree()
+        findings += div_findings
+        findings.append(Finding(
+            severity="info",
+            pass_name="divergence",
+            code="lint-coverage",
+            message=(
+                f"divergence pass scanned {div_files} file(s), "
+                f"{sum(1 for f in div_findings if f.severity == 'error')} "
+                "error(s)"
+            ),
+            context={"pass": "divergence", "files_scanned": div_files},
+        ))
+
     # Pass 2 — lowered-program lint (needs real devices for the SPMD
-    # partitioner; also meaningless for combos pass 3 already condemned)
+    # partitioner; also meaningless for combos pass 3 already condemned).
+    # Every AOT-compiled program in the lint set is tracked by NAME in the
+    # coverage block: a program that cannot compile on this jax version or
+    # host appears as a skipped_programs entry with its reason, never as a
+    # silent gap that makes smell coverage look complete when it isn't.
+    widths: tuple[int, ...] = ()
+    if serve:
+        widths = tuple(
+            int(b) for b in prefill_buckets if 0 < int(b) < src_len
+        ) + (src_len,)
+    accum_variant = 2 if grad_accum_steps == 1 else 1
+    comp_tag = f",{grad_compression}" if grad_compression and grad_compression != "off" else ""
+    train_program = f"train_step[accum={grad_accum_steps}{comp_tag}]"
+    planned: list[str] = [train_program]
+    if divergence:
+        planned.append(f"train_step[accum={accum_variant}{comp_tag}]")
+    if serve:
+        for width in widths:
+            if divergence:
+                planned.append(f"prefill[bucket={width}]")
+            planned.append(f"decode[bucket={width}]")
+    if divergence and reshard_from is not None:
+        planned.append("train_step[reshard-saved]")
+    programs_scanned: list[str] = []
+    programs_skipped: list[dict[str, str]] = []
+
+    def skip_all(reason: str) -> None:
+        findings.extend(ir_lint.skipped(reason))
+        programs_skipped.extend(
+            {"program": name, "reason": reason} for name in planned
+        )
+
+    mesh_size = 1
+    for v in axis_sizes.values():
+        mesh_size *= v
     if not run_ir:
-        findings += ir_lint.skipped("--no-ir")
+        skip_all("--no-ir")
     elif has_errors(findings):
-        findings += ir_lint.skipped("spec/composition errors make the compile moot")
+        skip_all("spec/composition errors make the compile moot")
     elif pipelined:
-        findings += ir_lint.skipped(
-            "stage>1 pipelines lower through shard_map schedules; IR smell "
-            "patterns for them are an open ROADMAP item"
+        skip_all(
+            "stage>1 pipelines lower through shard_map schedules on "
+            "jax-0.4.37; IR smell patterns for them are an open ROADMAP "
+            "item"
+        )
+    elif mesh_size > jax.device_count():
+        skip_all(
+            f"mesh size {mesh_size} exceeds attached device count "
+            f"{jax.device_count()} (run under "
+            f"--xla_force_host_platform_device_count={mesh_size})"
         )
     else:
-        mesh_size = 1
-        for v in axis_sizes.values():
-            mesh_size *= v
-        if mesh_size > jax.device_count():
-            findings += ir_lint.skipped(
-                f"mesh size {mesh_size} exceeds attached device count "
-                f"{jax.device_count()} (run under "
-                f"--xla_force_host_platform_device_count={mesh_size})"
-            )
-        else:
-            from distributed_llms_example_tpu.core.config import MeshConfig
+        from distributed_llms_example_tpu.core.config import MeshConfig
 
-            findings += ir_lint.lint_train_step(
+        hlo_texts: dict[str, str] | None = {} if divergence else None
+        findings += ir_lint.lint_train_step(
+            model,
+            mesh_config=MeshConfig(**axis_sizes),
+            global_batch=global_batch,
+            src_len=src_len,
+            tgt_len=tgt_len,
+            dtype=dtype,
+            remat=remat,
+            grad_accum_steps=grad_accum_steps,
+            optim_impl=optim_impl,
+            grad_compression=grad_compression,
+            collect=hlo_texts,
+            program=train_program,
+        )
+        programs_scanned.append(train_program)
+        census_pairs: list[tuple[str, str]] = []
+        if divergence:
+            # determinism probe: a SECOND independent compile of the base
+            # train step must schedule the identical collective sequence
+            # (per-rank compilation + nondeterministic ordering = pod hang)
+            recompile: dict[str, str] = {}
+            ir_lint.lint_train_step(
                 model,
                 mesh_config=MeshConfig(**axis_sizes),
                 global_batch=global_batch,
@@ -265,32 +349,139 @@ def run_passes(
                 grad_accum_steps=grad_accum_steps,
                 optim_impl=optim_impl,
                 grad_compression=grad_compression,
+                collect=recompile,
+                program=train_program,
             )
-            if serve:
-                # the compiled SERVING decode step(s): no encoder
-                # recompute, no per-step cross-KV re-projection
-                # (prefill-in-decode), s8 cache operands under int8 — one
-                # compile per admission bucket, since each bucket's
-                # prefill carry shapes its own decode step
-                widths = tuple(
-                    int(b) for b in prefill_buckets if 0 < int(b) < src_len
-                ) + (src_len,)
-                for width in widths:
-                    findings += ir_lint.lint_decode_step(
-                        model,
-                        mesh_config=MeshConfig(**axis_sizes),
-                        slots=global_batch,
-                        src_len=width,
-                        max_new_tokens=tgt_len,
-                        dtype=dtype,
-                        kv_cache_dtype=kv_cache_dtype,
-                    )
+            order = ir_lint.signature_order_finding(
+                train_program,
+                ir_lint.collective_signature(hlo_texts[train_program]),
+                ir_lint.collective_signature(recompile[train_program]),
+            )
+            if order is not None:
+                findings.append(order)
+            # the accum twin: grad accumulation must not change WHICH
+            # worker groups move together, only how often — its smell
+            # findings are discarded (the operator's program is the base;
+            # the twin exists for the census pairing)
+            twin = f"train_step[accum={accum_variant}{comp_tag}]"
+            ir_lint.lint_train_step(
+                model,
+                mesh_config=MeshConfig(**axis_sizes),
+                global_batch=global_batch,
+                src_len=src_len,
+                tgt_len=tgt_len,
+                dtype=dtype,
+                remat=remat,
+                grad_accum_steps=accum_variant,
+                optim_impl=optim_impl,
+                grad_compression=grad_compression,
+                collect=hlo_texts,
+                program=twin,
+            )
+            programs_scanned.append(twin)
+            census_pairs.append((train_program, twin))
+        if serve:
+            # the compiled SERVING decode step(s): no encoder recompute,
+            # no per-step cross-KV re-projection (prefill-in-decode), s8
+            # cache operands under int8 — one compile per admission
+            # bucket, since each bucket's prefill carry shapes its own
+            # decode step
+            for width in widths:
+                decode_name = f"decode[bucket={width}]"
+                prefill_name = f"prefill[bucket={width}]" if divergence else ""
+                findings += ir_lint.lint_decode_step(
+                    model,
+                    mesh_config=MeshConfig(**axis_sizes),
+                    slots=global_batch,
+                    src_len=width,
+                    max_new_tokens=tgt_len,
+                    dtype=dtype,
+                    kv_cache_dtype=kv_cache_dtype,
+                    collect=hlo_texts,
+                    program=decode_name,
+                    prefill_program=prefill_name,
+                )
+                if prefill_name:
+                    programs_scanned.append(prefill_name)
+                    census_pairs.append((prefill_name, decode_name))
+                    census_pairs.append((train_program, decode_name))
+                programs_scanned.append(decode_name)
+        if divergence and reshard_from is not None:
+            # the reshard-restore TARGET is this mesh's train step (the
+            # base program above); the SAVED topology's program joins the
+            # census only when it can compile here — and pairs with the
+            # target only when both slice the same device world
+            saved_axes = dict(reshard_from.get("axes", {})) if isinstance(
+                reshard_from, dict) else _resolve_axis_sizes(reshard_from)
+            saved_size = 1
+            for v in saved_axes.values():
+                saved_size *= max(1, int(v))
+            name = "train_step[reshard-saved]"
+            if saved_axes.get("stage", 1) > 1:
+                programs_skipped.append({
+                    "program": name,
+                    "reason": "saved topology is pipelined (stage>1): no "
+                              "IR lowering on jax-0.4.37",
+                })
+            elif saved_size > jax.device_count():
+                programs_skipped.append({
+                    "program": name,
+                    "reason": f"saved mesh size {saved_size} exceeds "
+                              f"attached device count {jax.device_count()}",
+                })
+            else:
+                ir_lint.lint_train_step(
+                    model,
+                    mesh_config=MeshConfig(**saved_axes),
+                    global_batch=global_batch,
+                    src_len=src_len,
+                    tgt_len=tgt_len,
+                    dtype=dtype,
+                    remat=remat,
+                    grad_accum_steps=grad_accum_steps,
+                    optim_impl=optim_impl,
+                    grad_compression=grad_compression,
+                    collect=hlo_texts,
+                    program=name,
+                )
+                programs_scanned.append(name)
+                if saved_size == mesh_size:
+                    census_pairs.append((train_program, name))
+        if divergence and hlo_texts:
+            findings += ir_lint.census_findings(
+                {
+                    n: ir_lint.collective_signature(text)
+                    for n, text in hlo_texts.items()
+                },
+                census_pairs,
+            )
+    findings.append(Finding(
+        severity="info",
+        pass_name="ir",
+        code="lint-coverage",
+        message=(
+            f"ir pass scanned {len(programs_scanned)} program(s), "
+            f"skipped {len(programs_skipped)}"
+            + (
+                " — " + "; ".join(
+                    f"{e['program']}: {e['reason']}" for e in programs_skipped
+                ) if programs_skipped else ""
+            )
+        ),
+        context={
+            "pass": "ir",
+            "programs_scanned": programs_scanned,
+            "programs_skipped": programs_skipped,
+        },
+    ))
     return findings
 
 
 def startup_lint(cfg: Any) -> list[Finding]:
     """Trainer-startup surface (launch/cli.py): passes 1 and 3 from the
-    resolved TrainConfig — no AOT compile, milliseconds not minutes."""
+    resolved TrainConfig — no AOT compile, milliseconds not minutes —
+    plus Layer 1 of the pod-agreement analysis (the AST divergence lint;
+    the HLO census needs the compile pass and stays on the CLI)."""
     return run_passes(
         model=cfg.model_ckpt,
         mesh_cfg=cfg.mesh,
@@ -303,6 +494,7 @@ def startup_lint(cfg: Any) -> list[Finding]:
         dtype=cfg.compute_dtype,
         remat=cfg.remat,
         grad_accum_steps=cfg.grad_accum_steps,
+        divergence=True,
     )
 
 
@@ -372,8 +564,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "--reshard-from (0 = no EF tree in the payload)")
     p.add_argument("--no-ir", action="store_true",
                    help="skip the lowered-program pass (no AOT compile)")
+    p.add_argument("--divergence", action="store_true",
+                   help="run the pod-agreement analysis: the host-AST SPMD "
+                        "divergence lint (rank-divergent branches feeding "
+                        "collectives) and, with the IR pass, the "
+                        "cross-program collective-matching census over the "
+                        "compiled lint set; implied by --strict")
     p.add_argument("--strict", action="store_true",
-                   help="warnings also fail the run")
+                   help="warnings also fail the run (implies --divergence)")
     p.add_argument("--json", action="store_true", help="JSON-lines output")
     return p
 
@@ -444,13 +642,29 @@ def main(argv: list[str] | None = None) -> int:
                 int(b) for b in args.prefill_buckets.split(",") if b.strip()
             ),
             reshard_from=reshard_from,
+            divergence=args.divergence or args.strict,
         )
     emit(findings, as_json=args.json)
     counts = count_by_severity(findings)
+    coverage = [f for f in findings if f.code == "lint-coverage"]
     if args.json:
         from distributed_llms_example_tpu.utils.jsonlog import log_json
 
-        log_json({"event": "lint_summary", **counts})
+        # the per-pass coverage block: what was scanned and — by NAME,
+        # with a reason — what was not, so a skipped program can never
+        # read as covered
+        for f in coverage:
+            log_json({"event": "lint_coverage", **f.context})
+        log_json({
+            "event": "lint_summary",
+            **counts,
+            "programs_scanned": sum(
+                len(f.context.get("programs_scanned", ())) for f in coverage
+            ),
+            "programs_skipped": sum(
+                len(f.context.get("programs_skipped", ())) for f in coverage
+            ),
+        })
     else:
         print(
             f"lint: {counts['error']} error(s), {counts['warning']} "

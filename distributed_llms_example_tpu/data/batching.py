@@ -220,7 +220,7 @@ class BatchIterator:
             batches = batches[start_step:]
         import jax
 
-        if self.process_count > 1 and jax.process_count() > 1:
+        if self.process_count > 1 and jax.process_count() > 1:  # pod-agreed: pod-uniform guard; the branch body is the once-per-epoch agreement allgather every rank joins
             # Real multi-host: eager local maxima (tokenizes only this
             # host's 1/P slice; memoized in the dataset so the cost is
             # once per run), then ONE agreement allgather per epoch on the

@@ -39,7 +39,7 @@ def host_rows(arr: Any) -> np.ndarray:
     local slice (train-accelerator.py:257-258) without moving other hosts'
     rows over DCN.
     """
-    if jax.process_count() == 1:
+    if jax.process_count() == 1:  # pod-agreed: process_count() is pod-uniform; single-host fast path
         return np.asarray(jax.device_get(arr))
     by_start: dict[int, np.ndarray] = {}
     for s in arr.addressable_shards:
@@ -136,7 +136,7 @@ class Evaluator:
             out = self._generate(params, gb["input_ids"], gb["attention_mask"])
             labels = batch["labels"]
             labels = np.where(labels == LABEL_PAD, self.config.pad_token_id, labels)
-            if jax.process_count() == 1:
+            if jax.process_count() == 1:  # pod-agreed: process_count() is pod-uniform fast path
                 local_ids = host_rows(out)[lo : lo + per_host]
             else:
                 local_ids = host_rows(out)

@@ -20,7 +20,7 @@ PASSTHROUGH_KEYS = ("epoch", "step")  # parity with train-task.py:214 ('epoch' t
 def aggregate_mean(metrics: Mapping[str, float]) -> dict[str, float]:
     """Mean of each metric across processes (pass-through for epoch/step)."""
     out = {k: float(v) for k, v in metrics.items()}
-    if jax.process_count() == 1:
+    if jax.process_count() == 1:  # pod-agreed: process_count() is pod-uniform; the multi-host allgather below runs on every rank
         return out
     from jax.experimental import multihost_utils
 

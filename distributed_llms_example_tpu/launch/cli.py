@@ -205,7 +205,7 @@ def _serve_setup(args, *, extra_flags: tuple = ()):
     from distributed_llms_example_tpu.models.registry import load_model
     from distributed_llms_example_tpu.parallel.sharding import shard_params
 
-    if jax.process_count() > 1:
+    if jax.process_count() > 1:  # pod-agreed: pod-uniform guard; every rank fails fast together
         raise SystemExit(
             "the serving engine is single-controller; run one process "
             "(the serve-router replica pool is in-process — multi-host "
@@ -278,6 +278,15 @@ def _serve_setup(args, *, extra_flags: tuple = ()):
             flags=("decode", "seq2seq" if lm.is_seq2seq else "causal")
             + tuple(extra_flags),
         )
+        # Layer 1 of the pod-agreement analysis: a rank-divergent branch
+        # into a collective hangs the serve replica pool the same way it
+        # hangs a train pod — same AST pass as the trainer startup lint
+        from distributed_llms_example_tpu.analysis.divergence import (
+            analyze_tree as divergence_tree,
+        )
+
+        div_findings, _ = divergence_tree()
+        findings += div_findings
         emit_findings(findings, as_json=True)
         if args.lint == "strict" and has_errors(findings):
             raise SystemExit(

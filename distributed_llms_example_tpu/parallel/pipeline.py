@@ -187,7 +187,9 @@ def gather_tree_to_host(tree, *, writer_only: bool = False):
     drop = writer_only and jax.process_count() > 1 and jax.process_index() != 0
 
     def to_host(x):
-        if jax.process_count() > 1 and hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        if (  # pod-agreed: process_count() is pod-uniform; the per-leaf allgather below runs on every rank
+            jax.process_count() > 1 and hasattr(x, "is_fully_addressable") and not x.is_fully_addressable
+        ):
             from jax.experimental import multihost_utils
 
             g = np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -907,6 +907,6 @@ def put_batch(batch: dict, mesh: Mesh, *, sequence_sharded: bool = False) -> dic
     whole because decode steps are length-1).
     """
     sh = batch_sharding(mesh, sequence_sharded=sequence_sharded)
-    if jax.process_count() == 1:
+    if jax.process_count() == 1:  # pod-agreed: process_count() is pod-uniform; single-host fast path
         return {k: jax.device_put(v, sh) for k, v in batch.items()}
     return {k: jax.make_array_from_process_local_data(sh, v) for k, v in batch.items()}

@@ -574,7 +574,7 @@ class Trainer:
         # Only written when storage is actually permuted — the guard's
         # missing-sidecar default IS the standard layout, so a sidecar for
         # it would add nothing (and litter every plain run's output dir)
-        if permuted and jax.process_index() == 0:
+        if permuted and jax.process_index() == 0:  # pod-agreed: p0-only LOCAL sidecar write; no collectives in branch
             os.makedirs(ckpt_dir, exist_ok=True)
             with open(self._ckpt_layout_path, "w") as f:
                 json.dump(self._ckpt_layout, f)
@@ -739,7 +739,7 @@ class Trainer:
             # corruption must be caught by integrity verification, not by
             # an unluckily torn write orbax happens to notice
             self.checkpointer.wait()
-            if jax.process_index() == 0:
+            if jax.process_index() == 0:  # pod-agreed: chaos injection corrupts p0's local file only; no collectives in branch
                 from distributed_llms_example_tpu.obs.chaos import corrupt_checkpoint
 
                 corrupt_checkpoint(self.checkpointer.step_dir(step))
@@ -762,7 +762,7 @@ class Trainer:
         and the quarantine survives the restart (the dropout-RNG snapshot
         stays in-memory only: bit-exact replay is a same-process
         property).  GC'd with the step by io/checkpoint.py."""
-        if jax.process_index() != 0:
+        if jax.process_index() != 0:  # pod-agreed: p0-only LOCAL sidecar write; no collectives after the early return
             return
         payload = {
             "step": int(step),
@@ -1323,7 +1323,7 @@ class Trainer:
         for ax in ("data", "fsdp", "expert"):
             shards *= self.mesh.shape.get(ax, 1)
         quantum = shards * getattr(self.model, "num_microbatches", 1)
-        if quantum % jax.process_count():
+        if quantum % jax.process_count():  # pod-agreed: arithmetic on the pod-uniform process count
             quantum *= jax.process_count()
         eval_batch = max(self.cfg.eval_batch_size or self.cfg.batch_size, quantum)
         eval_batch -= eval_batch % quantum
@@ -1442,7 +1442,7 @@ class Trainer:
         issues the next step's collectives (pod-wide deadlock).  All hosts
         agree via an allgather of the local flag (any host signaled →
         everyone stops).  Single-process: just the flag."""
-        if jax.process_count() == 1:
+        if jax.process_count() == 1:  # pod-agreed: process_count() is pod-uniform; single-host fast path
             return self._preempted
         from jax.experimental import multihost_utils
 
@@ -1458,7 +1458,7 @@ class Trainer:
         identical on all hosts, so they always enter the allgather
         together; a SIGTERM is acted on at most ``log_every_steps`` steps
         late, well inside any preemption grace period (tens of seconds)."""
-        if jax.process_count() == 1:
+        if jax.process_count() == 1:  # pod-agreed: process_count() is pod-uniform; single-host fast path
             return self._preempted
         if step % self._preempt_sync_every != 0:
             return False
@@ -1592,7 +1592,7 @@ class Trainer:
         ``host_loss@K`` schedule is deterministic across ranks, so the
         allgather is the same belt the preemption flag wears, not the
         mechanism.)"""
-        if jax.process_count() == 1:
+        if jax.process_count() == 1:  # pod-agreed: process_count() is pod-uniform; single-host fast path
             return self._host_lost
         if step % self._preempt_sync_every != 0:
             return False
@@ -2045,7 +2045,7 @@ class Trainer:
             # collectives).  Every host reaches this point at the same
             # step, so an unconditional agreement round is collectively
             # safe; mid-epoch agreed breaks re-agree here (still true).
-            if jax.process_count() > 1:
+            if jax.process_count() > 1:  # pod-agreed: pod-uniform guard; the branch body IS the agreement (_preemption_agreed)
                 self._preempted = self._preemption_agreed()
             if self._preempted or self._anomaly_action is not None:
                 break
@@ -2151,7 +2151,7 @@ class Trainer:
             from distributed_llms_example_tpu.parallel.pipeline import gather_tree_to_host
 
             final_params = gather_tree_to_host(final_params, writer_only=True)
-        if jax.process_index() == 0:
+        if jax.process_index() == 0:  # pod-agreed: p0-only LOCAL export; gather_tree_to_host above ran on every rank
             os.makedirs(out, exist_ok=True)
             save_hf_checkpoint(out, self.loaded.family, self.config, final_params)
             with open(os.path.join(out, "train_config.json"), "w") as f:

@@ -43,7 +43,7 @@ def log_json(metrics: Mapping[str, Any], *, all_processes: bool = False, file=No
     processes the device values are never ``.item()``-ed, so non-logging
     ranks keep costing zero device syncs."""
     if file is not None:
-        if not all_processes and jax.process_index() != 0:
+        if not all_processes and jax.process_index() != 0:  # pod-agreed: p0 emission gate; local print only, no collectives downstream
             return
         out = {k: _to_scalar(v) for k, v in metrics.items()}
         print(json.dumps(out), file=file, flush=True)

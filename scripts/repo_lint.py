@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Twelve rules, all born from real regressions at TPU scale:
+Thirteen rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -134,6 +134,25 @@ Twelve rules, all born from real regressions at TPU scale:
    (``backoff_ticks``) is the sanctioned form.  Retry sleeps go through
    ``utils.backoff.sleep_backoff``; any call named ``sleep`` lexically
    inside an except handler elsewhere fails here.
+
+13. **No bare rank conditionals — ``jax.process_index()`` /
+   ``process_count()`` inside an ``if``/``while``/ternary/assert test —
+   outside the whitelisted owners.**  A branch on raw rank identity is
+   the seed of every pod-deadlock bug class this repo has shipped review
+   fixes for (the one-rank walk-back, the p0-only verdict, the
+   rank-varying retry ladder): the moment the branch reaches a
+   collective, ranks disagree about the collective sequence.  The owners
+   — ``core/mesh.py`` (bootstrap), ``obs/heartbeat.py`` (the agreement
+   channel itself), ``io/checkpoint.py`` (the agreement helpers), and
+   ``obs/sink.py`` (the p0 emission gate) — are where rank branching is
+   the mechanism; everyone else routes decisions through the agreement
+   helpers (``_agreed_ok``/``_agreed_step``/``_agreed_count``/
+   ``gather_probe`` — the registry in ``analysis/divergence.py``) or
+   annotates the line ``# pod-agreed: <mechanism>`` naming why the
+   branch is pod-uniform (e.g. ``process_count() == 1`` fast paths: the
+   count is the same number everywhere).  The taint-tracking twin of
+   this lexical rule is the divergence pass (``analysis/divergence.py``),
+   which follows rank-local values into collectives across assignments.
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -280,6 +299,19 @@ KV_CAST_OWNERS = {
 # exponential schedule, one definition); a sleep inside an except
 # handler anywhere else is an ad-hoc retry loop.
 BACKOFF_OWNER = os.path.join(PACKAGE, "utils", "backoff.py")
+
+# Rule 13: bare rank conditionals live only where rank branching IS the
+# mechanism — the bootstrap, the agreement channel, the agreement
+# helpers, and the p0 emission gate.  Everyone else goes through the
+# agreement helpers or carries a `# pod-agreed: <mechanism>` pragma.
+RANK_CONDITIONAL_OWNERS = {
+    os.path.join(PACKAGE, "core", "mesh.py"),
+    os.path.join(PACKAGE, "obs", "heartbeat.py"),
+    os.path.join(PACKAGE, "io", "checkpoint.py"),
+    os.path.join(PACKAGE, "obs", "sink.py"),
+}
+_RANK_CALLS = ("process_index", "process_count")
+_POD_AGREED_PRAGMA = "# pod-agreed:"
 
 
 def _names_contain_lr(node: ast.AST) -> bool:
@@ -447,6 +479,50 @@ def _retry_sleep_violations(tree: ast.AST, rel: str) -> list[str]:
                     "utils.backoff.sleep_backoff (tick-based paths use "
                     "backoff_ticks)"
                 )
+    return violations
+
+
+def _rank_conditional_violations(
+    tree: ast.AST, rel: str, src: str,
+) -> list[str]:
+    """Rule 13: a ``jax.process_index()`` / ``process_count()`` call
+    inside the TEST of an ``if``/``while``/ternary/``assert``, outside
+    the whitelisted owners, without a ``# pod-agreed:`` pragma on the
+    call line or the statement line."""
+    pragma_lines = {
+        i for i, line in enumerate(src.splitlines(), start=1)
+        if _POD_AGREED_PRAGMA in line
+    }
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            test = node.test
+        else:
+            continue
+        for inner in ast.walk(test):
+            if not isinstance(inner, ast.Call):
+                continue
+            fn = inner.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name)
+                else None
+            )
+            if name not in _RANK_CALLS:
+                continue
+            if node.lineno in pragma_lines or inner.lineno in pragma_lines:
+                continue
+            violations.append(
+                f"{rel}:{inner.lineno}: bare `{name}()` conditional "
+                "outside the rank-branching owners (core/mesh.py, "
+                "obs/heartbeat.py, io/checkpoint.py, obs/sink.py) — a "
+                "branch on raw rank identity feeding a collective "
+                "deadlocks the pod; route the decision through an "
+                "agreement helper (_agreed_ok/_agreed_step/_agreed_count/"
+                "gather_probe — see analysis/divergence.py SANITIZERS) "
+                "or annotate the line `# pod-agreed: <mechanism>` naming "
+                "why the branch is pod-uniform"
+            )
     return violations
 
 
@@ -669,10 +745,11 @@ def _cadence_violations(tree: ast.AST, rel: str, allowed: frozenset) -> list[str
 
 def lint_file(path: str, rel: str) -> list[str]:
     with open(path) as f:
-        try:
-            tree = ast.parse(f.read(), filename=rel)
-        except SyntaxError as e:
-            return [f"{rel}: syntax error: {e}"]
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [f"{rel}: syntax error: {e}"]
     violations: list[str] = []
     hot = rel in HOT_PATH_FILES
     dropout_ruled = any(rel.startswith(d + os.sep) for d in DROPOUT_RULE_DIRS)
@@ -708,6 +785,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         violations.extend(_trace_emit_violations(tree, rel))
     if rel != BACKOFF_OWNER:
         violations.extend(_retry_sleep_violations(tree, rel))
+    if rel not in RANK_CONDITIONAL_OWNERS:
+        violations.extend(_rank_conditional_violations(tree, rel, src))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)

@@ -1,0 +1,17 @@
+"""Known-bad fixture: the rank-divergent early return.  Non-zero ranks
+bail out of the export before the save; process 0 then parks alone
+inside the checkpoint commit collective.
+
+The fixed production shape: gather first (every rank participates),
+THEN gate the local file write on process_index — never the other way
+around.
+"""
+
+import jax
+
+
+def export_checkpoint(ckpt, step, state):
+    if jax.process_index() != 0:
+        return
+    # BUG: only p0 reaches the commit collective
+    ckpt.save(step, state)

@@ -1,0 +1,16 @@
+"""Known-bad fixture: the one-rank exception walk-back (the PR 6 review
+bug).  A restore that raises on ONE rank sends only that rank into the
+walk-back collective; its peers, whose restore succeeded, have already
+returned — the pod deadlocks inside ``restore_before``.
+
+The fixed production shape (io/checkpoint.py): capture the error, agree
+on ``err is None`` with the MIN helper, and walk back TOGETHER.
+"""
+
+
+def restore_with_walkback(ckpt, abstract_state, step):
+    try:
+        return ckpt.restore_latest(abstract_state)
+    except Exception:
+        # BUG: only the throwing rank reaches this collective
+        return ckpt.restore_before(abstract_state, step)

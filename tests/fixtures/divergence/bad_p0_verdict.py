@@ -1,0 +1,21 @@
+"""Known-bad fixture: the p0-only unbroadcast verdict (the PR 14 review
+bug).  Process 0 verifies the checkpoint and flips ``ok`` — but the
+verdict is never broadcast, so every other rank still holds the default.
+The ranks then take DIFFERENT branches into the restore collective.
+
+The fixed production shape (io/checkpoint.py ``_agreed_step``): p0's
+verdict rides the heartbeat allgather channel; row 0 IS the verdict on
+every rank.
+"""
+
+import jax
+
+
+def verify_then_restore(ckpt, verify, abstract_state, step):
+    ok = True
+    if jax.process_index() == 0:
+        ok = verify(step)
+    if not ok:
+        # BUG: `ok` is rank-divergent — p0's verdict was never broadcast
+        return ckpt.restore_before(abstract_state, step)
+    return ckpt.restore_latest(abstract_state)

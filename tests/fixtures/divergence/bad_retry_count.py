@@ -1,0 +1,23 @@
+"""Known-bad fixture: the rank-varying retry count before a collective
+(the PR 15 review bug).  The candidate ladder is enumerated from the
+LOCAL filesystem, so a rank whose disk lags (or whose listing raced a
+GC) runs a different number of restore attempts — each attempt a
+collective its peers may never join.
+
+The fixed production shape (io/checkpoint.py ``_agreed_count``): the
+attempt count is MAX-agreed over the heartbeat channel and short ranks
+repeat their last candidate, keeping the per-attempt agreement sequence
+aligned across the pod.
+"""
+
+import os
+
+
+def restore_ladder(ckpt, abstract_state, ckpt_dir):
+    candidates = sorted(os.listdir(ckpt_dir), reverse=True)
+    for step in candidates:
+        # BUG: trip count differs per rank — a collective per attempt
+        state = ckpt.restore_before(abstract_state, int(step))
+        if state is not None:
+            return state
+    return None

@@ -1,0 +1,64 @@
+"""Known-good fixture: the same recovery shapes as the bad_* files, but
+routed through the agreement sanitizers — the patterns io/checkpoint.py
+actually ships.  The divergence pass must report ZERO findings here; a
+finding on this file is an analyzer regression (false positive), exactly
+as a silent pass on a bad_* file is a missed bug.
+"""
+
+import os
+
+import jax
+
+
+def restore_with_agreed_walkback(ckpt, abstract_state, step):
+    """The fixed exception walk-back: capture, MIN-agree, act together."""
+    state, err = None, None
+    try:
+        state = ckpt.restore_latest(abstract_state)
+    except Exception as e:
+        err = e
+    if not ckpt._agreed_ok(err is None):
+        # every rank takes this branch together: the verdict is pod-agreed
+        return ckpt.restore_before(abstract_state, step)
+    return state
+
+
+def verify_then_restore_broadcast(ckpt, verify, abstract_state, step):
+    """The fixed p0-only verify: the verdict rides the heartbeat channel."""
+    chosen = None
+    if jax.process_index() == 0:
+        chosen = step if verify(step) is None else None
+    chosen = ckpt._agreed_step(chosen)
+    if chosen is None:
+        return ckpt.restore_before(abstract_state, step)
+    return ckpt.restore_latest(abstract_state)
+
+
+def restore_ladder_agreed(ckpt, abstract_state, ckpt_dir):
+    """The fixed fallback ladder: MAX-agreed attempt count, short ranks
+    repeat their last candidate."""
+    candidates = sorted(os.listdir(ckpt_dir), reverse=True)
+    n_attempts = ckpt._agreed_count(len(candidates))
+    while len(candidates) < n_attempts:
+        candidates.append(candidates[-1] if candidates else "0")
+    for i in range(n_attempts):
+        # the trip count is the AGREED count: candidate VALUES may differ
+        # per rank, but every rank runs the same collective sequence and
+        # the per-attempt MIN verdict keeps the pod in lockstep
+        state, err = None, None
+        try:
+            state = ckpt.restore_before(abstract_state, int(candidates[i]))
+        except Exception as e:
+            err = e
+        if ckpt._agreed_ok(err is None and state is not None):
+            return state
+    return None
+
+
+def gather_then_export(ckpt, gather_tree, step, state):
+    """The fixed p0 export: collective first, rank gate second."""
+    host_state = gather_tree(state)
+    if jax.process_index() != 0:
+        return
+    with open(f"export-{step}.json", "w") as fh:
+        fh.write(str(type(host_state).__name__))

@@ -1224,6 +1224,63 @@ def test_repo_lint_rule7_covers_devprof(tmp_path):
     assert repo_lint.lint_file(str(rogue), rel_owner) == []
 
 
+def test_repo_lint_rank_conditional_rule(tmp_path):
+    """Rule 13 (ISSUE 16): a bare ``process_index()``/``process_count()``
+    conditional outside the rank-branching owners is forbidden — raw rank
+    identity feeding a branch is the pod-deadlock seed the divergence
+    pass hunts semantically; this is the cheap lexical backstop."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "repo_lint.py"),
+    )
+    repo_lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repo_lint)
+
+    # (the annotated repo being clean is already pinned by
+    # test_repo_lint_clean_and_catches_violations's main([]) run — rule 13
+    # rides the same driver, so a whole-tree re-lint here is pure wall)
+
+    bad = tmp_path / "rogue.py"
+    bad.write_text(
+        "import jax\n"
+        "if jax.process_index() == 0:\n"
+        "    save()\n"
+        "while jax.process_count() > 1:\n"
+        "    sync()\n"
+        "x = 1 if jax.process_index() else 0\n"
+        "assert jax.process_count() == 8\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "train", "rogue.py")
+    violations = repo_lint.lint_file(str(bad), rel)
+    assert len(violations) == 4
+    assert all("pod-agreed" in v for v in violations)
+
+    # ...every whitelisted owner keeps its rank-branching license
+    for owner in sorted(repo_lint.RANK_CONDITIONAL_OWNERS):
+        assert repo_lint.lint_file(str(bad), owner) == []
+
+    # a NON-conditional use (gating nothing) is not rule 13's business
+    ok_use = tmp_path / "use.py"
+    ok_use.write_text("import jax\npid = jax.process_index()\n")
+    assert repo_lint.lint_file(str(ok_use), rel) == []
+
+    # the pragma waives, on either the statement or the call line
+    waived = tmp_path / "waived.py"
+    waived.write_text(
+        "import jax\n"
+        "if jax.process_count() == 1:  # pod-agreed: pod-uniform fast path\n"
+        "    save()\n"
+        "if (  # pod-agreed: pod-uniform guard\n"
+        "    jax.process_count() > 1\n"
+        "):\n"
+        "    sync()\n"
+    )
+    assert repo_lint.lint_file(str(waived), rel) == []
+
+
 def test_bench_diff_config_knobs_never_gate():
     """SLO settings and thresholds are config stamped into the artifact,
     not measurements — changing them between rounds must read as info,

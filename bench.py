@@ -1758,6 +1758,181 @@ def _router_main() -> None:
     }))
 
 
+def _loadgen_measure(
+    lm, mesh, sharded, *,
+    slots: int, src: int, new_tokens: int, n_req: int,
+    process: str, seed: int, qps_grid: tuple, slo_ms: float,
+    max_wall_s: float, replicas: int, chaos_spec: str,
+) -> dict:
+    """Open-loop QPS sweep (ISSUE 17) vs the closed-loop measurement of
+    the SAME engine config.  The closed-loop pass (generate: submit all,
+    drain) is what every previous serving bench reported — its offered
+    rate is capped by the service rate, so it reads healthy even when
+    the config would collapse under real traffic.  The open-loop sweep
+    offers seeded arrivals that never wait for completions, so the same
+    config gains a saturation knee, per-rate goodput/SLO-attainment, and
+    TTFT-from-arrival percentiles.  Two extra stamps: the determinism
+    pin (open-loop tokens at the top of the grid == the closed-loop
+    oracle's — arrival timing moves latency, never tokens) and, when
+    ``replicas >= 1``, a second sweep through the replica router with
+    ``chaos_spec`` injected per point (degraded-mode numbers AT a
+    stated offered load)."""
+    import numpy as np
+
+    from distributed_llms_example_tpu.serving.engine import (
+        ServeConfig,
+        ServingEngine,
+    )
+    from distributed_llms_example_tpu.serving.loadgen import (
+        EngineTarget,
+        LoadgenConfig,
+        RouterTarget,
+        arrival_schedule,
+        drive_open_loop,
+        sweep_qps,
+    )
+
+    rng = np.random.RandomState(0)
+    vocab_hi = min(lm.config.vocab_size, 30000)
+    requests = [
+        list(rng.randint(4, vocab_hi, rng.randint(max(src // 2, 8), src + 1)))
+        for _ in range(n_req)
+    ]
+    budgets = [
+        int(b)
+        for b in rng.randint(max(new_tokens // 4, 1), new_tokens + 1, n_req)
+    ]
+    serve_cfg = ServeConfig(
+        max_slots=slots, prefill_batch=slots,
+        max_new_tokens=new_tokens, max_source_length=src,
+        log_every_steps=0, request_spans=False, ttft_slo_ms=slo_ms,
+    )
+    engine = ServingEngine(
+        lm.module, lm.config, mesh, serve_cfg, is_seq2seq=lm.is_seq2seq
+    )
+    # closed-loop measurement of the same config — the number that can
+    # NEVER expose queueing collapse (and the determinism oracle)
+    t0 = time.perf_counter()
+    oracle_outs = engine.generate(sharded, requests, max_new=budgets)
+    closed_wall = max(time.perf_counter() - t0, 1e-9)
+    closed_stats = engine.last_stats
+    cfg = LoadgenConfig(
+        process=process, seed=seed, qps_grid=qps_grid,
+        ttft_slo_ms=slo_ms, max_wall_s=max_wall_s,
+    )
+    summary = sweep_qps(
+        lambda: EngineTarget(engine.open(sharded)),
+        requests, cfg, budgets=budgets,
+    )
+    # determinism pin: an uncapped open-loop run at the top of the grid
+    # must produce the oracle's tokens bit-for-bit
+    sess = engine.open(sharded)
+    sched = arrival_schedule(
+        process, qps=float(qps_grid[-1]), n=n_req, seed=seed,
+    )
+    drive_open_loop(EngineTarget(sess), requests, sched, budgets=budgets)
+    open_outs = [sess.output(r) for r in range(n_req)]
+    out: dict = {
+        "closed_loop": {
+            "wall_s": round(closed_wall, 3),
+            "decode_tokens_per_sec": round(
+                sum(len(o) for o in oracle_outs) / closed_wall, 1
+            ),
+            "slo_attainment": (
+                (closed_stats.goodput or {}).get("slo_attainment")
+                if closed_stats else None
+            ),
+        },
+        "loadgen": summary,
+        "tokens_identical_to_closed_loop": open_outs == oracle_outs,
+    }
+    if replicas >= 1:
+        from distributed_llms_example_tpu.obs.chaos import parse_chaos
+        from distributed_llms_example_tpu.serving.router import (
+            ReplicaRouter,
+            RouterConfig,
+        )
+
+        engines = [
+            ServingEngine(
+                lm.module, lm.config, mesh, serve_cfg,
+                is_seq2seq=lm.is_seq2seq,
+            )
+            for _ in range(replicas)
+        ]
+        router_cfg = RouterConfig(
+            log_every_ticks=0,
+            chaos=parse_chaos(chaos_spec) if chaos_spec else None,
+        )
+        chaos_summary = sweep_qps(
+            lambda: RouterTarget(ReplicaRouter(engines, sharded, router_cfg)),
+            requests, cfg, budgets=budgets,
+        )
+        out["router_sweep"] = {
+            "replicas": replicas,
+            "chaos": chaos_spec or None,
+            **chaos_summary,
+        }
+    return out
+
+
+def _loadgen_main() -> None:
+    """BENCH_MODE=serve-loadgen: the standalone open-loop load record —
+    offered-QPS sweep over the flagship model with the closed-loop
+    measurement of the same config stamped beside it."""
+    import jax
+
+    from distributed_llms_example_tpu.core.config import MeshConfig, parse_mesh_arg
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.parallel.sharding import shard_params
+
+    name, lm, _ = _flagship()
+    n_chips = jax.device_count()
+    mesh_spec = os.environ.get("BENCH_SERVE_MESH", "")
+    mesh = build_mesh(parse_mesh_arg(mesh_spec) if mesh_spec else MeshConfig(data=-1))
+    batch_shards = 1
+    for a in ("data", "fsdp", "expert"):
+        batch_shards *= mesh.shape.get(a, 1)
+    src = int(os.environ.get("BENCH_LOADGEN_SRC", "256"))
+    new_tokens = int(os.environ.get("BENCH_LOADGEN_NEW", "32"))
+    slots = int(os.environ.get("BENCH_LOADGEN_SLOTS_PER_SHARD", "2")) * batch_shards
+    n_req = int(os.environ.get("BENCH_LOADGEN_REQUESTS", str(4 * slots)))
+    process = os.environ.get("BENCH_LOADGEN_PROCESS", "poisson")
+    seed = int(os.environ.get("BENCH_LOADGEN_SEED", "0"))
+    qps_grid = tuple(
+        float(q)
+        for q in os.environ.get("BENCH_LOADGEN_QPS_GRID", "0.5,1,2,4,8").split(",")
+        if q.strip()
+    )
+    slo_ms = float(os.environ.get("BENCH_LOADGEN_SLO_MS", "500"))
+    max_wall_s = float(os.environ.get("BENCH_LOADGEN_MAX_WALL_S", "120"))
+    replicas = int(os.environ.get("BENCH_LOADGEN_REPLICAS", "0"))
+    chaos_spec = os.environ.get("BENCH_LOADGEN_CHAOS", "")
+    params = lm.params if lm.params is not None else jax.device_get(lm.init_params(0))
+    sharded = shard_params(params, mesh)
+    record = _loadgen_measure(
+        lm, mesh, sharded,
+        slots=slots, src=src, new_tokens=new_tokens, n_req=n_req,
+        process=process, seed=seed, qps_grid=qps_grid, slo_ms=slo_ms,
+        max_wall_s=max_wall_s, replicas=replicas, chaos_spec=chaos_spec,
+    )
+    print(json.dumps({
+        "grad_compression": "off",
+        "metric": f"{name} open-loop load sweep ({process} arrivals, "
+                  f"QPS grid {list(qps_grid)}, {n_req} requests/point, "
+                  f"slots {slots}, src {src} / max_new {new_tokens}, "
+                  f"TTFT SLO {slo_ms:.0f} ms) — serving/loadgen.py on "
+                  f"mesh {mesh_spec or 'data=-1'}; no reference number "
+                  "exists",
+        "value": record["loadgen"].get("knee_qps"),
+        "unit": "offered QPS at the saturation knee",
+        "vs_baseline": None,
+        **record,
+        "chips": n_chips,
+        "backend": jax.default_backend(),
+    }))
+
+
 def main() -> None:
     # Child-side wall-clock budget: the add-on measurements (grad-accum,
     # dropout, rbg-dropout, trainer loop, trainer-rbg) each compile their
@@ -2506,6 +2681,8 @@ if __name__ == "__main__":
             _serve_main()
         elif os.environ.get("BENCH_MODE", "") == "serve-router":
             _router_main()
+        elif os.environ.get("BENCH_MODE", "") == "serve-loadgen":
+            _loadgen_main()
         elif os.environ.get("BENCH_MODE", "") == "host-input":
             _host_input_main()
         else:

@@ -5,6 +5,11 @@ prompts file (``python -m distributed_llms_example_tpu.launch.cli serve
 --model-ckpt ... --prompts-file prompts.json``): prefill/decode split,
 sharded KV-cache slots, admit/evict per token step, serve_window /
 serve_summary obs events — see README "Serving" and serving/engine.py.
+``serve-router`` fronts N engine replicas with the fault-tolerant
+router; ``serve-loadgen`` drives either through the open-loop QPS sweep
+(serving/loadgen.py): seeded Poisson/bursty/ramp arrivals, goodput and
+TTFT-percentile curves per offered rate, a detected saturation knee —
+see README "Open-loop load testing & SLO curves".
 
 One (sub)command serves all three of the reference's launch modes (SURVEY.md §7):
 
@@ -458,6 +463,124 @@ def serve_router_main(argv: list[str] | None = None) -> int:
     return 0
 
 
+def build_loadgen_parser() -> argparse.ArgumentParser:
+    """``serve-loadgen`` = every serve-router flag + the open-loop sweep
+    knobs.  ``--replicas`` is repurposed: 0 (the default here) drives a
+    bare engine session; >= 1 drives a ReplicaRouter pool, which is how
+    the sweep composes with ``--chaos``."""
+    p = build_router_parser()
+    p.prog = "dllm-train serve-loadgen"
+    p.description = (
+        "open-loop load sweep (serving/loadgen.py): seeded arrival "
+        "schedules (arrivals never wait for completions, so queues "
+        "genuinely build) over an offered-QPS grid, producing "
+        "offered-vs-goodput and TTFT-percentile curves with a detected "
+        "saturation knee; --replicas 0 drives one engine session, >= 1 "
+        "a router pool (composable with --chaos)"
+    )
+    p.set_defaults(replicas=0)
+    p.add_argument("--arrival-process", type=str, default="poisson",
+                   choices=("poisson", "bursty", "ramp"),
+                   help="arrival process: exponential inter-arrivals, "
+                        "bursts of --burst-size, or a linear rate ramp "
+                        "from --ramp-start-frac x rate")
+    p.add_argument("--loadgen-seed", type=int, default=0,
+                   help="arrival-schedule RNG seed (same seed + config = "
+                        "bit-identical schedule)")
+    p.add_argument("--qps-grid", type=str, default="1,2,4,8",
+                   help="comma list of ascending offered QPS points")
+    p.add_argument("--burst-size", type=int, default=4,
+                   help="bursty: simultaneous arrivals per burst")
+    p.add_argument("--ramp-start-frac", type=float, default=0.25,
+                   help="ramp: starting rate as a fraction of the "
+                        "point's offered rate")
+    p.add_argument("--max-wall-s", type=float, default=0.0,
+                   help="per-point wall cap (0 = none); a point far past "
+                        "saturation stops here and reports its "
+                        "unfinished tail")
+    p.add_argument("--track-tol", type=float, default=0.9,
+                   help="knee sensitivity: a point with achieved QPS "
+                        "below track-tol x offered has saturated")
+    return p
+
+
+def serve_loadgen_main(argv: list[str] | None = None) -> int:
+    """The ``serve-loadgen`` subcommand: load once, shard once, one
+    fresh session (or router pool) per offered-QPS grid point."""
+    args = build_loadgen_parser().parse_args(argv)
+    from distributed_llms_example_tpu.serving.engine import ServingEngine
+    from distributed_llms_example_tpu.serving.loadgen import (
+        EngineTarget,
+        LoadgenConfig,
+        RouterTarget,
+        sweep_qps,
+    )
+
+    lm, mesh, tok, params, prompts, requests = _serve_setup(
+        args, extra_flags=("router",) if args.replicas >= 1 else ()
+    )
+    serve_cfg = _serve_config_from_args(args)
+    cfg = LoadgenConfig(
+        process=args.arrival_process,
+        seed=args.loadgen_seed,
+        burst_size=args.burst_size,
+        ramp_start_frac=args.ramp_start_frac,
+        qps_grid=tuple(
+            float(q) for q in args.qps_grid.split(",") if q.strip()
+        ),
+        # the serve parser's SLO default (0 = no SLO) would make
+        # attainment vacuous; the sweep judges against a real bar
+        ttft_slo_ms=args.ttft_slo_ms or 500.0,
+        max_wall_s=args.max_wall_s,
+        track_tol=args.track_tol,
+    )
+    if args.replicas >= 1:
+        from distributed_llms_example_tpu.obs.chaos import parse_chaos
+        from distributed_llms_example_tpu.serving.router import (
+            ReplicaRouter,
+            RouterConfig,
+        )
+
+        router_cfg = RouterConfig(
+            max_retries=args.max_retries,
+            deadline_s=args.deadline_ms / 1e3,
+            max_queue=args.router_max_queue,
+            shed_policy=args.shed_policy,
+            suspect_after_ticks=args.suspect_after_ticks,
+            dead_after_ticks=args.dead_after_ticks,
+            log_every_ticks=args.log_every_steps,
+            chaos=parse_chaos(args.chaos) if args.chaos else None,
+        )
+
+        def target_factory():
+            engines = [
+                ServingEngine(
+                    lm.module, lm.config, mesh, serve_cfg,
+                    is_seq2seq=lm.is_seq2seq,
+                )
+                for _ in range(args.replicas)
+            ]
+            return RouterTarget(ReplicaRouter(engines, params, router_cfg))
+    else:
+        engine = ServingEngine(
+            lm.module, lm.config, mesh, serve_cfg, is_seq2seq=lm.is_seq2seq
+        )
+
+        def target_factory():
+            return EngineTarget(engine.open(params))
+
+    summary = sweep_qps(target_factory, requests, cfg)
+    if args.output_file:
+        from distributed_llms_example_tpu.obs.sink import ProductJsonlWriter
+
+        writer = ProductJsonlWriter(args.output_file)
+        try:
+            writer.write(summary)
+        finally:
+            writer.close()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -465,6 +588,8 @@ def main(argv: list[str] | None = None) -> int:
         return serve_main(argv[1:])
     if argv and argv[0] == "serve-router":
         return serve_router_main(argv[1:])
+    if argv and argv[0] == "serve-loadgen":
+        return serve_loadgen_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.source_column:

@@ -36,6 +36,13 @@ and reconstructs the run:
   reduce-scatter smell predicate (analysis/ir_lint.py) evaluated over it
   — an fsdp run whose gradient bytes ride all-reduce is flagged right in
   the report;
+- an **"Open-loop load sweep" section** from the ``loadgen_point`` /
+  ``loadgen_summary`` events (serving/loadgen.py): the offered-vs-
+  achieved/goodput and TTFT-percentile curves per offered-QPS grid
+  point, per-point SLO attainment, and the detected saturation knee —
+  rendered from the JSONL alone.  ``--min-slo-attainment X`` /
+  ``--max-p99-ttft-ms Y`` + ``--strict`` gate on the curve (missing
+  loadgen measurement = fail);
 - the **anomaly log** (``obs_anomaly`` events + flight-recorder
   bundles).
 
@@ -661,6 +668,51 @@ def recovery_report(processes: dict[int, list[dict]]) -> dict[str, Any]:
     }
 
 
+def loadgen_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
+    """The open-loop load sweep rollup: the curve (one row per offered-
+    QPS grid point) + the detected knee, from ``loadgen_point`` /
+    ``loadgen_summary`` events alone.  The newest ``loadgen_summary``
+    is authoritative for the curve and knee (it embeds its points);
+    bare points (a run killed mid-sweep) still render.
+
+    ``best_slo_attainment`` / ``best_ttft_p99_ms`` are the gate inputs:
+    the best attainment any measured point reached, and the lowest
+    MEASURED p99 TTFT (points where nothing finished measure None and
+    are excluded — so a run whose every point collapsed has no p99 at
+    all, and a p99 gate on it fails as a missing measurement)."""
+    points: list[dict] = []
+    summaries: list[dict] = []
+    for _, records in sorted(processes.items()):
+        ev = _by_event(records)
+        points.extend(ev.get("loadgen_point", []))
+        summaries.extend(ev.get("loadgen_summary", []))
+    if not points and not summaries:
+        return None
+    summary = summaries[-1] if summaries else None
+    curve = list((summary or {}).get("points") or points)
+    attains = [
+        p["slo_attainment"] for p in curve
+        if isinstance(p.get("slo_attainment"), (int, float))
+    ]
+    p99s = [
+        p["ttft_p99_ms"] for p in curve
+        if isinstance(p.get("ttft_p99_ms"), (int, float))
+    ]
+    meta = summary or (points[-1] if points else {})
+    return {
+        "process": meta.get("process"),
+        "seed": meta.get("seed"),
+        "ttft_slo_ms": meta.get("ttft_slo_ms"),
+        "requests_per_point": (summary or {}).get("requests_per_point"),
+        "qps_grid": (summary or {}).get("qps_grid"),
+        "knee_qps": (summary or {}).get("knee_qps"),
+        "sweeps": len(summaries),
+        "points": curve,
+        "best_slo_attainment": max(attains) if attains else None,
+        "best_ttft_p99_ms": min(p99s) if p99s else None,
+    }
+
+
 def build_report(output_dir: str) -> dict[str, Any]:
     run = load_run(output_dir)
     processes = run["processes"]
@@ -681,6 +733,7 @@ def build_report(output_dir: str) -> dict[str, Any]:
         "comm": comm_report(processes),
         "budget": budget_report(processes),
         "device": device_report(processes),
+        "loadgen": loadgen_report(processes),
         "recovery": recovery_report(processes),
         "anomalies": anomalies,
         "recorders": {
@@ -930,6 +983,38 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
                 )
         if "reduce_scatter_smell" in comm:
             add(f"- **smell**: {comm['reduce_scatter_smell'].get('message')}")
+    lg = report.get("loadgen")
+    if lg is not None:
+        add("")
+        add("## Open-loop load sweep")
+        knee = lg.get("knee_qps")
+        add(
+            f"- process={lg.get('process')} seed={lg.get('seed')} "
+            f"slo={_fmt(lg.get('ttft_slo_ms'))}ms "
+            f"requests/point={lg.get('requests_per_point')} — knee: "
+            + (
+                f"**{_fmt(knee)} QPS** (first saturated offered rate)"
+                if knee is not None
+                else "not reached on this grid"
+            )
+        )
+        add("")
+        add("| offered QPS | achieved | goodput | SLO attain | ttft p50 ms "
+            "| p95 | p99 | qdelay p99 ms | growing | shed | unfinished |")
+        add("|---" * 11 + "|")
+        for pt in lg.get("points", []):
+            add(
+                f"| {_fmt(pt.get('offered_qps'))} | "
+                f"{_fmt(pt.get('achieved_qps'))} | "
+                f"{_fmt(pt.get('goodput_qps'))} | "
+                f"{_fmt(pt.get('slo_attainment'))} | "
+                f"{_fmt(pt.get('ttft_p50_ms'))} | "
+                f"{_fmt(pt.get('ttft_p95_ms'))} | "
+                f"{_fmt(pt.get('ttft_p99_ms'))} | "
+                f"{_fmt(pt.get('queue_delay_p99_ms'))} | "
+                f"{'yes' if pt.get('queue_growing') else ''} | "
+                f"{_fmt(pt.get('shed'))} | {_fmt(pt.get('unfinished'))} |"
+            )
     rec = report.get("recovery") or {}
     add("")
     add("## Recovery timeline")
@@ -1083,6 +1168,23 @@ def main(argv: list[str] | None = None) -> int:
              "serving measurement must never read as a pass",
     )
     p.add_argument(
+        "--min-slo-attainment", type=float, default=0.0,
+        help="with --strict: fail when the open-loop load sweep's BEST "
+             "per-point slo_attainment (loadgen_point/loadgen_summary "
+             "events) falls below this floor — if even the best offered "
+             "rate cannot meet it, the deployment cannot — or when NO "
+             "loadgen measurement exists (0 = the gate is off); a "
+             "missing measurement must never read as a pass",
+    )
+    p.add_argument(
+        "--max-p99-ttft-ms", type=float, default=0.0,
+        help="with --strict: fail when the open-loop load sweep's lowest "
+             "MEASURED per-point p99 TTFT (from arrival) exceeds this "
+             "ceiling, or when no point measured one (nothing finished, "
+             "or no loadgen run at all) (0 = the gate is off); a missing "
+             "measurement must never read as a pass",
+    )
+    p.add_argument(
         "--trace", type=str, default="",
         help="also export the merged Chrome-trace/Perfetto JSON here "
              "(every rank's spans aligned on shared step boundaries, "
@@ -1184,6 +1286,42 @@ def main(argv: list[str] | None = None) -> int:
                     f"strict: goodput_frac {frac} below the "
                     f"{args.min_serve_goodput_frac} floor — requests are "
                     "being shed or missing the TTFT SLO", file=sys.stderr,
+                )
+                rc = 1
+        lg = report.get("loadgen")
+        if args.min_slo_attainment > 0:
+            best = (lg or {}).get("best_slo_attainment")
+            if best is None:
+                print(
+                    "strict: --min-slo-attainment set but no loadgen "
+                    "measurement found (run the open-loop load sweep — "
+                    "serving/loadgen.py) — a missing measurement must "
+                    "never read as a pass", file=sys.stderr,
+                )
+                rc = 1
+            elif best < args.min_slo_attainment:
+                print(
+                    f"strict: best per-point slo_attainment {best} below "
+                    f"the {args.min_slo_attainment} floor — no offered "
+                    "rate on the sweep grid meets the SLO",
+                    file=sys.stderr,
+                )
+                rc = 1
+        if args.max_p99_ttft_ms > 0:
+            best = (lg or {}).get("best_ttft_p99_ms")
+            if best is None:
+                print(
+                    "strict: --max-p99-ttft-ms set but no measured p99 "
+                    "TTFT found (no loadgen run, or nothing finished at "
+                    "any offered rate) — a missing measurement must "
+                    "never read as a pass", file=sys.stderr,
+                )
+                rc = 1
+            elif best > args.max_p99_ttft_ms:
+                print(
+                    f"strict: best per-point p99 TTFT {best} ms exceeds "
+                    f"the {args.max_p99_ttft_ms} ms ceiling at every "
+                    "offered rate on the sweep grid", file=sys.stderr,
                 )
                 rc = 1
         ov_floor = args.min_overlap_frac

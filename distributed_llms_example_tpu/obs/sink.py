@@ -18,6 +18,20 @@ The module-level sink is what ``utils.jsonlog.log_json`` routes through;
 startup).  The process gate lives in ``wants`` and is checked BEFORE the
 caller converts device scalars to host floats — on non-zero processes a
 record nobody will emit must not cost a device sync.
+
+Schema note (still ``schema_version`` 1 — event kinds are additive):
+the open-loop load generator (serving/loadgen.py) emits one
+``loadgen_point`` per offered-QPS grid point (offered/achieved QPS,
+goodput, SLO attainment judged over every OFFERED request, TTFT
+percentiles from ARRIVAL — ``None`` when nothing finished, so a
+missing measurement can never gate as a pass — queue-delay percentiles
+and the ``queue_growing`` verdict) and a closing ``loadgen_summary``
+carrying the whole curve plus the detected ``knee_qps``; ``serve_request``
+records gained ``t_arrival_s``/``queue_delay_ms`` (arrival→submit) and
+``serve_window`` the ``arrival_rate_per_sec``/``service_rate_per_sec``/
+``queue_growth`` gauges.  ``obs.report``'s "Open-loop load sweep"
+section and the ``--min-slo-attainment``/``--max-p99-ttft-ms`` strict
+gates consume these from the JSONL stream alone.
 """
 
 from __future__ import annotations

@@ -769,6 +769,13 @@ class ServeSession:
         self.outputs: list[list[int]] = []
         self.ttft: list[float | None] = []
         self.submit_t: list[float] = []
+        # absolute arrival instant per request (perf_counter timeline).
+        # Closed-loop drivers never pass one, so arrival == submit and the
+        # arrival→submit queue delay reads 0; an open-loop driver
+        # (serving/loadgen.py) stamps the SCHEDULED arrival, so the time a
+        # request waited before the driver could even submit it becomes a
+        # first-class, JSONL-visible queueing stage instead of vanishing
+        self.arrival_t: list[float] = []
         self.first_tok_wall: list[float | None] = []
         self.admit_t: list[float | None] = []
         self.prefill_dt: list[float] = []
@@ -803,6 +810,9 @@ class ServeSession:
         self._win_tokens, self._win_occ = 0, 0.0
         self._win_t0 = time.perf_counter()
         self._win_prefill, self._win_decode = 0.0, 0.0
+        # queueing-telemetry window counters: submissions vs completions
+        # inside the window — their imbalance IS the queue growing
+        self._win_arrivals, self._win_done = 0, 0
         self._finalized = False
 
     # ------------------------------------------------------------- intake
@@ -813,10 +823,15 @@ class ServeSession:
         max_new: int | None = None,
         attention_mask: Sequence[int] | None = None,
         label: Any = None,
+        arrival: float | None = None,
     ) -> int:
         """Enqueue one request; returns the session-local rid.  ``label``
         (default: the rid) is what the ``serve_request`` event carries as
-        ``request`` — the router passes its global request id."""
+        ``request`` — the router passes its global request id.
+        ``arrival`` (absolute perf_counter instant, default: now) is when
+        the request ARRIVED, which under open-loop load precedes the
+        submit — the gap is the driver-side queueing delay the
+        ``serve_request`` record stamps as ``queue_delay_ms``."""
         if self._finalized:
             raise RuntimeError("session already finalized")
         rid = len(self.requests)
@@ -830,12 +845,15 @@ class ServeSession:
         self.labels.append(rid if label is None else label)
         self.outputs.append([])
         self.ttft.append(None)
-        self.submit_t.append(time.perf_counter())
+        now = time.perf_counter()
+        self.submit_t.append(now)
+        self.arrival_t.append(float(arrival) if arrival is not None else now)
         self.first_tok_wall.append(None)
         self.admit_t.append(None)
         self.prefill_dt.append(0.0)
         self.pending.append(rid)
         self.stats.sequences += 1
+        self._win_arrivals += 1
         return rid
 
     def take_pending(self) -> list[Any]:
@@ -884,6 +902,7 @@ class ServeSession:
         if not self.eng.serve.request_spans:
             return
         t_sub = self.submit_t[rid]
+        t_arr = self.arrival_t[rid]
         t_admit = self.admit_t[rid] if self.admit_t[rid] is not None else t_sub
         queue_wait = t_admit - t_sub
         t = self.ttft[rid]
@@ -891,6 +910,13 @@ class ServeSession:
             "event": "serve_request",
             "request": self.labels[rid],
             "slot": int(slot),
+            # arrival→submit: the open-loop driver-side wait (0 under
+            # closed-loop driving, where arrival is stamped == submit);
+            # queue_wait_ms below is the submit→admit stage — total
+            # queueing delay = queue_delay_ms + queue_wait_ms, readable
+            # off this one record
+            "t_arrival_s": round(t_arr - self.t_open, 6),
+            "queue_delay_ms": round((t_sub - t_arr) * 1e3, 3),
             "queue_wait_ms": round(queue_wait * 1e3, 3),
             "prefill_ms": round(self.prefill_dt[rid] * 1e3, 3),
             "ttft_ms": round(t * 1e3, 3) if t is not None else None,
@@ -911,6 +937,7 @@ class ServeSession:
         the pool (the evict-returns-all-blocks contract)."""
         self.active[slot] = False
         self.slot_req[slot] = -1
+        self._win_done += 1
         if self.eng.paged and self.slot_blocks[slot]:
             self.eng.pool.free(self.slot_blocks[slot])
             self.slot_blocks[slot] = []
@@ -1114,6 +1141,13 @@ class ServeSession:
                 ),
                 "slot_occupancy": round(self._win_occ / every, 4),
                 "queue_depth": len(self.pending),
+                # queueing telemetry: the window's offered vs served rate
+                # and their imbalance — a sustained positive queue_growth
+                # is the open-loop collapse signal (arrivals outpacing
+                # service), visible live instead of post-hoc
+                "arrival_rate_per_sec": round(self._win_arrivals / w_dt, 2),
+                "service_rate_per_sec": round(self._win_done / w_dt, 2),
+                "queue_growth": int(self._win_arrivals - self._win_done),
                 # the window's wall split: admission prefill vs decode
                 # steps — a window whose prefill share balloons is paying
                 # admission on the decode critical path
@@ -1134,6 +1168,7 @@ class ServeSession:
             log_json(window)
             self._win_tokens, self._win_t0, self._win_occ = 0, now, 0.0
             self._win_prefill, self._win_decode = 0.0, 0.0
+            self._win_arrivals, self._win_done = 0, 0
         return finished
 
     # ------------------------------------------------------------ closing
@@ -1174,6 +1209,14 @@ class ServeSession:
             else 0.0
         )
         p50, p95 = stats.ttft_percentiles()
+        # arrival→submit delay percentiles over every request (0s under
+        # closed-loop driving; the open-loop driver's queueing signature)
+        from distributed_llms_example_tpu.obs.spans import percentiles
+
+        qd50, qd95, qd99 = percentiles(
+            [s - a for s, a in zip(self.submit_t, self.arrival_t)],
+            (0.50, 0.95, 0.99),
+        )
         summary = {
             "event": "serve_summary",
             "sequences": stats.sequences,
@@ -1185,6 +1228,9 @@ class ServeSession:
             ),
             "ttft_p50_ms": round(p50 * 1e3, 1),
             "ttft_p95_ms": round(p95 * 1e3, 1),
+            "queue_delay_p50_ms": round(qd50 * 1e3, 3),
+            "queue_delay_p95_ms": round(qd95 * 1e3, 3),
+            "queue_delay_p99_ms": round(qd99 * 1e3, 3),
             **stats.ttft_decomposition(),
             **stats.goodput,
             "slot_occupancy": round(stats.slot_occupancy, 4),

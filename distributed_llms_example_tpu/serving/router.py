@@ -142,6 +142,7 @@ class _Request:
     budget: int | None
     session_key: Any
     synthetic: bool
+    arrival_wall: float  # scheduled arrival (== submit_wall closed-loop)
     submit_wall: float
     submit_tick: int
     deadline_wall: float | None  # absolute perf_counter instant
@@ -220,13 +221,18 @@ class ReplicaRouter:
         deadline_s: float | None = None,
         deadline_ticks: int | None = None,
         synthetic: bool = False,
+        arrival: float | None = None,
     ) -> int:
         """Offer one request to the router.  Admission control applies
         HERE: a full queue sheds (policy "shed") or defers (policy
         "defer" — parked client-side, admitted as the queue drains)
         instead of growing without bound.  Returns the router-global
         request id either way; a shed request's output stays empty and
-        its reason rides the summary."""
+        its reason rides the summary.  ``arrival`` (absolute
+        perf_counter instant, default: now) is the open-loop scheduled
+        arrival — it threads through dispatch to the replica session so
+        the ``serve_request`` stream's arrival→submit queue-delay stage
+        covers router-held time too."""
         now = time.perf_counter()
         ddl_s = self.cfg.deadline_s if deadline_s is None else deadline_s
         req = _Request(
@@ -236,6 +242,7 @@ class ReplicaRouter:
             budget=max_new,
             session_key=session,
             synthetic=synthetic,
+            arrival_wall=float(arrival) if arrival is not None else now,
             submit_wall=now,
             submit_tick=self.ticks,
             deadline_wall=(now + ddl_s) if ddl_s and ddl_s > 0 else None,
@@ -445,6 +452,7 @@ class ReplicaRouter:
                 max_new=req.budget,
                 attention_mask=req.mask,
                 label=req.rid,
+                arrival=req.arrival_wall,
             )
             req.replica = target.idx
             if req.rid in self._requeued_outstanding:
@@ -760,6 +768,10 @@ class ReplicaRouter:
             {
                 "rid": q.rid,
                 "synthetic": q.synthetic,
+                "arrival_s": round(q.arrival_wall - self.t_open, 6),
+                "queue_delay_ms": round(
+                    (q.submit_wall - q.arrival_wall) * 1e3, 3
+                ),
                 "submit_s": round(q.submit_wall - self.t_open, 6),
                 "done_s": (
                     round(q.done_wall - self.t_open, 6)

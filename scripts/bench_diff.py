@@ -49,6 +49,12 @@ _HIGHER_BETTER = (
     # serving capacity (PR 13): sustained concurrency per chip and the
     # int8/f32 footprint ratio are the levers the capacity block measures
     "max_sustained_slots", "token_match_rate", "cache_bytes_ratio",
+    # open-loop load sweep (serving/loadgen.py): a knee moving RIGHT is
+    # more offered load served before saturation; goodput/attainment at
+    # the SLO are the curve's quality axes ("goodput"/"slo_attainment"
+    # above already cover goodput_at_slo lexically — named here so the
+    # direction survives a tuple reshuffle)
+    "knee_qps", "achieved_qps", "goodput_qps", "goodput_at_slo",
 )
 _LOWER_BETTER = (
     "_ms", "ttft", "wall_s", "_seconds", "overhead", "exposed_",
@@ -60,6 +66,10 @@ _LOWER_BETTER = (
     # cache footprint per live token: what the int8/paged knobs shrink
     "cache_bytes_per_token", "bytes_per_live_token",
     "admit_deferrals",
+    # open-loop tail latency and queueing delay ("_ms"/"ttft" above
+    # already cover these lexically — named for the same reason as
+    # knee_qps)
+    "p99_ttft_ms", "ttft_p99_ms", "queue_delay_p99_ms",
 )
 # config knobs stamped INTO the artifact (not measurements): changing a
 # setting between rounds must never read as a perf regression — the
@@ -71,6 +81,12 @@ _CONFIG_LEAVES = (
     "ttft_slo_ms", "threshold", "slo_ms", "grad_compression",
     "kv_cache_dtype", "prefill_buckets", "pool_blocks", "kv_block_size",
     "paged_kv",
+    # the open-loop sweep's offered-QPS grid and schedule knobs are the
+    # experiment's x-axis and shape, not measurements: widening the grid
+    # or retuning the arrival process between rounds must never read as
+    # a perf regression (max_wall_s would otherwise match "wall_s")
+    "qps_grid", "offered_qps", "requests_per_point", "burst_size",
+    "ramp_start_frac", "track_tol", "max_wall_s",
 )
 
 

@@ -66,6 +66,21 @@ def main(argv: list[str] | None = None) -> int:
              "requests submitted) falls below this floor, or when no "
              "router_summary was emitted (0 = off)",
     )
+    p.add_argument(
+        "--min-slo-attainment", type=float, default=0.0,
+        help="optional open-loop loadgen gate: fail when the QPS sweep's "
+             "best per-point slo_attainment (loadgen_point events) falls "
+             "below this floor, or when NO loadgen measurement was "
+             "emitted — a round that silently skips the open-loop sweep "
+             "fails instead of passing on the closed-loop numbers "
+             "(0 = off)",
+    )
+    p.add_argument(
+        "--max-p99-ttft-ms", type=float, default=0.0,
+        help="optional open-loop loadgen gate: fail when the QPS sweep's "
+             "lowest measured per-point p99 TTFT (from arrival) exceeds "
+             "this ceiling, or when no point measured one (0 = off)",
+    )
     args = p.parse_args(argv)
     from distributed_llms_example_tpu.obs.report import main as report_main
 
@@ -90,6 +105,10 @@ def main(argv: list[str] | None = None) -> int:
         flags += [
             "--min-serve-goodput-frac", str(args.min_serve_goodput_frac),
         ]
+    if args.min_slo_attainment > 0:
+        flags += ["--min-slo-attainment", str(args.min_slo_attainment)]
+    if args.max_p99_ttft_ms > 0:
+        flags += ["--max-p99-ttft-ms", str(args.max_p99_ttft_ms)]
     return report_main(flags)
 
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Thirteen rules, all born from real regressions at TPU scale:
+Fourteen rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -153,6 +153,20 @@ Thirteen rules, all born from real regressions at TPU scale:
    count is the same number everywhere).  The taint-tracking twin of
    this lexical rule is the divergence pass (``analysis/divergence.py``),
    which follows rank-local values into collectives across assignments.
+
+14. **No inline percentile/quantile computation outside ``obs/spans.py``.**
+   The repo has ONE quantile definition — ``obs.spans.percentiles``
+   (nearest-rank over sorted values) — and every tail-latency gate
+   (ttft_p99, queue_delay_p99, the loadgen SLO curves) compares numbers
+   produced by it.  A stray ``np.percentile(..., 99)`` (linear
+   interpolation by default) or a hand-rolled ``sorted(xs)[int(0.99 *
+   len(xs))]`` (off-by-one at the rank boundary) silently disagrees with
+   the owner on small samples — exactly where serving p99s live — so
+   two reports of the same run would gate differently.  Flagged: calls
+   named ``percentile``/``quantile``/``nanpercentile``/``nanquantile``
+   in any spelling, and subscripts of a ``sorted(...)`` result whose
+   index arithmetic involves ``len``/a multiplication (the sorted-index
+   idiom).  Everyone imports ``percentiles`` from the owner.
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -312,6 +326,14 @@ RANK_CONDITIONAL_OWNERS = {
 }
 _RANK_CALLS = ("process_index", "process_count")
 _POD_AGREED_PRAGMA = "# pod-agreed:"
+
+# Rule 14: the quantile definition is owned by obs/spans.py
+# (`percentiles`, nearest-rank) — every tail-latency gate compares its
+# numbers, so a second definition (np.percentile's interpolation, a
+# sorted-index one-liner) disagrees exactly on the small samples where
+# serving p99s live.
+PERCENTILE_OWNER = os.path.join(PACKAGE, "obs", "spans.py")
+_PERCENTILE_FNS = ("percentile", "quantile", "nanpercentile", "nanquantile")
 
 
 def _names_contain_lr(node: ast.AST) -> bool:
@@ -522,6 +544,49 @@ def _rank_conditional_violations(
                 "gather_probe — see analysis/divergence.py SANITIZERS) "
                 "or annotate the line `# pod-agreed: <mechanism>` naming "
                 "why the branch is pod-uniform"
+            )
+    return violations
+
+
+def _percentile_violations(tree: ast.AST, rel: str) -> list[str]:
+    """Rule 14: calls named percentile/quantile (any qualifier) and
+    sorted-index quantile idioms — ``sorted(xs)[<arith with len/mult>]``
+    — outside obs/spans.py."""
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name)
+                else None
+            )
+            if name in _PERCENTILE_FNS:
+                violations.append(
+                    f"{rel}:{node.lineno}: {name}(...) outside obs/spans.py "
+                    "forks the quantile definition (interpolation vs the "
+                    "owner's nearest-rank) — tail-latency gates comparing "
+                    "the two disagree on small samples; import "
+                    "obs.spans.percentiles"
+                )
+        elif (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Name)
+            and node.value.func.id == "sorted"
+            and any(
+                (isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Name)
+                 and n.func.id == "len")
+                or (isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult))
+                for n in ast.walk(node.slice)
+            )
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: sorted(...)[...] rank-index "
+                "quantile idiom outside obs/spans.py — hand-rolled rank "
+                "math is off-by-one at the boundary vs the owner's "
+                "nearest-rank definition; import obs.spans.percentiles"
             )
     return violations
 
@@ -787,6 +852,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         violations.extend(_retry_sleep_violations(tree, rel))
     if rel not in RANK_CONDITIONAL_OWNERS:
         violations.extend(_rank_conditional_violations(tree, rel, src))
+    if rel != PERCENTILE_OWNER:
+        violations.extend(_percentile_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)

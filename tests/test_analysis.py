@@ -595,6 +595,32 @@ def test_repo_lint_clean_and_catches_violations(tmp_path):
     rel = os.path.join("distributed_llms_example_tpu", "io", "okretry.py")
     assert repo_lint.lint_file(str(ok_retry), rel) == []
 
+    # rule 14: inline percentile/quantile computation outside the one
+    # owner — numpy spellings and the sorted-index rank idiom both fork
+    # the quantile definition the tail-latency gates compare against
+    bad_pct = tmp_path / "pct.py"
+    bad_pct.write_text(
+        "import numpy as np\n"
+        "p = np.percentile(xs, 99)\n"
+        "q = np.quantile(xs, 0.99)\n"
+        "r = sorted(xs)[int(0.99 * (len(xs) - 1))]\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "serving", "pct.py")
+    assert len(repo_lint.lint_file(str(bad_pct), rel)) == 3
+    # ...the owner holds the one definition
+    rel = os.path.join("distributed_llms_example_tpu", "obs", "spans.py")
+    assert repo_lint.lint_file(str(bad_pct), rel) == []
+    # the sanctioned spelling, and a plain sorted()[0] (min, not a
+    # quantile), stay legal everywhere
+    ok_pct = tmp_path / "okpct.py"
+    ok_pct.write_text(
+        "from distributed_llms_example_tpu.obs.spans import percentiles\n"
+        "(p99,) = percentiles(xs, (0.99,))\n"
+        "first = sorted(xs)[0]\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "serving", "okpct.py")
+    assert repo_lint.lint_file(str(ok_pct), rel) == []
+
 
 # ---------------------------------------------------------------------------
 # grad accumulation (ISSUE 5): accumulator-mirror spec lint, the

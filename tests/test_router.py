@@ -120,6 +120,7 @@ class FakeSession:
         self.slots = slots
         self.default_budget = default_budget
         self.requests: list[list] = []
+        self.arrivals: list[float | None] = []
         self.budgets: list[int] = []
         self.labels: list = []
         self.outputs: list[list[int]] = []
@@ -130,8 +131,10 @@ class FakeSession:
         self.frozen = False  # an ORGANIC stall: no progress, no raise
         self.finalized = False
 
-    def submit(self, tokens, *, max_new=None, attention_mask=None, label=None):
+    def submit(self, tokens, *, max_new=None, attention_mask=None, label=None,
+               arrival=None):
         rid = len(self.requests)
+        self.arrivals.append(arrival)
         self.requests.append(list(tokens))
         self.budgets.append(max_new or self.default_budget)
         self.labels.append(rid if label is None else label)

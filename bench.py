@@ -812,20 +812,20 @@ def _llama_depth_main() -> None:
         # step time; the per-microbatch overhead fraction is the cost of
         # the scan + the (amortized-away) once-per-step tail.
         if L == max(depths) and os.environ.get("BENCH_ACCUM_7B", "1") != "0":
-            from distributed_llms_example_tpu.obs.gauges import hbm_stats
+            from distributed_llms_example_tpu.obs import memprof
+
+            # peak_bytes_in_use is the allocator's PROCESS-LIFETIME
+            # high-water mark (never reset), so every field derived from
+            # it is named *_cumulative and each accumN entry also reports
+            # the watermark delta vs its own pre-pass mark(): delta 0
+            # proves the pass stayed under the historical peak (the
+            # memory-flatness claim), delta > 0 is the new high water
+            # this pass alone set
+            watermark = memprof.Watermark()
 
             def peak_gib():
-                # peak_bytes_in_use is the allocator's PROCESS-LIFETIME
-                # high-water mark (never reset), so every field derived
-                # from it is named *_cumulative and each accumN entry also
-                # reports the delta vs its own pre-pass baseline: delta 0
-                # proves the pass stayed under the historical peak (the
-                # memory-flatness claim), delta > 0 is the new high water
-                # this pass alone set
-                h = hbm_stats()
-                if not h:
-                    return None
-                return round(max(d["peak_bytes_in_use"] for d in h) / 1024**3, 2)
+                p = watermark.peak_bytes()
+                return round(p / memprof.GIB, 2) if p else None
 
             accum_list = [
                 int(x)
@@ -845,7 +845,7 @@ def _llama_depth_main() -> None:
             if p is not None:
                 accum_report["accum1_peak_hbm_gib_cumulative"] = p
             for N in accum_list:
-                base_peak = peak_gib()
+                watermark.mark()
                 rows = batch * n_chips * N
                 idsN = rng.randint(2, base.vocab_size, (rows, seq)).astype(np.int32)
                 labelsN = idsN.copy()
@@ -888,11 +888,12 @@ def _llama_depth_main() -> None:
                     p = peak_gib()
                     if p is not None:
                         entry["peak_hbm_gib_cumulative"] = p
-                        if base_peak is not None:
+                        delta = watermark.delta_bytes()
+                        if delta is not None:
                             # 0.0 == this pass stayed under the lifetime
                             # peak: the constant-memory acceptance signal
                             entry["peak_hbm_new_high_water_gib"] = round(
-                                p - base_peak, 2
+                                delta / memprof.GIB, 2
                             )
                     accum_report[f"accum{N}"] = entry
                     del gbN, mN
@@ -2637,6 +2638,38 @@ def main() -> None:
         except Exception as e:
             print(f"bench: serve block failed ({e})", file=sys.stderr)
             skipped_passes.append(f"serve block failed ({str(e)[:200]})")
+
+    # memory stamp: the static bucketed HBM account (obs/memprof.py) at
+    # the measured shape plus the allocator watermark this process set —
+    # the "where did the bytes go" record for the headline pass.  The
+    # account is an abstract AOT compile (no device buffers), so it is
+    # safe to run while the synthetic state is still resident.
+    if os.environ.get("BENCH_MEMORY", "1") != "0":
+        from distributed_llms_example_tpu.obs import memprof
+
+        try:
+            acct = memprof.static_memory_account(
+                name, mesh,
+                global_batch=batch, src_len=src_len, tgt_len=tgt_len,
+                remat=remat,
+                hbm_budget_gib=float(
+                    os.environ.get("BENCH_HBM_BUDGET_GIB", "16")
+                ),
+            )
+            result["memory_account"] = {
+                k: acct[k]
+                for k in (
+                    "buckets_bytes", "peak_bytes", "peak_gib",
+                    "additivity_gap_bytes", "hbm_budget_gib",
+                    "hbm_headroom_gib", "peak_frac_of_budget", "fits_budget",
+                )
+            }
+        except Exception as e:
+            print(f"bench: static memory account failed ({e})", file=sys.stderr)
+        wm = memprof.Watermark().read()
+        if wm is not None:
+            result["memory_watermark"] = wm
+        emit_result()
 
     # the full Trainer loop (bucketed batching + prefetch + logging on the
     # critical path): validating within ~5% of the with-dropout synthetic

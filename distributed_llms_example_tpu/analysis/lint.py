@@ -91,6 +91,8 @@ def run_passes(
     prefill_buckets: tuple = (),
     reshard_from: Any = None,
     divergence: bool = False,
+    memory: bool = False,
+    hbm_budget_gib: float = 16.0,
 ) -> list[Finding]:
     """The analysis passes over one (model, mesh, config) triple.
 
@@ -98,6 +100,14 @@ def run_passes(
     SPMD divergence lint, analysis/divergence.py) always; Layer 2 (the
     cross-program collective census over extra AOT-compiled variants,
     ir_lint.census_findings) when the IR pass runs.  On by default under
+    ``--strict``.
+
+    ``memory`` adds the static HBM account (obs/memprof.py) over the
+    compiled train step: the bucketed peak composition as an info
+    finding, and ``memory-over-budget`` (error) when the compiled peak
+    does not fit ``hbm_budget_gib``.  Runs only where the IR pass can
+    compile (same gates); skipped configs get a NAMED skip finding, so a
+    skipped account never reads as a fitting one.  On by default under
     ``--strict``."""
     import jax
 
@@ -287,8 +297,10 @@ def run_passes(
         planned.append("train_step[reshard-saved]")
     programs_scanned: list[str] = []
     programs_skipped: list[dict[str, str]] = []
+    ir_skip: list[str] = []
 
     def skip_all(reason: str) -> None:
+        ir_skip.append(reason)
         findings.extend(ir_lint.skipped(reason))
         programs_skipped.extend(
             {"program": name, "reason": reason} for name in planned
@@ -455,6 +467,33 @@ def run_passes(
                 },
                 census_pairs,
             )
+    if memory:
+        if ir_skip:
+            # the static account rides the IR pass's compile gates: where
+            # the train step cannot compile here, the account is SKIPPED
+            # by name — never silently reported as fitting
+            findings.append(Finding(
+                severity="info",
+                pass_name="memory",
+                code="memory-account-skipped",
+                message=f"static HBM account skipped: {ir_skip[0]}",
+                context={"pass": "memory", "reason": ir_skip[0]},
+            ))
+        else:
+            from distributed_llms_example_tpu.core.config import MeshConfig
+
+            findings += _memory_findings(
+                model,
+                MeshConfig(**axis_sizes),
+                global_batch=global_batch,
+                src_len=src_len,
+                tgt_len=tgt_len,
+                dtype=dtype,
+                remat=remat,
+                grad_accum_steps=grad_accum_steps,
+                grad_compression=grad_compression,
+                hbm_budget_gib=hbm_budget_gib,
+            )
     findings.append(Finding(
         severity="info",
         pass_name="ir",
@@ -474,6 +513,91 @@ def run_passes(
             "programs_skipped": programs_skipped,
         },
     ))
+    return findings
+
+
+def _memory_findings(
+    model: str,
+    mesh_config: Any,
+    *,
+    global_batch: int,
+    src_len: int,
+    tgt_len: int,
+    dtype: str,
+    remat: bool,
+    grad_accum_steps: int,
+    grad_compression: str,
+    hbm_budget_gib: float,
+) -> list[Finding]:
+    """The static HBM account as lint findings: one info finding with the
+    bucketed peak composition, plus ``memory-over-budget`` (error) when
+    the compiled peak exceeds the budget.  A failed account is a NAMED
+    warning, not a silent pass."""
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.obs import memprof
+
+    try:
+        account = memprof.static_memory_account(
+            model,
+            build_mesh(mesh_config),
+            global_batch=global_batch,
+            src_len=src_len,
+            tgt_len=tgt_len,
+            dtype=dtype,
+            remat=remat,
+            grad_accum_steps=grad_accum_steps,
+            grad_compression=grad_compression,
+            hbm_budget_gib=hbm_budget_gib,
+        )
+    except Exception as e:  # compile/account failure is a finding, not a crash
+        return [Finding(
+            severity="warning",
+            pass_name="memory",
+            code="memory-account-failed",
+            message=f"static HBM account failed: {type(e).__name__}: "
+                    f"{str(e)[:240]}",
+            context={"pass": "memory"},
+        )]
+    buckets = dict(account["buckets_bytes"])
+    top = max(buckets, key=lambda k: buckets[k]) if buckets else "other"
+    findings = [Finding(
+        severity="info",
+        pass_name="memory",
+        code="memory-account",
+        message=(
+            f"compiled train-step peak {account['peak_gib']} GiB "
+            f"({account['peak_frac_of_budget']:.2f} of the "
+            f"{account['hbm_budget_gib']} GiB budget); largest bucket "
+            f"{top} = {buckets.get(top, 0) / memprof.GIB:.2f} GiB"
+        ),
+        context={
+            "pass": "memory",
+            "peak_bytes": account["peak_bytes"],
+            "buckets_bytes": buckets,
+            "hbm_budget_gib": account["hbm_budget_gib"],
+            "hbm_headroom_gib": account["hbm_headroom_gib"],
+            "fits_budget": account["fits_budget"],
+            "additivity_gap_bytes": account["additivity_gap_bytes"],
+        },
+    )]
+    if not account["fits_budget"]:
+        findings.append(Finding(
+            severity="error",
+            pass_name="memory",
+            code="memory-over-budget",
+            message=(
+                f"compiled train-step peak {account['peak_gib']} GiB "
+                f"exceeds the {account['hbm_budget_gib']} GiB per-device "
+                f"HBM budget ({account['peak_frac_of_budget']:.2f}x); "
+                f"largest bucket {top} — shrink the batch, raise remat, "
+                f"or shard further before launching"
+            ),
+            context={
+                "pass": "memory",
+                "peak_bytes": account["peak_bytes"],
+                "hbm_budget_gib": account["hbm_budget_gib"],
+            },
+        ))
     return findings
 
 
@@ -570,8 +694,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "collectives) and, with the IR pass, the "
                         "cross-program collective-matching census over the "
                         "compiled lint set; implied by --strict")
+    p.add_argument("--memory", action="store_true",
+                   help="run the static HBM account (obs/memprof.py) over "
+                        "the compiled train step: the bucketed peak "
+                        "composition as an info finding, memory-over-budget "
+                        "(error) when the compiled peak exceeds "
+                        "--hbm-budget-gib; rides the IR pass's compile gates "
+                        "(skipped by name where the step cannot compile "
+                        "here); implied by --strict")
+    p.add_argument("--hbm-budget-gib", type=float, default=16.0,
+                   help="per-device HBM budget for --memory's over-budget "
+                        "verdict (default 16.0 = one v5e core)")
     p.add_argument("--strict", action="store_true",
-                   help="warnings also fail the run (implies --divergence)")
+                   help="warnings also fail the run (implies --divergence "
+                        "and --memory)")
     p.add_argument("--json", action="store_true", help="JSON-lines output")
     return p
 
@@ -643,6 +779,8 @@ def main(argv: list[str] | None = None) -> int:
             ),
             reshard_from=reshard_from,
             divergence=args.divergence or args.strict,
+            memory=args.memory or args.strict,
+            hbm_budget_gib=args.hbm_budget_gib,
         )
     emit(findings, as_json=args.json)
     counts = count_by_severity(findings)

@@ -242,6 +242,11 @@ class TrainConfig:
     obs_budget: str = "auto"
     # MFU denominator: peak per-chip FLOP/s in TFLOP/s (v5e bf16 ≈ 197)
     obs_peak_tflops: float = 197.0
+    # per-chip HBM ceiling in GiB for the bucketed memory account
+    # (obs/memprof.py): the static account's fit verdict, the report's
+    # --max-peak-hbm-frac / --min-hbm-headroom-gib denominators, and the
+    # serving capacity gauges all divide by this one number (v5e = 16)
+    hbm_budget_gib: float = 16.0
 
     # --- training health (obs/health.py + in-graph numerics in train/step.py) ---
     # "on": the compiled step also returns param norm, per-bucket update
@@ -481,6 +486,11 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--obs-peak-tflops", type=float, default=_D.obs_peak_tflops)
     p.add_argument(
+        "--hbm-budget-gib", type=float, default=_D.hbm_budget_gib,
+        help="per-chip HBM ceiling in GiB for the bucketed memory account "
+             "(obs/memprof.py fit verdict + report memory gates; v5e = 16)",
+    )
+    p.add_argument(
         "--health", type=str, default=_D.health, choices=("auto", "on", "off"),
         help="in-graph numerics (param norm, per-bucket update ratios, "
              "non-finite counts) + the anomaly watchdog at the log cadence "
@@ -515,8 +525,8 @@ def add_tpu_args(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--chaos", type=str, default=_D.chaos,
         help="deterministic fault injection: comma list of kind@tick with "
-             "kind in nan_grad/ckpt_corrupt/data_error/sigterm/host_loss "
-             "(tick = global step; for ckpt_corrupt the Nth checkpoint "
+             "kind in nan_grad/ckpt_corrupt/data_error/sigterm/host_loss/"
+             "oom (tick = global step; for ckpt_corrupt the Nth checkpoint "
              "save), e.g. 'nan_grad@120,ckpt_corrupt@2'; every firing is "
              "logged as a chaos_injection event",
     )

@@ -172,6 +172,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-block-size", type=int, default=0,
                    help="paged: block length in cache positions (0 = the "
                         "kv tile size for the cache width)")
+    p.add_argument("--hbm-budget-gib", type=float, default=16.0,
+                   help="per-chip HBM ceiling in GiB for the serve "
+                        "summary's bucketed memory account (obs/memprof.py "
+                        "fit verdict; v5e = 16)")
+    p.add_argument("--postmortem-dir", type=str, default="",
+                   help="where a RESOURCE_EXHAUSTED mid-serve dumps its "
+                        "atomic memory-postmortem-p*.json bundle "
+                        "('' = tripwire off)")
     p.add_argument("--mesh", type=str, default="data=-1")
     p.add_argument("--compute-dtype", type=str, default="bfloat16")
     p.add_argument("--attention-impl", type=str, default="",
@@ -323,6 +331,8 @@ def _serve_config_from_args(args):
         paged_kv=args.paged_kv,
         pool_blocks=args.pool_blocks,
         kv_block_size=args.kv_block_size,
+        hbm_budget_gib=args.hbm_budget_gib,
+        postmortem_dir=args.postmortem_dir,
     )
 
 

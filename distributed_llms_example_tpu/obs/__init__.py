@@ -67,6 +67,7 @@ from typing import Any, Iterable, Iterator
 from distributed_llms_example_tpu.obs import health as health_mod
 from distributed_llms_example_tpu.obs import profile as profile_mod
 from distributed_llms_example_tpu.obs import sink as sink_mod
+from distributed_llms_example_tpu.obs.memprof import MemoryMonitor
 from distributed_llms_example_tpu.obs.budget import BudgetAccountant, budget_enabled
 from distributed_llms_example_tpu.obs.health import HealthWatchdog, health_enabled
 from distributed_llms_example_tpu.obs.heartbeat import Heartbeat
@@ -158,6 +159,10 @@ class TrainerObs:
         # step and the static per-step collective byte account
         self._op_buckets: dict[str, str] | None = None
         self._comm_account: dict | None = None
+        # the HBM account + watermark telemetry (obs/memprof.py): samples
+        # memory_window events at the log cadence and holds the last
+        # static account for the OOM postmortem bundle
+        self.memory = MemoryMonitor() if self.enabled else None
         # --profile-on-anomaly: an agreed anomaly arms the profiler's own
         # trigger file, so the NEXT steps are captured and the post-mortem
         # carries a device timeline next to the flight recorder
@@ -236,6 +241,7 @@ class TrainerObs:
                     remat_policy=cfg.remat_policy,
                     grad_accum_steps=cfg.grad_accum_steps,
                     grad_compression=getattr(cfg, "grad_compression", ""),
+                    hbm_budget_gib=float(getattr(cfg, "hbm_budget_gib", 16.0)),
                 )
         except Exception as e:  # never fail training for telemetry
             sink_mod.emit({
@@ -249,6 +255,14 @@ class TrainerObs:
         # account is re-read from the emitted record at report time
         self._op_buckets = report.pop("op_bucket_index", None)
         self._comm_account = report.get("comm")
+        # the bucketed HBM account gets its OWN event (the report's
+        # "Where did the bytes go" table reads it from the JSONL alone)
+        # and seeds the monitor so an OOM postmortem carries it
+        account = report.pop("memory_account", None)
+        if account is not None:
+            if self.memory is not None:
+                self.memory.attach_account(account)
+            sink_mod.emit({"event": "memory_account", **account})
         sink_mod.emit({
             "event": "obs_gauges",
             "peak_flops_per_chip": self.peak_flops_per_chip,
@@ -468,11 +482,16 @@ class TrainerObs:
             record["mfu"] = float(f"{mfu:.4g}")
         if self._last_health is not None:
             record["health"] = self._last_health
-        from distributed_llms_example_tpu.obs.gauges import hbm_stats
-
-        hbm = hbm_stats()
-        if hbm is not None:
-            record["hbm"] = hbm
+        if self.memory is not None:
+            # one cadenced memory_stats read: a memory_window event with
+            # watermark-delta-since-last-window (or a single named skip on
+            # backends that report nothing), plus the live summary inline
+            hbm = self.memory.sample(step)
+            if hbm is not None:
+                record["hbm"] = {
+                    k: hbm[k]
+                    for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                }
         # local: every process's window lands in its OWN jsonl file (the
         # cross-host timeline obs/report.py merges); stdout stays p0-only
         sink_mod.emit(record, local=True)

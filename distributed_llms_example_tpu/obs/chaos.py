@@ -32,6 +32,11 @@ the save cadence maps to steps).  Kinds:
                       rides the real preemption handler: the flag is
                       agreed over the same heartbeat-cadence allgather,
                       so every rank takes the topology branch together
+- ``oom@K``           raise a RESOURCE_EXHAUSTED-shaped error before
+                      step K dispatches — exercises the OOM tripwire
+                      (obs/memprof.py): the trainer must land an atomic
+                      ``memory-postmortem-p*.json`` bundle and re-raise,
+                      never swallow
 
 Serving kinds (the router tier, serving/router.py — ticks are **router
 scheduler ticks**, the serving counterpart of optimizer steps; they fire
@@ -65,7 +70,7 @@ import os
 from typing import Iterable
 
 KINDS = (
-    "nan_grad", "ckpt_corrupt", "data_error", "sigterm", "host_loss",
+    "nan_grad", "ckpt_corrupt", "data_error", "sigterm", "host_loss", "oom",
     "replica_crash", "replica_stall", "request_storm",
 )
 # the serving subset: ticks are router scheduler ticks, consumed only by

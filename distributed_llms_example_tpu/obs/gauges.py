@@ -74,21 +74,13 @@ def mfu(
 
 def hbm_stats() -> list[dict] | None:
     """Per-local-device live memory: bytes in use / peak / limit.  None
-    when the backend does not report (CPU PJRT) — absent beats zero."""
-    import jax
+    when the backend does not report (CPU PJRT) — absent beats zero.
+    Since the memprof PR, ``obs/memprof.py`` owns the raw
+    ``memory_stats`` read (repo-lint rule 15); this re-export keeps the
+    historical import site working."""
+    from distributed_llms_example_tpu.obs import memprof
 
-    out = []
-    for d in jax.local_devices():
-        stats = d.memory_stats() if hasattr(d, "memory_stats") else None
-        if not stats:
-            return None
-        out.append({
-            "device": d.id,
-            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
-            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
-            "bytes_limit": int(stats.get("bytes_limit", 0)),
-        })
-    return out
+    return memprof.hbm_stats()
 
 
 def collective_traffic(
@@ -148,18 +140,22 @@ def train_step_static_gauges(
     remat_policy: str = "full",
     grad_accum_steps: int = 1,
     grad_compression: str = "",
+    hbm_budget_gib: float = 16.0,
 ) -> dict:
     """AOT-compile the train step (the shared recipe the memory audit and
     IR lint use — utils/memory_audit.py) and derive the static gauges:
-    per-step FLOPs for the MFU numerator and the collective-traffic
-    account.  No weights materialize; the compile is the only cost."""
+    per-step FLOPs for the MFU numerator, the collective-traffic account,
+    and the bucketed HBM account (obs/memprof.py) — all from the ONE
+    compiled program.  No weights materialize; the compile is the only
+    cost."""
     import jax
 
+    from distributed_llms_example_tpu.obs import memprof
     from distributed_llms_example_tpu.utils.memory_audit import (
         aot_compile_train_step,
     )
 
-    compiled, lm, a_params, _, _ = aot_compile_train_step(
+    compiled, lm, a_params, a_state, state_sh = aot_compile_train_step(
         model_name,
         mesh,
         global_batch=global_batch,
@@ -223,6 +219,14 @@ def train_step_static_gauges(
         "flops_per_step": flops,
         "flops_source": flops_source,
         "comm": comm,
+        # the bucketed HBM account of the SAME compiled program — the
+        # trainer pops this into its own memory_account event and hands
+        # it to the memory monitor for OOM postmortems
+        "memory_account": memprof.account_from_compiled(
+            compiled, a_state, state_sh,
+            hbm_budget_gib=hbm_budget_gib,
+            model=model_name, mesh=dict(mesh.shape),
+        ),
         # instruction→bucket index for the device-time attribution
         # (obs/devprof.py): CPU-backend traces name device events by HLO
         # instruction, and this program is the same lowering the runtime

@@ -127,7 +127,32 @@ def load_run(output_dir: str) -> dict[str, Any]:
             )
             continue
         recorders[int(m.group(1))] = bundle
-    return {"processes": processes, "recorders": recorders, "errors": errors}
+    postmortems: dict[int, dict] = {}
+    for path in sorted(
+        glob.glob(os.path.join(obs_dir, "memory-postmortem-p*.json"))
+    ):
+        m = re.search(r"-p(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                bundle = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"{path}: unreadable bundle ({e})")
+            continue
+        if bundle.get("schema_version") != SCHEMA_VERSION:
+            errors.append(
+                f"{path}: schema_version {bundle.get('schema_version')!r} "
+                f"!= {SCHEMA_VERSION}"
+            )
+            continue
+        postmortems[int(m.group(1))] = bundle
+    return {
+        "processes": processes,
+        "recorders": recorders,
+        "postmortems": postmortems,
+        "errors": errors,
+    }
 
 
 def _by_event(records: list[dict]) -> dict[str, list[dict]]:
@@ -713,6 +738,100 @@ def loadgen_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
     }
 
 
+def memory_report(
+    processes: dict[int, list[dict]],
+    postmortems: dict[int, dict] | None = None,
+) -> dict[str, Any] | None:
+    """"Where did the bytes go" — the HBM rollup from the JSONL (and
+    postmortem bundles) alone: the last static ``memory_account`` (the
+    bucketed peak composition of the compiled step), the runtime
+    ``memory_window`` envelope (max bytes-in-use / peak / per-window
+    watermark delta over every rank's samples), the serving tier's
+    account off its ``serve_summary``, and any ``memory-postmortem-p*``
+    bundles.  ``measured_peak_bytes`` is the gate input: the runtime peak
+    when any window was sampled, else the static account's compiled peak
+    — a run with NEITHER has no measurement, and the strict gates treat
+    that as a failure, never a pass."""
+    accounts: list[dict] = []
+    windows: list[dict] = []
+    skips: list[dict] = []
+    serve_accounts: list[dict] = []
+    for _, records in sorted(processes.items()):
+        ev = _by_event(records)
+        accounts.extend(ev.get("memory_account", []))
+        windows.extend(ev.get("memory_window", []))
+        skips.extend(ev.get("memory_window_skipped", []))
+        for r in ev.get("serve_summary", []):
+            if isinstance(r.get("memory_account"), dict):
+                serve_accounts.append(r["memory_account"])
+    postmortems = postmortems or {}
+    if not (accounts or windows or skips or serve_accounts or postmortems):
+        return None
+    account = accounts[-1] if accounts else None
+    serve_account = serve_accounts[-1] if serve_accounts else None
+    runtime = None
+    if windows:
+        runtime = {
+            "windows": len(windows),
+            "max_bytes_in_use": max(int(w.get("bytes_in_use", 0)) for w in windows),
+            "peak_bytes_in_use": max(
+                int(w.get("peak_bytes_in_use", 0)) for w in windows
+            ),
+            "max_watermark_delta_bytes": max(
+                int(w.get("watermark_delta_bytes", 0)) for w in windows
+            ),
+            "bytes_limit": max(int(w.get("bytes_limit", 0)) for w in windows),
+        }
+    measured_peak = None
+    peak_source = None
+    if runtime is not None:
+        measured_peak = runtime["peak_bytes_in_use"]
+        peak_source = "memory_window"
+    elif account is not None and isinstance(
+        account.get("peak_bytes"), (int, float)
+    ):
+        measured_peak = int(account["peak_bytes"])
+        peak_source = "static_account"
+    budget_bytes = None
+    for src in (account, serve_account):
+        if src is not None and isinstance(
+            src.get("hbm_budget_bytes"), (int, float)
+        ):
+            budget_bytes = int(src["hbm_budget_bytes"])
+            break
+    headrooms = [
+        a["hbm_headroom_gib"]
+        for a in (account, serve_account)
+        if a is not None and isinstance(a.get("hbm_headroom_gib"), (int, float))
+    ]
+    return {
+        "account": account,
+        "serve_account": serve_account,
+        "runtime": runtime,
+        "static_only": bool(not windows and (account or serve_account)),
+        "skips": [s.get("reason") for s in skips[:1]],
+        "measured_peak_bytes": measured_peak,
+        "measured_peak_source": peak_source,
+        "hbm_budget_bytes": budget_bytes,
+        "peak_frac_of_budget": (
+            round(measured_peak / budget_bytes, 4)
+            if (measured_peak is not None and budget_bytes)
+            else None
+        ),
+        "min_headroom_gib": min(headrooms) if headrooms else None,
+        "postmortems": {
+            str(p): {
+                "reason": b.get("reason"),
+                "step": b.get("step"),
+                "has_account": b.get("account") is not None,
+                "watermark_samples": len(b.get("watermark_history") or []),
+                "live_buffers_top": len(b.get("live_buffers_top") or []),
+            }
+            for p, b in sorted(postmortems.items())
+        },
+    }
+
+
 def build_report(output_dir: str) -> dict[str, Any]:
     run = load_run(output_dir)
     processes = run["processes"]
@@ -733,6 +852,7 @@ def build_report(output_dir: str) -> dict[str, Any]:
         "comm": comm_report(processes),
         "budget": budget_report(processes),
         "device": device_report(processes),
+        "memory": memory_report(processes, run["postmortems"]),
         "loadgen": loadgen_report(processes),
         "recovery": recovery_report(processes),
         "anomalies": anomalies,
@@ -983,6 +1103,79 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
                 )
         if "reduce_scatter_smell" in comm:
             add(f"- **smell**: {comm['reduce_scatter_smell'].get('message')}")
+    mem = report.get("memory")
+    if mem is not None:
+        add("")
+        add("## Where did the bytes go")
+        acct = mem.get("account")
+        if acct is not None:
+            add(
+                f"- static account (model {acct.get('model')}, mesh "
+                f"{acct.get('mesh')}): compiled peak "
+                f"{int(acct.get('peak_bytes', 0)):,} B "
+                f"({_fmt(acct.get('peak_gib'))} GiB) vs budget "
+                f"{_fmt(acct.get('hbm_budget_gib'))} GiB — "
+                + ("fits" if acct.get("fits_budget") else "**OVER BUDGET**")
+                + f" (headroom {_fmt(acct.get('hbm_headroom_gib'))} GiB, "
+                f"additivity gap {int(acct.get('additivity_gap_bytes', 0)):,} B)"
+            )
+            add("")
+            add("| bucket | bytes | GiB | share of peak |")
+            add("|---|---|---|---|")
+            peak = max(1, int(acct.get("peak_bytes", 0)))
+            for bucket, b in sorted(
+                (acct.get("buckets_bytes") or {}).items(),
+                key=lambda kv: -kv[1],
+            ):
+                add(
+                    f"| {bucket} | {int(b):,} | {b / 1024**3:.3f} | "
+                    f"{b / peak:.1%} |"
+                )
+            add("")
+            for row in (acct.get("largest_buffers") or [])[:8]:
+                add(
+                    f"- {row.get('name')}: {int(row.get('bytes', 0)):,} B "
+                    f"(shard {row.get('shard_shape')} {row.get('dtype')}"
+                    + (
+                        f", module {row['module']}"
+                        if row.get("module")
+                        else ""
+                    )
+                    + ")"
+                )
+        sa = mem.get("serve_account")
+        if sa is not None:
+            buckets = sa.get("buckets_bytes") or {}
+            add(
+                f"- serving account: params {int(buckets.get('params', 0)):,} B"
+                f" + kv_cache {int(buckets.get('kv_cache', 0)):,} B = "
+                f"{int(sa.get('peak_bytes', 0)):,} B vs budget "
+                f"{_fmt(sa.get('hbm_budget_gib'))} GiB — "
+                + ("fits" if sa.get("fits_budget") else "**OVER BUDGET**")
+            )
+        rt = mem.get("runtime")
+        if rt is not None:
+            add(
+                f"- runtime ({rt.get('windows')} memory_window samples): "
+                f"bytes in use ≤ {rt.get('max_bytes_in_use', 0):,} B, "
+                f"process peak {rt.get('peak_bytes_in_use', 0):,} B, "
+                f"largest per-window watermark delta "
+                f"{rt.get('max_watermark_delta_bytes', 0):,} B"
+            )
+        elif mem.get("static_only"):
+            reason = (mem.get("skips") or [None])[0]
+            add(
+                "- runtime: static-only"
+                + (f" — {reason}" if reason else "")
+            )
+        for p, b in sorted((mem.get("postmortems") or {}).items()):
+            add(
+                f"- **OOM postmortem** p{p} at step {b.get('step')}: "
+                f"{b.get('reason')} ({b.get('watermark_samples')} watermark "
+                f"samples, account "
+                + ("attached" if b.get("has_account") else "absent")
+                + ")"
+            )
     lg = report.get("loadgen")
     if lg is not None:
         add("")
@@ -1185,6 +1378,22 @@ def main(argv: list[str] | None = None) -> int:
              "measurement must never read as a pass",
     )
     p.add_argument(
+        "--max-peak-hbm-frac", type=float, default=0.0,
+        help="with --strict: fail when the measured HBM peak (the runtime "
+             "memory_window peak where sampled, else the static account's "
+             "compiled peak) exceeds this fraction of the account's "
+             "--hbm-budget-gib ceiling, or when NO memory measurement "
+             "exists at all (0 = the gate is off); a missing measurement "
+             "must never read as a pass",
+    )
+    p.add_argument(
+        "--min-hbm-headroom-gib", type=float, default=0.0,
+        help="with --strict: fail when any memory account's "
+             "hbm_headroom_gib (budget minus peak) falls below this floor, "
+             "or when NO memory account exists (0 = the gate is off); a "
+             "missing measurement must never read as a pass",
+    )
+    p.add_argument(
         "--trace", type=str, default="",
         help="also export the merged Chrome-trace/Perfetto JSON here "
              "(every rank's spans aligned on shared step boundaries, "
@@ -1322,6 +1531,44 @@ def main(argv: list[str] | None = None) -> int:
                     f"strict: best per-point p99 TTFT {best} ms exceeds "
                     f"the {args.max_p99_ttft_ms} ms ceiling at every "
                     "offered rate on the sweep grid", file=sys.stderr,
+                )
+                rc = 1
+        mem = report.get("memory")
+        if args.max_peak_hbm_frac > 0:
+            frac = (mem or {}).get("peak_frac_of_budget")
+            if frac is None:
+                print(
+                    "strict: --max-peak-hbm-frac set but no memory "
+                    "measurement found (no memory_window samples and no "
+                    "memory_account — run with --obs jsonl so the startup "
+                    "gauges emit the static account) — a missing "
+                    "measurement must never read as a pass", file=sys.stderr,
+                )
+                rc = 1
+            elif frac > args.max_peak_hbm_frac:
+                src = (mem or {}).get("measured_peak_source")
+                print(
+                    f"strict: HBM peak at {frac} of the budget "
+                    f"(source: {src}) exceeds the {args.max_peak_hbm_frac} "
+                    "ceiling — where the bytes went is in the report's "
+                    "memory section", file=sys.stderr,
+                )
+                rc = 1
+        if args.min_hbm_headroom_gib > 0:
+            headroom = (mem or {}).get("min_headroom_gib")
+            if headroom is None:
+                print(
+                    "strict: --min-hbm-headroom-gib set but no memory "
+                    "account found (run with --obs jsonl so the startup "
+                    "gauges emit the static account) — a missing "
+                    "measurement must never read as a pass", file=sys.stderr,
+                )
+                rc = 1
+            elif headroom < args.min_hbm_headroom_gib:
+                print(
+                    f"strict: hbm_headroom_gib {headroom} below the "
+                    f"{args.min_hbm_headroom_gib} GiB floor — the config "
+                    "is one allocation spike from an OOM", file=sys.stderr,
                 )
                 rc = 1
         ov_floor = args.min_overlap_frac

@@ -32,6 +32,23 @@ records gained ``t_arrival_s``/``queue_delay_ms`` (arrival→submit) and
 ``queue_growth`` gauges.  ``obs.report``'s "Open-loop load sweep"
 section and the ``--min-slo-attainment``/``--max-p99-ttft-ms`` strict
 gates consume these from the JSONL stream alone.
+
+HBM attribution (obs/memprof.py — still additive, same version):
+``memory_account`` is the static bucketed peak composition of the
+compiled train step (``buckets_bytes`` over params / optimizer_state /
+grad_accum / activations / kv_cache / other, the compiled byte view,
+the largest-N buffers, and the ``fits_budget`` verdict against
+``hbm_budget_gib``), emitted once at startup; ``memory_window`` is the
+log-cadence runtime reading (bytes in use / process-lifetime peak /
+``watermark_delta_bytes`` since the previous window, per-process
+``local`` files since every rank's devices differ) with a once-only
+``memory_window_skipped`` named skip where the backend reports no
+``memory_stats`` (CPU PJRT — absent beats zero); ``memory_postmortem``
+announces an OOM forensics bundle landed atomically at
+``obs/memory-postmortem-p*.json`` (the bundle itself carries the same
+``schema_version``).  ``obs.report``'s "Where did the bytes go" section
+and the ``--max-peak-hbm-frac``/``--min-hbm-headroom-gib`` strict gates
+consume these from the JSONL/bundle files alone.
 """
 
 from __future__ import annotations

@@ -238,6 +238,22 @@ def build_trace(output_dir: str) -> dict[str, Any]:
                     "ph": "i", "s": "p", "pid": rank, "tid": TID_STEPS,
                     "ts": _us(t),
                 })
+            elif ev == "memory_window":
+                # the per-rank memory counter track: live bytes + the
+                # process peak as stacked counters on the gauges lane,
+                # anchored like every other step-cadence record
+                t = at_step(r)
+                if t is not None and "bytes_in_use" in r:
+                    events.append({
+                        "name": "hbm_bytes", "ph": "C",
+                        "pid": rank, "tid": TID_COUNTERS, "ts": _us(t),
+                        "args": {
+                            "bytes_in_use": r.get("bytes_in_use", 0),
+                            "peak_bytes_in_use": r.get(
+                                "peak_bytes_in_use", 0
+                            ),
+                        },
+                    })
             elif ev == "device_account":
                 events.extend(_device_lane_events(rank, r, marks, off))
             elif ev == "serve_request":

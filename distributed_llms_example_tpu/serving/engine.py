@@ -122,6 +122,13 @@ class ServeConfig:
     paged_kv: bool = False
     pool_blocks: int = 0  # 0 = worst case (max_slots x tiles per slot)
     kv_block_size: int = 0  # 0 = auto (the kv tile size for the cache width)
+    # the bucketed HBM account (obs/memprof.py): the capacity gauges'
+    # cache-bytes arithmetic lands in the shared params/kv_cache taxonomy
+    # and the serve_summary carries its fit verdict against this ceiling
+    hbm_budget_gib: float = 16.0
+    # where a RESOURCE_EXHAUSTED mid-serve dumps its atomic
+    # memory-postmortem-p*.json bundle ("" = tripwire off)
+    postmortem_dir: str = ""
 
 
 @dataclasses.dataclass
@@ -221,18 +228,20 @@ def compute_goodput(
 
 
 def device_peak_bytes() -> int | None:
-    """Peak allocator bytes from ``memory_stats`` where the backend
-    supports it (TPU/GPU); None on CPU — callers fall back to the static
-    account, which is why the capacity gauges never claim a live number
-    they didn't measure."""
+    """Peak allocator bytes where the backend supports ``memory_stats``
+    (TPU/GPU); None on CPU — callers fall back to the static account,
+    which is why the capacity gauges never claim a live number they
+    didn't measure.  Delegates to the one raw-read owner
+    (obs/memprof.py, repo-lint rule 15)."""
     try:
-        ms = jax.local_devices()[0].memory_stats()
+        from distributed_llms_example_tpu.obs import memprof
+
+        stats = memprof.hbm_stats()
     except Exception:
         return None
-    if not ms:
+    if not stats:
         return None
-    peak = ms.get("peak_bytes_in_use")
-    return int(peak) if peak is not None else None
+    return max(s["peak_bytes_in_use"] for s in stats)
 
 
 class ServingEngine:
@@ -806,6 +815,12 @@ class ServeSession:
         self.stats.cache_bytes_resident, self._per_block = (
             eng._state_byte_account(self.state)
         )
+        # loaded-weight bytes for the shared memory account (metadata
+        # arithmetic only — no device fetch)
+        self.params_bytes = int(sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize
+            for x in jax.tree.leaves(params)
+        ))
         self._bpt_samples: list[float] = []
         self._win_tokens, self._win_occ = 0, 0.0
         self._win_t0 = time.perf_counter()
@@ -1067,7 +1082,46 @@ class ServeSession:
         is live — one decode step.  Returns the session-local rids of
         requests that finished during this call (finish-at-prefill
         included).  The batch ``generate`` loop is
-        ``while has_work(): step()``."""
+        ``while has_work(): step()``.  A RESOURCE_EXHAUSTED escaping the
+        round trips the OOM forensics (obs/memprof.py): the postmortem
+        bundle lands atomically, then the error re-raises — the session
+        never swallows it."""
+        try:
+            return self._step_round()
+        except Exception as e:
+            self._oom_tripwire(e)
+            raise
+
+    def _memory_account(self) -> dict:
+        """The serving tier's bucketed HBM account over the shared
+        taxonomy: loaded weights in ``params``, the live cache/pool bytes
+        (the capacity gauges' arithmetic) in ``kv_cache``."""
+        from distributed_llms_example_tpu.obs import memprof
+
+        return memprof.serving_account(
+            params_bytes=self.params_bytes,
+            kv_cache_bytes=self._bytes_in_use(),
+            hbm_budget_gib=self.eng.serve.hbm_budget_gib,
+        )
+
+    def _oom_tripwire(self, e: BaseException) -> None:
+        """Dump the memory postmortem when ``e`` is an OOM and a dump dir
+        is configured; the caller re-raises either way."""
+        out_dir = self.eng.serve.postmortem_dir
+        if not out_dir:
+            return
+        from distributed_llms_example_tpu.obs import memprof
+
+        if not memprof.is_resource_exhausted(e):
+            return
+        memprof.dump_postmortem(
+            out_dir,
+            reason=f"{type(e).__name__}: {str(e)[:300]}",
+            step=self.stats.decode_steps,
+            account=self._memory_account(),
+        )
+
+    def _step_round(self) -> list[int]:
         if self._finalized:
             raise RuntimeError("session already finalized")
         eng = self.eng
@@ -1252,6 +1306,12 @@ class ServeSession:
             summary["admit_deferrals"] = stats.admit_deferrals
         if self.replica is not None:
             summary["replica"] = int(self.replica)
+        # the shared bucketed account (params + kv_cache over the one
+        # taxonomy) with its fit verdict — the capacity gauges' bytes,
+        # re-pointed through obs/memprof.py
+        acct = self._memory_account()
+        summary["memory_account"] = acct
+        summary["hbm_headroom_gib"] = acct["hbm_headroom_gib"]
         peak_hbm = device_peak_bytes()
         if peak_hbm is not None:
             # live allocator peak where the backend supports memory_stats

@@ -1822,15 +1822,23 @@ class Trainer:
         self._install_preemption_handler()
         try:
             return self._train_loop()
-        except Exception:
+        except Exception as e:
             # a crashing step must still leave the post-mortem evidence:
-            # dump the flight recorder (ring → atomic bundle) and push
-            # the JSONL channel to disk before the traceback propagates
+            # dump the flight recorder (ring → atomic bundle) — and, when
+            # the crash is a RESOURCE_EXHAUSTED, the memory postmortem
+            # (last static account + watermark history + live-buffer
+            # top-N) — then push the JSONL channel to disk before the
+            # traceback propagates
+            crash_step = int(getattr(self, "_last_step", self.start_step))
             if self.obs.recorder is not None:
                 self.obs.recorder.dump(
                     self.cfg.output_dir,
                     reason="exception",
-                    step=int(getattr(self, "_last_step", self.start_step)),
+                    step=crash_step,
+                )
+            if self.obs.memory is not None:
+                self.obs.memory.maybe_dump_postmortem(
+                    self.cfg.output_dir, step=crash_step, error=e
                 )
             from distributed_llms_example_tpu.obs import sink as sink_mod
 
@@ -1894,6 +1902,15 @@ class Trainer:
                     if self.recovery.should_skip(epoch, pos - 1, batch):
                         continue  # quarantined batch: the retry skips it
                     obs.profiler.before_step(step + 1)
+                    if self.chaos.take("oom", step + 1):
+                        # RESOURCE_EXHAUSTED-shaped so the memprof
+                        # tripwire (train()'s except hook) fires exactly
+                        # like a real XLA OOM: postmortem bundle, then
+                        # the raise propagates
+                        raise RuntimeError(
+                            "RESOURCE_EXHAUSTED: chaos-injected out of "
+                            f"memory before step {step + 1}"
+                        )
                     if self.chaos.take("nan_grad", step + 1):
                         # chaos (or the legacy test hook): corrupt one
                         # param element (lazy device op — the NaN surfaces

@@ -49,6 +49,59 @@ def _shard_bytes(tree: Any, shardings: Any) -> int:
     return total
 
 
+def compiled_byte_view(ma: Any) -> dict:
+    """XLA's ``memory_analysis()`` as per-device byte counts, with the ONE
+    peak formula (donation credited: the state argument aliases its output
+    buffers, so steady-state outputs cost only the non-aliased slack)
+
+        peak = arguments + temps + max(0, outputs - aliased)
+
+    Both the audit's ``compiled_*`` view and ``obs/memprof.py``'s bucketed
+    account read XLA through this function — single owner, no forked
+    arithmetic."""
+    args_b = int(ma.argument_size_in_bytes)
+    out_b = int(ma.output_size_in_bytes)
+    alias_b = int(ma.alias_size_in_bytes)
+    temp_b = int(ma.temp_size_in_bytes)
+    return {
+        "arguments_bytes": args_b,
+        "output_bytes": out_b,
+        "aliased_bytes": alias_b,
+        "temp_bytes": temp_b,
+        "peak_bytes": args_b + temp_b + max(0, out_b - alias_b),
+    }
+
+
+# TrainState field → shared memory-bucket taxonomy (obs/memprof.py BUCKETS).
+# ``ef`` is the per-worker fp32 error-feedback carry from --grad-compression,
+# i.e. gradient-accumulation state that persists across steps.
+_STATE_FIELD_BUCKETS = {
+    "params": "params",
+    "opt_state": "optimizer_state",
+    "ef": "grad_accum",
+}
+
+
+def state_bucket_bytes(a_state: Any, sh: Any) -> dict[str, int]:
+    """Per-device shard bytes of the train state, split by top-level
+    TrainState field into the shared bucket taxonomy.  Per-leaf additive,
+    so ``sum(values)`` EQUALS ``_shard_bytes(a_state, sh)`` — the audit's
+    ``analytic_state_bytes`` and memprof's params/optimizer buckets are
+    the same numbers from this one function."""
+    import dataclasses
+
+    buckets: dict[str, int] = {}
+    if dataclasses.is_dataclass(a_state):
+        for f in dataclasses.fields(a_state):
+            bucket = _STATE_FIELD_BUCKETS.get(f.name, "other")
+            buckets[bucket] = buckets.get(bucket, 0) + _shard_bytes(
+                getattr(a_state, f.name), getattr(sh, f.name)
+            )
+    else:
+        buckets["other"] = _shard_bytes(a_state, sh)
+    return buckets
+
+
 def _activation_bytes(
     config: Any, b_loc: int, src: int, tgt: int, dtype_bytes: int, remat: bool,
 ) -> dict:
@@ -280,7 +333,8 @@ def audit_train_step_memory(
         )
 
     # ---- analytic per-device accounting (backend-independent) ----
-    state_b = _shard_bytes(a_state, sh)
+    state_buckets = state_bucket_bytes(a_state, sh)
+    state_b = sum(state_buckets.values())
     # gradients: fp32, sharded like the params (one full tree live at the
     # optimizer update, alongside a comparable fused-update temporary)
     params_sh = state_shardings(a_params, mesh)
@@ -312,11 +366,12 @@ def audit_train_step_memory(
 
     backend = jax.default_backend()
     if ma is not None:
-        args_b = int(ma.argument_size_in_bytes)
-        out_b = int(ma.output_size_in_bytes)
-        alias_b = int(ma.alias_size_in_bytes)
-        temp_b = int(ma.temp_size_in_bytes)
-        compiled_peak = args_b + temp_b + max(0, out_b - alias_b)
+        view = compiled_byte_view(ma)
+        args_b = view["arguments_bytes"]
+        out_b = view["output_bytes"]
+        alias_b = view["aliased_bytes"]
+        temp_b = view["temp_bytes"]
+        compiled_peak = view["peak_bytes"]
     else:
         args_b = out_b = alias_b = temp_b = compiled_peak = 0
     # the fit verdict: compiled stats when compiled for TPU, analytic model
@@ -339,6 +394,7 @@ def audit_train_step_memory(
         "params": n_params,
         "backend": backend,
         "analytic_state_bytes": state_b,
+        "analytic_state_bucket_bytes": state_buckets,
         "analytic_grad_bytes": grads_b,
         "analytic_activation_bytes": act,
         "analytic_peak_bytes": analytic_peak,

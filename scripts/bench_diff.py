@@ -55,6 +55,9 @@ _HIGHER_BETTER = (
     # above already cover goodput_at_slo lexically — named here so the
     # direction survives a tuple reshuffle)
     "knee_qps", "achieved_qps", "goodput_qps", "goodput_at_slo",
+    # HBM attribution (obs/memprof.py): more headroom under the budget
+    # is strictly better
+    "hbm_headroom_gib",
 )
 _LOWER_BETTER = (
     "_ms", "ttft", "wall_s", "_seconds", "overhead", "exposed_",
@@ -70,6 +73,11 @@ _LOWER_BETTER = (
     # already cover these lexically — named for the same reason as
     # knee_qps)
     "p99_ttft_ms", "ttft_p99_ms", "queue_delay_p99_ms",
+    # HBM attribution (obs/memprof.py): a peak or live-bytes move UP is
+    # a memory regression — the static account's bucket leaves and the
+    # watermark readings end in bytes_in_use / peak_hbm_*
+    "peak_hbm", "bytes_in_use", "watermark_delta_bytes",
+    "peak_frac_of_budget",
 )
 # config knobs stamped INTO the artifact (not measurements): changing a
 # setting between rounds must never read as a perf regression — the
@@ -87,6 +95,11 @@ _CONFIG_LEAVES = (
     # a perf regression (max_wall_s would otherwise match "wall_s")
     "qps_grid", "offered_qps", "requests_per_point", "burst_size",
     "ramp_start_frac", "track_tol", "max_wall_s",
+    # the HBM budget is the gate's ceiling, not a measurement: raising
+    # it between rounds (new chip generation) must never read as a
+    # regression ("hbm_budget_gib" would otherwise match nothing, but
+    # "hbm_budget_bytes" must not match "_bytes_in_use"-adjacent rules)
+    "hbm_budget",
 )
 
 

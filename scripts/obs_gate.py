@@ -21,7 +21,12 @@ measured configs.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 DEFAULT_FLOOR = 0.90
 
@@ -81,6 +86,20 @@ def main(argv: list[str] | None = None) -> int:
              "lowest measured per-point p99 TTFT (from arrival) exceeds "
              "this ceiling, or when no point measured one (0 = off)",
     )
+    p.add_argument(
+        "--max-peak-hbm-frac", type=float, default=0.0,
+        help="optional memory gate: fail when the measured HBM peak "
+             "(runtime memory_window where sampled, else the static "
+             "account's compiled peak) exceeds this fraction of the "
+             "--hbm-budget-gib ceiling, or when NO memory measurement "
+             "exists (0 = off)",
+    )
+    p.add_argument(
+        "--min-hbm-headroom-gib", type=float, default=0.0,
+        help="optional memory gate: fail when any memory account's "
+             "hbm_headroom_gib falls below this floor, or when no "
+             "account was emitted (0 = off)",
+    )
     args = p.parse_args(argv)
     from distributed_llms_example_tpu.obs.report import main as report_main
 
@@ -109,6 +128,10 @@ def main(argv: list[str] | None = None) -> int:
         flags += ["--min-slo-attainment", str(args.min_slo_attainment)]
     if args.max_p99_ttft_ms > 0:
         flags += ["--max-p99-ttft-ms", str(args.max_p99_ttft_ms)]
+    if args.max_peak_hbm_frac > 0:
+        flags += ["--max-peak-hbm-frac", str(args.max_peak_hbm_frac)]
+    if args.min_hbm_headroom_gib > 0:
+        flags += ["--min-hbm-headroom-gib", str(args.min_hbm_headroom_gib)]
     return report_main(flags)
 
 

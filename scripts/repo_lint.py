@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Fourteen rules, all born from real regressions at TPU scale:
+Fifteen rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -167,6 +167,19 @@ Fourteen rules, all born from real regressions at TPU scale:
    in any spelling, and subscripts of a ``sorted(...)`` result whose
    index arithmetic involves ``len``/a multiplication (the sorted-index
    idiom).  Everyone imports ``percentiles`` from the owner.
+
+15. **No raw ``memory_stats()`` / ``live_buffers()`` reads outside the
+   memory owners.**  ``obs/memprof.py`` (runtime watermarks, OOM
+   forensics) and ``utils/memory_audit.py`` (the static audit CLI) own
+   every HBM byte count.  A stray ``d.memory_stats()`` elsewhere forks
+   the account the report gates on: its reading skips the
+   absent-beats-zero contract (CPU PJRT returns nothing — a raw read
+   happily stamps 0), its "peak" is the process-lifetime allocator
+   high-water mark with no ``Watermark`` mark/delta semantics (every
+   per-phase claim built on it is silently cumulative), and its numbers
+   never reach the ``memory_window`` events the "Where did the bytes
+   go" report renders.  Readers call ``memprof.hbm_stats()`` /
+   ``Watermark`` — one read path, one semantics.
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -334,6 +347,17 @@ _POD_AGREED_PRAGMA = "# pod-agreed:"
 # serving p99s live.
 PERCENTILE_OWNER = os.path.join(PACKAGE, "obs", "spans.py")
 _PERCENTILE_FNS = ("percentile", "quantile", "nanpercentile", "nanquantile")
+
+# Rule 15: HBM byte counts have two owners — the runtime side
+# (obs/memprof.py: hbm_stats/Watermark/postmortems) and the static audit
+# (utils/memory_audit.py).  A raw memory_stats()/live_buffers() read
+# anywhere else forks the absent-beats-zero and watermark-delta
+# semantics the report's memory gates are built on.
+MEMSTATS_OWNERS = {
+    os.path.join(PACKAGE, "obs", "memprof.py"),
+    os.path.join(PACKAGE, "utils", "memory_audit.py"),
+}
+_MEMSTATS_FNS = ("memory_stats", "live_buffers")
 
 
 def _names_contain_lr(node: ast.AST) -> bool:
@@ -587,6 +611,32 @@ def _percentile_violations(tree: ast.AST, rel: str) -> list[str]:
                 "quantile idiom outside obs/spans.py — hand-rolled rank "
                 "math is off-by-one at the boundary vs the owner's "
                 "nearest-rank definition; import obs.spans.percentiles"
+            )
+    return violations
+
+
+def _memstats_violations(tree: ast.AST, rel: str) -> list[str]:
+    """Rule 15: calls named memory_stats/live_buffers (any qualifier)
+    outside the memory owners (obs/memprof.py, utils/memory_audit.py)."""
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name)
+            else None
+        )
+        if name in _MEMSTATS_FNS:
+            violations.append(
+                f"{rel}:{node.lineno}: raw {name}(...) outside the memory "
+                "owners (obs/memprof.py, utils/memory_audit.py) forks the "
+                "HBM account — no absent-beats-zero contract (CPU PJRT "
+                "stamps 0), no Watermark mark/delta semantics (per-phase "
+                "peaks read as process-lifetime), invisible to the "
+                "memory_window events the report gates on; read through "
+                "memprof.hbm_stats()/Watermark"
             )
     return violations
 
@@ -854,6 +904,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         violations.extend(_rank_conditional_violations(tree, rel, src))
     if rel != PERCENTILE_OWNER:
         violations.extend(_percentile_violations(tree, rel))
+    if rel not in MEMSTATS_OWNERS:
+        violations.extend(_memstats_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)

@@ -621,6 +621,33 @@ def test_repo_lint_clean_and_catches_violations(tmp_path):
     rel = os.path.join("distributed_llms_example_tpu", "serving", "okpct.py")
     assert repo_lint.lint_file(str(ok_pct), rel) == []
 
+    # rule 15: raw memory_stats()/live_buffers() reads outside the memory
+    # owners fork the HBM account (no absent-beats-zero, no watermark
+    # delta semantics) — any qualifier spelling
+    bad_mem = tmp_path / "mem.py"
+    bad_mem.write_text(
+        "import jax\n"
+        "for d in jax.local_devices():\n"
+        "    s = d.memory_stats()\n"
+        "b = jax.local_devices()[0].live_buffers()\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "serving", "mem.py")
+    assert len(repo_lint.lint_file(str(bad_mem), rel)) == 2
+    # ...both owners hold the raw reads
+    rel = os.path.join("distributed_llms_example_tpu", "obs", "memprof.py")
+    assert repo_lint.lint_file(str(bad_mem), rel) == []
+    rel = os.path.join("distributed_llms_example_tpu", "utils", "memory_audit.py")
+    assert repo_lint.lint_file(str(bad_mem), rel) == []
+    # the sanctioned read path stays legal everywhere
+    ok_mem = tmp_path / "okmem.py"
+    ok_mem.write_text(
+        "from distributed_llms_example_tpu.obs import memprof\n"
+        "stats = memprof.hbm_stats()\n"
+        "wm = memprof.Watermark()\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "serving", "okmem.py")
+    assert repo_lint.lint_file(str(ok_mem), rel) == []
+
 
 # ---------------------------------------------------------------------------
 # grad accumulation (ISSUE 5): accumulator-mirror spec lint, the

@@ -1934,6 +1934,149 @@ def _loadgen_main() -> None:
     }))
 
 
+def _prefix_measure(
+    clm, mesh, cparams, *,
+    slots: int, src: int, new_tokens: int,
+    sessions: int, turns: int, seed: int, budget_gib: float,
+) -> dict:
+    """The prefix-cache A/B (ISSUE 19): the seeded chatbot shared-prefix
+    mix (serving/loadgen.py ``chatbot_requests`` — shared system prompt,
+    multi-turn growing histories, turn-major arrival) through the SAME
+    paged engine config twice — cold (prefix cache off, the baseline
+    every previous serving bench measured) and warm (``--prefix-cache``
+    with an LRU warm-retention budget).  Stamps the acceptance pins:
+    tokens bit-identical to cold, hit_rate, prefill_tokens_saved_frac,
+    tokens/sec/chip and p95 TTFT for both legs."""
+    import jax
+
+    from distributed_llms_example_tpu.serving.engine import (
+        ServeConfig,
+        ServingEngine,
+    )
+    from distributed_llms_example_tpu.serving.loadgen import chatbot_requests
+
+    requests, _keys = chatbot_requests(
+        sessions=sessions, turns=turns, seed=seed,
+        vocab=min(clm.config.vocab_size, 1000),
+        system_len=max(src * 3 // 4, 8), user_len=(2, 4), reply_len=(2, 4),
+        max_len=src,
+    )
+    base = dict(
+        max_slots=slots, prefill_batch=slots, max_new_tokens=new_tokens,
+        max_source_length=src, log_every_steps=0, request_spans=False,
+        # block size 8, not the auto (largest-valid) default: match
+        # granularity IS the block size — a turn's uncached delta is a
+        # handful of tokens, and coarse blocks round every chain down.
+        # Pool at 4x the slots' worst case: warm retention lives in the
+        # pool's free headroom (evicted strictly at refcount 0 under
+        # allocation pressure), and a worst-case-exact pool has none
+        paged_kv=True, kv_block_size=8,
+        pool_blocks=4 * slots * ((src + new_tokens) // 8),
+    )
+    n_chips = max(jax.device_count(), 1)
+
+    def run(**kw):
+        eng = ServingEngine(
+            clm.module, clm.config, mesh, ServeConfig(**base, **kw),
+            is_seq2seq=False,
+        )
+        t0 = time.perf_counter()
+        outs = eng.generate(cparams, requests)
+        return eng, outs, max(time.perf_counter() - t0, 1e-9)
+
+    cold_eng, cold_outs, cold_wall = run()
+    cs = cold_eng.last_stats
+    warm_eng, warm_outs, warm_wall = run(
+        prefix_cache=True, prefix_cache_budget_gib=budget_gib,
+    )
+    ws = warm_eng.last_stats
+    _, c95 = cs.ttft_percentiles()
+    _, w95 = ws.ttft_percentiles()
+    return {
+        "requests": len(requests),
+        "chat_sessions": sessions,
+        "chat_turns": turns,
+        "kv_block_size": warm_eng.block_size,
+        "prefix_cache_budget": budget_gib,
+        # the acceptance pin: warm-path tokens == cold-start tokens
+        "bit_identical": list(warm_outs) == list(cold_outs),
+        "hit_rate": round(ws.prefix_hits / max(ws.prefix_lookups, 1), 4),
+        "prefill_tokens_total": ws.prefill_tokens_total,
+        "prefill_tokens_saved": ws.prefill_tokens_saved,
+        "prefill_tokens_saved_frac": round(
+            ws.prefill_tokens_saved / max(ws.prefill_tokens_total, 1), 4
+        ),
+        "decode_tokens_per_sec_chip": round(ws.tokens_per_sec() / n_chips, 1),
+        "decode_tokens_per_sec_chip_cold": round(
+            cs.tokens_per_sec() / n_chips, 1
+        ),
+        "ttft_p95_ms": round(w95 * 1e3, 1),
+        "ttft_p95_ms_cold": round(c95 * 1e3, 1),
+        "prefill_seconds": round(ws.prefill_seconds, 3),
+        "prefill_seconds_cold": round(cs.prefill_seconds, 3),
+        "wall_s": round(warm_wall, 3),
+        "wall_s_cold": round(cold_wall, 3),
+    }
+
+
+def _prefix_main() -> None:
+    """BENCH_MODE=serve-prefix: the standalone prefix-caching record —
+    chatbot shared-prefix mix, warm vs cold, on a causal paged engine
+    (the flagship is seq2seq; prefix caching shares the causal paged
+    pool, so the record runs on BENCH_PREFIX_MODEL, default the
+    registry's causal test model — random init is fine: greedy decode is
+    deterministic and every claim here is weight-independent)."""
+    import jax
+
+    from distributed_llms_example_tpu.core.config import MeshConfig, parse_mesh_arg
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.parallel.sharding import shard_params
+
+    name = os.environ.get("BENCH_PREFIX_MODEL", "llama-test")
+    clm = load_model(name)
+    if clm.is_seq2seq:
+        raise SystemExit(
+            f"BENCH_PREFIX_MODEL={name!r} is seq2seq; the prefix cache "
+            "shares the causal paged pool — pick a causal model"
+        )
+    n_chips = jax.device_count()
+    mesh_spec = os.environ.get("BENCH_SERVE_MESH", "")
+    mesh = build_mesh(parse_mesh_arg(mesh_spec) if mesh_spec else MeshConfig(data=-1))
+    batch_shards = 1
+    for a in ("data", "fsdp", "expert"):
+        batch_shards *= mesh.shape.get(a, 1)
+    src = int(os.environ.get("BENCH_PREFIX_SRC", "64"))
+    new_tokens = int(os.environ.get("BENCH_PREFIX_NEW", "16"))
+    slots = int(os.environ.get("BENCH_PREFIX_SLOTS_PER_SHARD", "2")) * batch_shards
+    sessions = int(os.environ.get("BENCH_PREFIX_SESSIONS", "6"))
+    turns = int(os.environ.get("BENCH_PREFIX_TURNS", "5"))
+    seed = int(os.environ.get("BENCH_PREFIX_SEED", "0"))
+    budget_gib = float(os.environ.get("BENCH_PREFIX_BUDGET_GIB", "0.5"))
+    params = clm.params if clm.params is not None else jax.device_get(clm.init_params(0))
+    sharded = shard_params(params, mesh)
+    record = _prefix_measure(
+        clm, mesh, sharded,
+        slots=slots, src=src, new_tokens=new_tokens,
+        sessions=sessions, turns=turns, seed=seed, budget_gib=budget_gib,
+    )
+    print(json.dumps({
+        "grad_compression": "off",
+        "metric": f"{name} prefix-cache warm vs cold serving "
+                  f"(chatbot mix: {sessions} sessions x {turns} turns, "
+                  f"slots {slots}, src {src} / max_new {new_tokens}, "
+                  f"warm budget {budget_gib} GiB) — serving/cache_pool.py "
+                  f"content-hash block dedup on mesh {mesh_spec or 'data=-1'}; "
+                  "no reference number exists",
+        "value": record["prefill_tokens_saved_frac"],
+        "unit": "fraction of prefill tokens served from cache",
+        "vs_baseline": None,
+        **{k: v for k, v in record.items() if k != "prefill_tokens_saved_frac"},
+        "chips": n_chips,
+        "backend": jax.default_backend(),
+    }))
+
+
 def main() -> None:
     # Child-side wall-clock budget: the add-on measurements (grad-accum,
     # dropout, rbg-dropout, trainer loop, trainer-rbg) each compile their
@@ -2716,6 +2859,8 @@ if __name__ == "__main__":
             _router_main()
         elif os.environ.get("BENCH_MODE", "") == "serve-loadgen":
             _loadgen_main()
+        elif os.environ.get("BENCH_MODE", "") == "serve-prefix":
+            _prefix_main()
         elif os.environ.get("BENCH_MODE", "") == "host-input":
             _host_input_main()
         else:

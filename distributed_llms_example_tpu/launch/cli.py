@@ -172,6 +172,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-block-size", type=int, default=0,
                    help="paged: block length in cache positions (0 = the "
                         "kv tile size for the cache width)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="paged: content-hash full blocks and share them "
+                        "across requests — admission walks the longest "
+                        "cached prefix, bumps refcounts, and prefills "
+                        "only the uncached tail (copy-on-write at the "
+                        "first divergent block); tokens stay bit-identical "
+                        "to cold admission")
+    p.add_argument("--prefix-cache-budget-gib", type=float, default=0.0,
+                   help="prefix cache: per-replica LRU byte budget for "
+                        "keeping FINISHED requests' blocks warm (evicted "
+                        "strictly at refcount 0), so a follow-up turn "
+                        "prefills only its delta (0 = no warm retention; "
+                        "live sharing still applies)")
     p.add_argument("--hbm-budget-gib", type=float, default=16.0,
                    help="per-chip HBM ceiling in GiB for the serve "
                         "summary's bucketed memory account (obs/memprof.py "
@@ -331,6 +344,8 @@ def _serve_config_from_args(args):
         paged_kv=args.paged_kv,
         pool_blocks=args.pool_blocks,
         kv_block_size=args.kv_block_size,
+        prefix_cache=args.prefix_cache,
+        prefix_cache_budget_gib=args.prefix_cache_budget_gib,
         hbm_budget_gib=args.hbm_budget_gib,
         postmortem_dir=args.postmortem_dir,
     )
@@ -511,6 +526,21 @@ def build_loadgen_parser() -> argparse.ArgumentParser:
     p.add_argument("--track-tol", type=float, default=0.9,
                    help="knee sensitivity: a point with achieved QPS "
                         "below track-tol x offered has saturated")
+    p.add_argument("--workload", type=str, default="random",
+                   choices=("random", "chatbot"),
+                   help="request mix: 'random' drives the prompts file; "
+                        "'chatbot' generates the seeded shared-prefix "
+                        "multi-turn mix (serving/loadgen.py "
+                        "chatbot_requests — >=90%% shared system prompt, "
+                        "growing per-session history, session keys for "
+                        "router affinity), ignoring the prompts file")
+    p.add_argument("--chat-sessions", type=int, default=8,
+                   help="chatbot: concurrent conversation sessions")
+    p.add_argument("--chat-turns", type=int, default=4,
+                   help="chatbot: turns per session (turn-major order)")
+    p.add_argument("--chat-shared-frac", type=float, default=0.9,
+                   help="chatbot: fraction of sessions opening with the "
+                        "one shared system prompt")
     return p
 
 
@@ -529,6 +559,22 @@ def serve_loadgen_main(argv: list[str] | None = None) -> int:
     lm, mesh, tok, params, prompts, requests = _serve_setup(
         args, extra_flags=("router",) if args.replicas >= 1 else ()
     )
+    sessions = None
+    if args.workload == "chatbot":
+        from distributed_llms_example_tpu.serving.loadgen import (
+            chatbot_requests,
+        )
+
+        # synthetic seeded token streams (prompts file ignored): the
+        # shared-prefix structure, not the text, is what the mix drives
+        requests, sessions = chatbot_requests(
+            sessions=args.chat_sessions,
+            turns=args.chat_turns,
+            seed=args.loadgen_seed,
+            vocab=int(lm.config.vocab_size),
+            shared_frac=args.chat_shared_frac,
+            max_len=args.max_source_length,
+        )
     serve_cfg = _serve_config_from_args(args)
     cfg = LoadgenConfig(
         process=args.arrival_process,
@@ -579,7 +625,7 @@ def serve_loadgen_main(argv: list[str] | None = None) -> int:
         def target_factory():
             return EngineTarget(engine.open(params))
 
-    summary = sweep_qps(target_factory, requests, cfg)
+    summary = sweep_qps(target_factory, requests, cfg, sessions=sessions)
     if args.output_file:
         from distributed_llms_example_tpu.obs.sink import ProductJsonlWriter
 

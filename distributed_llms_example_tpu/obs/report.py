@@ -738,6 +738,51 @@ def loadgen_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
     }
 
 
+def prefix_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
+    """The prefix-cache rollup: reuse ledger from ``serve_summary``
+    events whose engine ran with the cache on (``prefix_cache: true``)
+    plus the router's cross-replica aggregate when one exists (a
+    ``router_summary`` carrying ``prefix_hit_rate``).  The router
+    aggregate is authoritative when present — per-replica summaries
+    double-count nothing but see only their own traffic.
+
+    ``hit_rate`` is the gate input: None when no prefix-enabled engine
+    ever summarized, and the strict ``--min-prefix-hit-rate`` gate
+    treats that as a failure, never a pass."""
+    serve: list[dict] = []
+    router: list[dict] = []
+    windows = 0
+    for _, records in sorted(processes.items()):
+        ev = _by_event(records)
+        serve.extend(
+            r for r in ev.get("serve_summary", []) if r.get("prefix_cache")
+        )
+        router.extend(
+            r for r in ev.get("router_summary", []) if "prefix_hit_rate" in r
+        )
+        windows += sum(
+            1 for r in ev.get("serve_window", []) if "prefix_hit_rate" in r
+        )
+    if not (serve or router):
+        return None
+    src = router[-1] if router else serve[-1]
+    latest = serve[-1] if serve else {}
+    return {
+        "scope": "router" if router else "engine",
+        "hit_rate": src.get("prefix_hit_rate"),
+        "lookups": src.get("prefix_lookups"),
+        "hits": src.get("prefix_hits"),
+        "prefill_tokens_total": src.get("prefill_tokens_total"),
+        "prefill_tokens_saved": src.get("prefill_tokens_saved"),
+        "prefill_tokens_saved_frac": src.get("prefill_tokens_saved_frac"),
+        "budget_gib": latest.get("prefix_cache_budget_gib"),
+        "pool_blocks_warm": latest.get("pool_blocks_warm"),
+        "warm_bytes": latest.get("warm_bytes"),
+        "windows": windows,
+        "engines": len(serve),
+    }
+
+
 def memory_report(
     processes: dict[int, list[dict]],
     postmortems: dict[int, dict] | None = None,
@@ -854,6 +899,7 @@ def build_report(output_dir: str) -> dict[str, Any]:
         "device": device_report(processes),
         "memory": memory_report(processes, run["postmortems"]),
         "loadgen": loadgen_report(processes),
+        "prefix": prefix_report(processes),
         "recovery": recovery_report(processes),
         "anomalies": anomalies,
         "recorders": {
@@ -1208,6 +1254,23 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
                 f"{'yes' if pt.get('queue_growing') else ''} | "
                 f"{_fmt(pt.get('shed'))} | {_fmt(pt.get('unfinished'))} |"
             )
+    px = report.get("prefix")
+    if px is not None:
+        add("")
+        add("## Prefix cache")
+        add(
+            f"- scope={px.get('scope')} engines={px.get('engines')} "
+            f"budget={_fmt(px.get('budget_gib'))} GiB — hit rate: "
+            f"**{_fmt(px.get('hit_rate'))}** "
+            f"({_fmt(px.get('hits'))}/{_fmt(px.get('lookups'))} lookups)"
+        )
+        add(
+            f"- prefill tokens saved: {_fmt(px.get('prefill_tokens_saved'))}"
+            f"/{_fmt(px.get('prefill_tokens_total'))} "
+            f"({_fmt(px.get('prefill_tokens_saved_frac'))} of all prefill) — "
+            f"warm set {_fmt(px.get('pool_blocks_warm'))} blocks / "
+            f"{_fmt(px.get('warm_bytes'))} bytes at last summary"
+        )
     rec = report.get("recovery") or {}
     add("")
     add("## Recovery timeline")
@@ -1378,6 +1441,15 @@ def main(argv: list[str] | None = None) -> int:
              "measurement must never read as a pass",
     )
     p.add_argument(
+        "--min-prefix-hit-rate", type=float, default=0.0,
+        help="with --strict: fail when the prefix cache's hit rate "
+             "(prefix_hit_rate — the router aggregate when one exists, "
+             "else the last prefix-enabled serve_summary) falls below "
+             "this floor, or when NO prefix-enabled summary exists at "
+             "all (0 = the gate is off); a run that silently loses "
+             "--prefix-cache must fail here, never pass unmeasured",
+    )
+    p.add_argument(
         "--max-peak-hbm-frac", type=float, default=0.0,
         help="with --strict: fail when the measured HBM peak (the runtime "
              "memory_window peak where sampled, else the static account's "
@@ -1531,6 +1603,26 @@ def main(argv: list[str] | None = None) -> int:
                     f"strict: best per-point p99 TTFT {best} ms exceeds "
                     f"the {args.max_p99_ttft_ms} ms ceiling at every "
                     "offered rate on the sweep grid", file=sys.stderr,
+                )
+                rc = 1
+        if args.min_prefix_hit_rate > 0:
+            rate = (report.get("prefix") or {}).get("hit_rate")
+            if rate is None:
+                print(
+                    "strict: --min-prefix-hit-rate set but no "
+                    "prefix-enabled serve_summary found (run with "
+                    "--prefix-cache on a paged engine) — a missing "
+                    "measurement must never read as a pass",
+                    file=sys.stderr,
+                )
+                rc = 1
+            elif rate < args.min_prefix_hit_rate:
+                print(
+                    f"strict: prefix_hit_rate {rate} below the "
+                    f"{args.min_prefix_hit_rate} floor — the workload is "
+                    "not sharing prefixes, the warm budget is too small, "
+                    "or custom attention masks made requests ineligible",
+                    file=sys.stderr,
                 )
                 rc = 1
         mem = report.get("memory")

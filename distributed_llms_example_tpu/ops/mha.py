@@ -312,10 +312,13 @@ class MultiHeadAttention(nn.Module):
         ``cache_positions`` (B,) int32 switches to PER-ROW writes — each
         row lands at its own cache slot, the continuous-batching contract
         where every serving slot sits at a different decode offset
-        (q_len must be 1; ``mode="drop"`` makes an out-of-range position
-        a no-op, which is how idle slots park).  Without it, the whole
-        batch writes at the shared ``cache_index`` (the static-batch
-        generation loops).
+        (``mode="drop"`` makes an out-of-range position a no-op, which is
+        how idle slots park).  q_len may exceed 1: row b's queries write
+        the contiguous span ``cache_positions[b] + [0, q_len)`` — the
+        warm-admission contract, where each slot ingests its uncached
+        prompt tail at its own start offset.  Without ``cache_positions``
+        the whole batch writes at the shared ``cache_index`` (the
+        static-batch generation loops).
 
         Under ``kv_cache_context("int8")`` the buffers are s8 with
         per-head per-position f32 ``key_scale``/``value_scale`` leaves
@@ -347,25 +350,42 @@ class MultiHeadAttention(nn.Module):
                 key, ks_new = quantize_kv(key)
                 value, vs_new = quantize_kv(value)
             if cache_positions is not None:
-                if key.shape[2] != 1:
-                    raise ValueError(
-                        f"per-row cache_positions requires q_len == 1, got {key.shape[2]}"
-                    )
                 b = jnp.arange(key.shape[0])
-                k = cached_k.value.at[b, :, cache_positions].set(
-                    key[:, :, 0, :], mode="drop"
-                )
-                v = cached_v.value.at[b, :, cache_positions].set(
-                    value[:, :, 0, :], mode="drop"
-                )
-                cached_k.value, cached_v.value = k, v
-                if int8_kv:
-                    k_scale.value = k_scale.value.at[b, :, cache_positions].set(
-                        ks_new[:, :, 0], mode="drop"
+                if key.shape[2] == 1:
+                    k = cached_k.value.at[b, :, cache_positions].set(
+                        key[:, :, 0, :], mode="drop"
                     )
-                    v_scale.value = v_scale.value.at[b, :, cache_positions].set(
-                        vs_new[:, :, 0], mode="drop"
+                    v = cached_v.value.at[b, :, cache_positions].set(
+                        value[:, :, 0, :], mode="drop"
                     )
+                    cached_k.value, cached_v.value = k, v
+                    if int8_kv:
+                        k_scale.value = k_scale.value.at[b, :, cache_positions].set(
+                            ks_new[:, :, 0], mode="drop"
+                        )
+                        v_scale.value = v_scale.value.at[b, :, cache_positions].set(
+                            vs_new[:, :, 0], mode="drop"
+                        )
+                else:
+                    # per-row multi-token span: row b writes positions
+                    # cache_positions[b] + [0, T).  Advanced indexing with
+                    # a mid-axis slice puts the (B, T) index result in
+                    # front, so values transpose to (B, T, H[, D]).
+                    pos = cache_positions[:, None] + jnp.arange(key.shape[2])[None, :]
+                    k = cached_k.value.at[b[:, None], :, pos].set(
+                        key.transpose(0, 2, 1, 3), mode="drop"
+                    )
+                    v = cached_v.value.at[b[:, None], :, pos].set(
+                        value.transpose(0, 2, 1, 3), mode="drop"
+                    )
+                    cached_k.value, cached_v.value = k, v
+                    if int8_kv:
+                        k_scale.value = k_scale.value.at[b[:, None], :, pos].set(
+                            ks_new.transpose(0, 2, 1), mode="drop"
+                        )
+                        v_scale.value = v_scale.value.at[b[:, None], :, pos].set(
+                            vs_new.transpose(0, 2, 1), mode="drop"
+                        )
                 # the engine owns per-slot offsets; the shared counter is
                 # meaningless here and stays put
             else:

@@ -37,24 +37,72 @@ validated by ``analysis/spec_lint.py lint_cache_sharding`` exactly like
 
 from __future__ import annotations
 
+import hashlib
 import math
-from typing import Any, Sequence
+from collections import OrderedDict
+from typing import Any, Iterable, Sequence
 
 import jax
 import jax.numpy as jnp
+
+
+# --------------------------------------------------- block content identity
+#
+# A block's identity is the CHAIN of token ids that produced it: layer-l
+# K/V at position p depends on every token <= p, so two blocks holding
+# the same block_size tokens are only interchangeable when their whole
+# prefixes match.  Chaining the predecessor's hash into each block's
+# hash encodes exactly that — equal chain hash ⟺ equal token prefix.
+# This module is the ONE owner of both the hash computation and the
+# refcount bookkeeping (repo_lint rule: cache identity has one owner).
+
+
+def block_hash(prev_hash: str | None, tokens: Sequence[int]) -> str:
+    """Chain hash of one full block: sha256 over the predecessor's hash
+    (empty for the first block) and this block's token ids.  Different
+    predecessor → different hash, so a match on block k implies blocks
+    0..k-1 matched too — the collision discipline the prefix walk
+    relies on."""
+    h = hashlib.sha256()
+    h.update(b"" if prev_hash is None else prev_hash.encode("ascii"))
+    h.update(("|".join(str(int(t)) for t in tokens)).encode("ascii"))
+    return h.hexdigest()
+
+
+def chain_hashes(tokens: Sequence[int], block_size: int) -> list[str]:
+    """Chain hashes for every FULL block of ``tokens`` (the partial tail
+    block has no stable identity and is never shared)."""
+    out: list[str] = []
+    prev: str | None = None
+    for start in range(0, (len(tokens) // block_size) * block_size, block_size):
+        prev = block_hash(prev, tokens[start : start + block_size])
+        out.append(prev)
+    return out
 
 
 # ----------------------------------------------------- host-side allocator
 
 
 class CachePool:
-    """Free-list allocator over identityless cache blocks (pure host).
+    """Free-list allocator over cache blocks, with refcounted sharing and
+    a warm LRU of finished requests' prefix blocks (pure host).
 
     The engine calls ``alloc`` at admission and ``free`` at eviction —
     between jitted steps, like every other piece of slot bookkeeping.
-    Invariants (property-tested): a block is never handed out twice,
-    ``blocks_free + blocks_in_use == num_blocks`` always, double-free and
-    foreign-free raise."""
+    ``alloc`` grants a block with refcount 1; ``acquire`` bumps the
+    count on a matched prefix chain; ``free`` is a refcount DECREMENT
+    with reclaim at zero — reclaimed blocks whose chain hash is
+    registered park in a warm LRU (up to ``warm_capacity`` blocks) so a
+    follow-up turn can re-acquire them, everything else returns to the
+    free list.  Warm blocks count as allocatable: ``alloc`` evicts the
+    oldest warm entries under pressure, so retention can never fail an
+    admission that would have fit without it.
+
+    Invariants (property-tested and walkable via
+    ``ref_invariant_violations``): a block is never handed out twice,
+    ``blocks_free + blocks_in_use == num_blocks`` always, every
+    refcount equals the number of live references, warm blocks are
+    strictly refcount 0, double-free and foreign-free raise."""
 
     def __init__(self, num_blocks: int, block_size: int):
         if num_blocks < 1:
@@ -67,37 +115,189 @@ class CachePool:
         # keeps tests readable; correctness never depends on the order
         self._free: list[int] = list(range(self.num_blocks - 1, -1, -1))
         self._used: set[int] = set()
+        # prefix-cache state — inert until the engine registers chains:
+        # _ref[b] is b's refcount (every _used block has an entry),
+        # _hash_of[b]/_index[h] the two directions of the chain-hash
+        # index (live OR warm blocks only — a block on the free list has
+        # no identity), _lru the refcount-0 retained blocks in eviction
+        # order (oldest first), warm_capacity the retention budget in
+        # blocks (0 = retention off, the default: free() then behaves
+        # exactly like the pre-prefix-cache pool)
+        self._ref: dict[int, int] = {}
+        self._hash_of: dict[int, str] = {}
+        self._index: dict[str, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.warm_capacity = 0
 
     @property
     def blocks_free(self) -> int:
-        return len(self._free)
+        # warm blocks are reclaimable on demand, so they are FREE from
+        # the allocator's point of view — retention never costs capacity
+        return len(self._free) + len(self._lru)
 
     @property
     def blocks_in_use(self) -> int:
         return len(self._used)
 
+    @property
+    def blocks_warm(self) -> int:
+        return len(self._lru)
+
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= len(self._free) + len(self._lru)
 
     def alloc(self, n: int) -> list[int] | None:
-        """``n`` blocks, or None when the free list is short (the caller
-        defers admission — never a partial grant)."""
+        """``n`` fresh blocks at refcount 1, or None when the free list
+        plus the evictable warm set is short (the caller defers
+        admission — never a partial grant)."""
         if n < 0:
             raise ValueError(f"cannot alloc {n} blocks")
-        if n > len(self._free):
+        if n > len(self._free) + len(self._lru):
             return None
+        while len(self._free) < n:
+            self._evict_warm()
         out = [self._free.pop() for _ in range(n)]
         self._used.update(out)
+        for b in out:
+            self._ref[b] = 1
         return out
 
     def free(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; a block reclaims at refcount 0 —
+        into the warm LRU when its chain hash is registered and the
+        budget allows, else back to the free list."""
         for b in blocks:
             if b not in self._used:
                 raise ValueError(
                     f"block {b} is not allocated (double-free or foreign id)"
                 )
+            self._ref[b] -= 1
+            if self._ref[b] > 0:
+                continue
             self._used.remove(b)
-            self._free.append(b)
+            del self._ref[b]
+            if b in self._hash_of and self.warm_capacity > 0:
+                self._lru[b] = None
+                self._lru.move_to_end(b)
+                while len(self._lru) > self.warm_capacity:
+                    self._evict_warm()
+            else:
+                self._unregister(b)
+                self._free.append(b)
+
+    # ------------------------------------------------- prefix-chain index
+
+    def acquire(self, blocks: Sequence[int]) -> None:
+        """Take one more reference on each block of a matched chain —
+        live blocks bump their refcount, warm blocks revive out of the
+        LRU at refcount 1."""
+        for b in blocks:
+            if b in self._used:
+                self._ref[b] += 1
+            elif b in self._lru:
+                del self._lru[b]
+                self._used.add(b)
+                self._ref[b] = 1
+            else:
+                raise ValueError(
+                    f"block {b} is neither live nor warm (stale chain match)"
+                )
+
+    def register(self, blocks: Sequence[int], hashes: Sequence[str]) -> None:
+        """Record chain hashes for a request's full prompt blocks so later
+        admissions can match them.  First writer wins: a hash already
+        indexed keeps its existing block (the duplicate block simply
+        stays anonymous and reclaims to the free list)."""
+        if len(blocks) != len(hashes):
+            raise ValueError(
+                f"got {len(blocks)} blocks for {len(hashes)} hashes"
+            )
+        for b, h in zip(blocks, hashes):
+            if b not in self._used:
+                raise ValueError(f"block {b} is not allocated (cannot register)")
+            if self._hash_of.get(b) == h:
+                continue  # re-registration of a shared chain is a no-op
+            if b in self._hash_of or h in self._index:
+                continue  # first writer wins; never re-key a live block
+            self._hash_of[b] = h
+            self._index[h] = b
+
+    def lookup(self, h: str) -> int | None:
+        return self._index.get(h)
+
+    def match_chain(self, hashes: Sequence[str]) -> list[int]:
+        """Blocks for the longest indexed prefix of ``hashes`` — the
+        admission walk.  Chained hashing makes any gap impossible, so
+        the walk stops at the first miss."""
+        out: list[int] = []
+        for h in hashes:
+            b = self._index.get(h)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def drop_warm(self) -> int:
+        """Evict the ENTIRE warm set (replica teardown: a dead replica's
+        pool is gone, so its retained chains must not be matchable).
+        Returns the number of blocks released."""
+        n = len(self._lru)
+        while self._lru:
+            self._evict_warm()
+        return n
+
+    def _evict_warm(self) -> None:
+        b, _ = self._lru.popitem(last=False)  # strictly oldest first
+        self._unregister(b)
+        self._free.append(b)
+
+    def _unregister(self, b: int) -> None:
+        h = self._hash_of.pop(b, None)
+        if h is not None:
+            self._index.pop(h, None)
+
+    # ------------------------------------------------- invariant walking
+
+    def ref_invariant_violations(
+        self, live_chains: Iterable[Sequence[int]]
+    ) -> list[str]:
+        """Every block's refcount must equal its live references — walked
+        from the engine's block tables (``live_chains``: one sequence of
+        block ids per live slot) plus the warm LRU.  Also checks the
+        free/used/warm partition and index consistency.  Returns
+        human-readable violations; empty means the account is exact."""
+        out: list[str] = []
+        want: dict[int, int] = {}
+        for chain in live_chains:
+            for b in chain:
+                want[b] = want.get(b, 0) + 1
+        for b, n in sorted(want.items()):
+            if self._ref.get(b) != n:
+                out.append(
+                    f"block {b}: refcount {self._ref.get(b)} != {n} live references"
+                )
+        for b in sorted(self._used):
+            if b not in want:
+                out.append(f"block {b}: in use with no live reference")
+        for b in self._lru:
+            if b in want:
+                out.append(f"block {b}: warm but referenced by a live slot")
+            if b not in self._hash_of:
+                out.append(f"block {b}: warm without a registered hash")
+        free, used, warm = set(self._free), self._used, set(self._lru)
+        if free & used or free & warm or used & warm:
+            out.append("free/used/warm sets overlap")
+        if len(free) + len(used) + len(warm) != self.num_blocks:
+            out.append(
+                f"partition covers {len(free) + len(used) + len(warm)} of "
+                f"{self.num_blocks} blocks"
+            )
+        for h, b in self._index.items():
+            if b not in used and b not in warm:
+                out.append(f"hash {h[:12]}…: indexed block {b} is on the free list")
+            if self._hash_of.get(b) != h:
+                out.append(f"hash {h[:12]}…: index and hash_of disagree on {b}")
+        return out
 
 
 def blocks_needed(prompt_len: int, budget: int, block_size: int) -> int:

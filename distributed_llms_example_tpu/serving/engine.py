@@ -108,7 +108,15 @@ class ServeConfig:
     shared pool (serving/cache_pool.py) instead of worst-case-width
     rows; ``pool_blocks`` (0 = worst case: every slot at full width) and
     ``kv_block_size`` (0 = auto kv tile size) shape the pool.  Admission
-    defers while the free list is short; eviction returns all blocks."""
+    defers while the free list is short; eviction returns all blocks.
+    ``prefix_cache`` (requires ``paged_kv``): share immutable full
+    prompt blocks across requests by chained content hash — admission
+    walks its longest cached prefix, bumps refcounts on the matched
+    chain, and prefills only the uncached tail (README "Prefix caching
+    & multi-turn sessions"; tokens stay BIT-identical to cold-start).
+    ``prefix_cache_budget_gib``: warm-retention LRU budget for finished
+    requests' prefix blocks, evicted strictly at refcount 0 (0 = no
+    retention: sharing only among concurrently-live requests)."""
 
     max_slots: int = 8
     prefill_batch: int = 0  # 0 = max_slots
@@ -122,6 +130,8 @@ class ServeConfig:
     paged_kv: bool = False
     pool_blocks: int = 0  # 0 = worst case (max_slots x tiles per slot)
     kv_block_size: int = 0  # 0 = auto (the kv tile size for the cache width)
+    prefix_cache: bool = False
+    prefix_cache_budget_gib: float = 0.0
     # the bucketed HBM account (obs/memprof.py): the capacity gauges'
     # cache-bytes arithmetic lands in the shared params/kv_cache taxonomy
     # and the serve_summary carries its fit verdict against this ceiling
@@ -150,6 +160,14 @@ class ServeStats:
     peak_cache_bytes_in_use: int = 0
     bytes_per_live_token: float = 0.0
     admit_deferrals: int = 0  # paged: admissions deferred on a short free list
+    # prefix-cache gauges (prefix_cache only): a lookup per admitted
+    # eligible request, a hit when its longest cached chain is >= 1
+    # block; tokens saved = prompt tokens served from shared blocks
+    # instead of re-prefilled
+    prefix_lookups: int = 0
+    prefix_hits: int = 0
+    prefill_tokens_total: int = 0
+    prefill_tokens_saved: int = 0
     ttft_s: list[float] = dataclasses.field(default_factory=list)
     # per-request TTFT decomposition (same order as ttft_s): time spent
     # waiting for a slot vs inside the request's prefill call
@@ -328,6 +346,12 @@ class ServingEngine:
                     f"{self.block_size}) — admission would livelock"
                 )
             self.pool = cache_pool.CachePool(n_blocks, self.block_size)
+        self.prefix = bool(self.serve.prefix_cache)
+        if self.prefix and not self.paged:
+            raise ValueError(
+                "prefix_cache shares paged pool blocks — it requires "
+                "paged_kv (the flat cache has no block identity to share)"
+            )
         mesh_axes = dict(mesh.shape) if mesh is not None else {}
         # known-bad serving compositions are matrix rows, not scattered
         # raises — same table the trainer/lint consult
@@ -489,6 +513,51 @@ class ServingEngine:
                         "last": put(state["last"], first_tok),
                     }
 
+                def warm_admit(params, state, ids_tail, mask_full, start,
+                               tail_last, slot_idx, block_tables,
+                               admit_blocks):
+                    """Warm admission: the prompt's longest cached chain is
+                    already pool-resident, so the model runs over ONLY the
+                    uncached tail (``ids_tail``, at the tail bucket width)
+                    against a gathered slot view — per-row absolute
+                    positions starting at ``start`` (= cached prefix
+                    length), per-row multi-token cache writes (the mha
+                    ``cache_positions`` span contract).  The first output
+                    token reads off the last valid tail position's logits,
+                    exactly where cold prefill reads it; only fresh tail
+                    tiles scatter back (``admit_blocks`` sentinels the
+                    shared chain, which is never written)."""
+                    view = constrain_cache(
+                        cache_pool.gather_cache(state["pool"], block_tables)
+                    )
+                    positions = start[:, None] + jnp.arange(ids_tail.shape[1])[None, :]
+                    logits, mut = model.apply(
+                        {"params": params, "cache": view},
+                        ids_tail,
+                        mask_full,
+                        use_cache=True,
+                        positions=positions,
+                        cache_positions=start,
+                        mutable=["cache"],
+                    )
+                    first = jnp.take_along_axis(
+                        logits, tail_last[:, None, None], axis=1
+                    )[:, 0, :]
+                    first_tok = jnp.argmax(first, axis=-1).astype(jnp.int32)
+                    put = lambda dst, src: (  # noqa: E731
+                        dst.at[slot_idx].set(src, mode="drop") if dst.ndim > 0 else dst
+                    )
+                    return first_tok, {
+                        **state,
+                        "pool": cache_pool.scatter_admit(
+                            state["pool"], mut["cache"], admit_blocks, bs
+                        ),
+                        "mask": put(state["mask"], mask_full),
+                        "last": put(state["last"], first_tok),
+                    }
+
+                self._warm_admit_core = warm_admit
+
                 def step(params, state, block_tables, write_pos, rope_pos, active):
                     width = state["mask"].shape[1]
                     offs = jnp.where(active, write_pos, width)
@@ -557,6 +626,10 @@ class ServingEngine:
         self._prefill = self._wrap(prefill, name="prefill")
         self._admit = self._wrap(admit, donate=(0,), name="admit")
         self._step = self._wrap(step, donate=(1,), name="decode_step")
+        if self.paged and self.prefix:
+            self._warm_admit = self._wrap(
+                self._warm_admit_core, donate=(1,), name="warm_admit"
+            )
 
     # --------------------------------------------------------------- state
     def _leaf_spec(self, path: str, x):
@@ -684,6 +757,21 @@ class ServingEngine:
             else:
                 cache, full_mask, _, first = pre
                 state = self._admit(state, cache, full_mask, first, park)
+        if self.paged and self.prefix:
+            # one warm-admission trace per tail bucket, all writes dropped
+            # (park slots, sentinel block tables, out-of-range starts)
+            width_full = self.W + self.L
+            for bucket in self.buckets:
+                _, state = self._warm_admit(
+                    params, state,
+                    jnp.zeros((C, bucket), jnp.int32),
+                    jnp.zeros((C, width_full), jnp.int32),
+                    jnp.full((C,), width_full, jnp.int32),
+                    jnp.zeros((C,), jnp.int32),
+                    park,
+                    jnp.full((C, self.n_tiles), self.pool.num_blocks, jnp.int32),
+                    jnp.full((C * self.n_tiles,), self.pool.num_blocks, jnp.int32),
+                )
         idle = jnp.zeros((S,), bool)
         pos = jnp.zeros((S,), jnp.int32)
         if self.is_seq2seq:
@@ -804,6 +892,11 @@ class ServeSession:
         # the step program reads (sentinel = num_blocks → reads fill
         # zeros, writes drop)
         self.slot_blocks: list[list[int]] = [[] for _ in range(S)]
+        # prefix-cache bookkeeping: the slot's registered full-prompt
+        # chain (root → tail order), a subset of slot_blocks — eviction
+        # releases the chain tail-first so the LRU keeps roots longest
+        # (a shorter prefix stays matchable after partial eviction)
+        self.slot_chain: list[list[int]] = [[] for _ in range(S)]
         self.slot_bt = (
             np.full((S, eng.n_tiles), eng.pool.num_blocks, np.int32)
             if eng.paged
@@ -815,6 +908,19 @@ class ServeSession:
         self.stats.cache_bytes_resident, self._per_block = (
             eng._state_byte_account(self.state)
         )
+        if eng.paged and eng.prefix:
+            # the device pool tensor was just re-zeroed (_init_state), so
+            # any warm chains a PREVIOUS session retained now index
+            # garbage — matching them would splice zeros into a prompt.
+            # Warm content is session-lifetime state: drop it with it.
+            eng.pool.drop_warm()
+            if self._per_block:
+                # warm-retention budget in BLOCKS, derived from the byte
+                # budget once the per-block byte account exists (0 = off)
+                eng.pool.warm_capacity = int(
+                    eng.serve.prefix_cache_budget_gib * (1 << 30)
+                    // self._per_block
+                )
         # loaded-weight bytes for the shared memory account (metadata
         # arithmetic only — no device fetch)
         self.params_bytes = int(sum(
@@ -900,6 +1006,16 @@ class ServeSession:
         the router computes its own TTFT from its own submit instant."""
         return self.first_tok_wall[rid]
 
+    def prefix_ref_violations(self) -> list[str]:
+        """The refcount invariant, walked from THIS session's live block
+        tables: every pool block's refcount must equal its live
+        references (slot ownership) + warm-LRU membership.  Empty list =
+        invariant holds; tests and the lint contract pin this after
+        admit/evict/COW churn."""
+        return self.eng.pool.ref_invariant_violations(
+            [sb for sb in self.slot_blocks if sb]
+        )
+
     def _bytes_in_use(self) -> int:
         if self.eng.paged:
             return self.eng.pool.blocks_in_use * self._per_block
@@ -948,18 +1064,31 @@ class ServeSession:
         log_json(record)
 
     def _evict_slot(self, slot: int) -> None:
-        """Free the slot NOW — and, paged, return every block it held to
-        the pool (the evict-returns-all-blocks contract)."""
+        """Free the slot NOW — and, paged, drop one reference per block it
+        held (the evict-returns-all-blocks contract; under prefix_cache a
+        shared block survives until its LAST holder evicts).  The
+        registered chain releases tail-first so warm retention ages the
+        DEEP end of a prefix out before its root — a partially-evicted
+        chain still matches at shorter prefixes."""
         self.active[slot] = False
         self.slot_req[slot] = -1
         self._win_done += 1
         if self.eng.paged and self.slot_blocks[slot]:
-            self.eng.pool.free(self.slot_blocks[slot])
+            chain = self.slot_chain[slot]
+            if chain:
+                in_chain = set(chain)
+                rest = [b for b in self.slot_blocks[slot] if b not in in_chain]
+                self.eng.pool.free(rest + list(reversed(chain)))
+            else:
+                self.eng.pool.free(self.slot_blocks[slot])
             self.slot_blocks[slot] = []
+            self.slot_chain[slot] = []
             self.slot_bt[slot, :] = self.eng.pool.num_blocks
 
     def _admit_now(self, finished: list) -> None:
         eng = self.eng
+        if eng.paged and eng.prefix:
+            return self._admit_now_prefix(finished)
         S, W, C = eng.S, eng.W, eng.prefill_batch
         free = [i for i in range(S) if not self.active[i]]
         n = min(len(free), C, len(self.pending))
@@ -1076,6 +1205,218 @@ class ServeSession:
         self.stats.peak_cache_bytes_in_use = max(
             self.stats.peak_cache_bytes_in_use, self._bytes_in_use()
         )
+
+    def _admit_now_prefix(self, finished: list) -> None:
+        """Prefix-cache admission: per-row transactional packing (match
+        the longest cached chain → acquire it → alloc only the tail,
+        rolling the acquire back when the pool comes up short), then at
+        most TWO dispatches — the plain cold-prefill chunk for rows with
+        no cached prefix, followed by one warm-admit chunk that gathers
+        the matched chains from the pool and prefills only the tails.
+
+        Cold dispatches FIRST so a warm row may match a chain registered
+        by a cold row of the SAME wave (the warm gather reads the cold
+        scatter's pool state); a warm row must NOT match another warm
+        row's fresh tail blocks — those land in the same program call it
+        would gather from — so matches truncate before any block first
+        written by this wave's warm chunk."""
+        eng = self.eng
+        S, W, C = eng.S, eng.W, eng.prefill_batch
+        bs, N = eng.block_size, eng.pool.num_blocks
+        free = [i for i in range(S) if not self.active[i]]
+        n = min(len(free), C, len(self.pending))
+        if n == 0:
+            return
+        plen = lambda rid: min(len(self.requests[rid]), W)  # noqa: E731
+        cold: list[tuple[int, int, int, list[str]]] = []  # rid, slot, p, hashes
+        warm: list[dict] = []
+        warm_written: set[int] = set()
+        taken = 0
+        while taken < n:
+            rid = self.pending[0]
+            p = plen(rid)
+            budget = self.budgets[rid]
+            toks = self.requests[rid][:p]
+            # custom-masked prompts have no token-only identity: their KV
+            # depends on the mask too, so they neither match nor register
+            eligible = self.attn_masks[rid] is None
+            hashes = cache_pool.chain_hashes(toks, bs) if eligible else []
+            # keep >= 1 prompt token in the tail — the first output token
+            # is computed from the LAST prompt position's logits, so a
+            # fully-cached prompt still re-prefills its final block
+            chain = (
+                eng.pool.match_chain(hashes[: (p - 1) // bs])
+                if eligible else []
+            )
+            for i, b in enumerate(chain):
+                if b in warm_written:
+                    chain = chain[:i]
+                    break
+            k = len(chain)
+            need = (
+                max(1, math.ceil(p / bs)) - k
+                + math.ceil(max(budget, 1) / bs)
+            )
+            if k:
+                eng.pool.acquire(chain)
+            fresh = eng.pool.alloc(need)
+            if fresh is None:
+                if k:
+                    eng.pool.free(list(reversed(chain)))  # roll back
+                break
+            self.pending.popleft()
+            slot = free[taken]
+            taken += 1
+            blocks = chain + fresh
+            self.slot_blocks[slot] = blocks
+            full_tiles = p // bs
+            if eligible and full_tiles:
+                eng.pool.register(blocks[:full_tiles], hashes[:full_tiles])
+                self.slot_chain[slot] = list(blocks[:full_tiles])
+            else:
+                self.slot_chain[slot] = []
+            if eligible:
+                self.stats.prefix_lookups += 1
+            self.stats.prefill_tokens_total += p
+            if k:
+                self.stats.prefix_hits += 1
+                self.stats.prefill_tokens_saved += k * bs
+                warm_written.update(blocks[k:full_tiles])
+                warm.append({
+                    "rid": rid, "slot": slot, "p": p,
+                    "bucket": next(b for b in eng.buckets if b >= p),
+                    "start": k * bs, "tail": toks[k * bs:],
+                })
+            else:
+                cold.append((rid, slot, p, hashes))
+        if taken == 0:
+            self.stats.admit_deferrals += 1
+            return
+        now = time.perf_counter()
+        # ---- cold chunk: the plain prefill+admit path over cold rows
+        if cold:
+            bucket = next(
+                b for b in eng.buckets if b >= max(p for _, _, p, _ in cold)
+            )
+            ids = np.full((C, bucket), eng.pad, np.int32)
+            mask = np.zeros((C, bucket), np.int32)
+            slot_idx = np.full(C, S, np.int32)
+            ntc = (bucket + eng.L) // bs
+            admit_rows = np.full((C, ntc), N, np.int32)
+            for r, (rid, slot, p, _h) in enumerate(cold):
+                toks = self.requests[rid][:bucket]
+                ids[r, : len(toks)] = toks
+                mask[r, : len(toks)] = 1
+                if self.attn_masks[rid] is not None:
+                    m = self.attn_masks[rid][:bucket]
+                    mask[r, : len(m)] = m
+                slot_idx[r] = slot
+                row = cache_pool.build_block_row(
+                    eng.n_tiles, self.slot_blocks[slot],
+                    prompt_len=p, bucket_width=bucket,
+                    budget=self.budgets[rid], block_size=bs, sentinel=N,
+                )
+                self.slot_bt[slot, :] = row
+                admit_rows[r, :] = row[:ntc]
+            t0 = time.perf_counter()
+            cache, full_mask, plens, first = eng._prefill(
+                self.params, jnp.asarray(ids), jnp.asarray(mask)
+            )
+            self.state = eng._admit(
+                self.state, cache, full_mask, first, jnp.asarray(slot_idx),
+                jnp.asarray(admit_rows.reshape(-1)),
+            )
+            plens_h = np.asarray(jax.device_get(plens))
+            first_h = np.asarray(jax.device_get(first))
+            dt = time.perf_counter() - t0
+            self.stats.prefill_seconds += dt
+            self._win_prefill += dt
+            self.progress += 1
+            now = time.perf_counter()
+            for r, (rid, slot, p, _h) in enumerate(cold):
+                self._admit_bookkeep(
+                    rid, slot, int(plens_h[r]), bucket, int(first_h[r]),
+                    t0, dt, now, finished,
+                )
+        # ---- warm chunk: gather matched chains, prefill only the tails
+        if warm:
+            width_full = W + eng.L
+            tail_bucket = next(
+                b for b in eng.buckets if b >= max(len(w["tail"]) for w in warm)
+            )
+            ids_t = np.full((C, tail_bucket), eng.pad, np.int32)
+            mask_f = np.zeros((C, width_full), np.int32)
+            start = np.full(C, width_full, np.int32)  # park rows write nowhere
+            tail_last = np.zeros(C, np.int32)
+            slot_idx = np.full(C, S, np.int32)
+            bt = np.full((C, eng.n_tiles), N, np.int32)
+            admit_rows = np.full((C, eng.n_tiles), N, np.int32)
+            for r, wr in enumerate(warm):
+                slot = wr["slot"]
+                tail = wr["tail"]
+                ids_t[r, : len(tail)] = tail
+                mask_f[r, : wr["p"]] = 1
+                start[r] = wr["start"]
+                tail_last[r] = len(tail) - 1
+                slot_idx[r] = slot
+                row = cache_pool.build_block_row(
+                    eng.n_tiles, self.slot_blocks[slot],
+                    prompt_len=wr["p"], bucket_width=wr["bucket"],
+                    budget=self.budgets[wr["rid"]], block_size=bs, sentinel=N,
+                )
+                self.slot_bt[slot, :] = row
+                bt[r, :] = row
+                # scatter ONLY the fresh tail prompt tiles back: the
+                # matched chain is immutable (shared), and decode tiles
+                # keep pool garbage until decode writes them (the
+                # poisoned-pool invariant — masked until valid)
+                k_tiles = wr["start"] // bs
+                full_tiles = max(1, math.ceil(wr["p"] / bs))
+                admit_rows[r, k_tiles:full_tiles] = row[k_tiles:full_tiles]
+            t0 = time.perf_counter()
+            first_w, self.state = eng._warm_admit(
+                self.params, self.state,
+                jnp.asarray(ids_t), jnp.asarray(mask_f), jnp.asarray(start),
+                jnp.asarray(tail_last), jnp.asarray(slot_idx),
+                jnp.asarray(bt), jnp.asarray(admit_rows.reshape(-1)),
+            )
+            first_wh = np.asarray(jax.device_get(first_w))
+            dt = time.perf_counter() - t0
+            self.stats.prefill_seconds += dt
+            self._win_prefill += dt
+            self.progress += 1
+            now = time.perf_counter()
+            for r, wr in enumerate(warm):
+                self._admit_bookkeep(
+                    wr["rid"], wr["slot"], wr["p"], wr["bucket"],
+                    int(first_wh[r]), t0, dt, now, finished,
+                )
+        self.stats.peak_cache_bytes_in_use = max(
+            self.stats.peak_cache_bytes_in_use, self._bytes_in_use()
+        )
+
+    def _admit_bookkeep(
+        self, rid: int, slot: int, length: int, base: int, first: int,
+        t0: float, dt: float, now: float, finished: list,
+    ) -> None:
+        """Per-row post-admit bookkeeping shared by the prefix path's
+        cold and warm chunks — byte-for-byte the causal branch of
+        ``_admit_now``'s trailing loop."""
+        eng = self.eng
+        self.slot_req[slot] = rid
+        self.lengths[slot] = length
+        self.base[slot] = base
+        self.active[slot] = True
+        self.admit_t[rid] = t0
+        self.prefill_dt[rid] = dt
+        self.outputs[rid].append(first)
+        self.emitted[slot] = 1
+        self.ttft[rid] = now - self.submit_t[rid]
+        self.first_tok_wall[rid] = now
+        if first == eng.eos or self.emitted[slot] >= self.budgets[rid]:
+            self._evict_slot(slot)
+            self._finish_request(rid, slot, now)
+            finished.append(rid)
 
     def step(self) -> list[int]:
         """One scheduler round: admit into free slots, then — if any slot
@@ -1217,6 +1558,22 @@ class ServeSession:
             if eng.paged:
                 window["pool_blocks_in_use"] = eng.pool.blocks_in_use
                 window["pool_blocks_free"] = eng.pool.blocks_free
+                if eng.prefix:
+                    # cumulative-to-date prefix-cache gauges: hit rate over
+                    # eligible admissions, prefill tokens served from the
+                    # pool instead of recomputed, and the warm set's bytes
+                    window["prefix_hit_rate"] = round(
+                        self.stats.prefix_hits
+                        / max(self.stats.prefix_lookups, 1), 4
+                    )
+                    window["prefill_tokens_saved_frac"] = round(
+                        self.stats.prefill_tokens_saved
+                        / max(self.stats.prefill_tokens_total, 1), 4
+                    )
+                    window["pool_blocks_warm"] = eng.pool.blocks_warm
+                    window["warm_bytes"] = (
+                        eng.pool.blocks_warm * self._per_block
+                    )
             if self.replica is not None:
                 window["replica"] = int(self.replica)
             log_json(window)
@@ -1304,6 +1661,30 @@ class ServeSession:
             summary["pool_blocks"] = eng.pool.num_blocks
             summary["kv_block_size"] = eng.block_size
             summary["admit_deferrals"] = stats.admit_deferrals
+            if eng.prefix:
+                # the prefix-cache ledger: how often admission matched a
+                # cached chain, how much prefill it skipped, and what the
+                # warm retention holds at close — the bench's hit_rate /
+                # prefill_tokens_saved_frac read straight off this block
+                summary["prefix_cache"] = True
+                summary["prefix_cache_budget_gib"] = (
+                    eng.serve.prefix_cache_budget_gib
+                )
+                summary["prefix_lookups"] = stats.prefix_lookups
+                summary["prefix_hits"] = stats.prefix_hits
+                summary["prefix_hit_rate"] = round(
+                    stats.prefix_hits / max(stats.prefix_lookups, 1), 4
+                )
+                summary["prefill_tokens_total"] = stats.prefill_tokens_total
+                summary["prefill_tokens_saved"] = stats.prefill_tokens_saved
+                summary["prefill_tokens_saved_frac"] = round(
+                    stats.prefill_tokens_saved
+                    / max(stats.prefill_tokens_total, 1), 4
+                )
+                summary["pool_blocks_warm"] = eng.pool.blocks_warm
+                summary["warm_bytes"] = (
+                    eng.pool.blocks_warm * self._per_block
+                )
         if self.replica is not None:
             summary["replica"] = int(self.replica)
         # the shared bucketed account (params + kv_cache over the one

@@ -65,6 +65,68 @@ from distributed_llms_example_tpu.utils.jsonlog import log_json
 
 ARRIVAL_PROCESSES = ("poisson", "bursty", "ramp")
 
+WORKLOADS = ("random", "chatbot")
+
+
+def chatbot_requests(
+    *,
+    sessions: int,
+    turns: int,
+    seed: int = 0,
+    vocab: int = 120,
+    system_len: int = 12,
+    user_len: tuple[int, int] = (2, 6),
+    reply_len: tuple[int, int] = (2, 6),
+    shared_frac: float = 0.9,
+    max_len: int = 0,
+) -> tuple[list[list[int]], list[str]]:
+    """The shared-prefix chat mix: (requests, session_keys) in arrival
+    order — the workload the prefix cache exists for.
+
+    ``sessions`` conversations × ``turns`` turns each, interleaved
+    turn-major (every session's turn 1, then every session's turn 2, …)
+    so follow-up turns arrive with OTHER traffic in between — warm
+    retention, not just same-wave sharing, is what makes them hit.
+    ``shared_frac`` of the sessions open with one COMMON system prompt
+    (``system_len`` tokens); the rest draw private system prompts (the
+    minority custom-prompt traffic).  Each turn appends a seeded user
+    message to the session's history, the prompt is the WHOLE history so
+    far (the chat API shape: clients re-send everything), and a seeded
+    synthetic assistant reply is appended after — so turn t+1's prompt
+    extends turn t's prompt exactly, and every session chain shares the
+    system-prompt root.  ``max_len`` (0 = off) right-truncates prompts,
+    matching the engine's own ``max_source_length`` truncation.
+
+    Pure function of its arguments (one ``RandomState(seed)`` drives
+    every draw in a fixed order): same seed + config → bit-identical
+    requests AND keys, the same replay contract as
+    ``arrival_schedule``.  ``session_keys`` feed the router's session
+    affinity so a conversation's turns land on the replica whose pool
+    holds its blocks."""
+    if sessions < 1 or turns < 1:
+        raise ValueError("sessions and turns must be >= 1")
+    if not 0.0 <= shared_frac <= 1.0:
+        raise ValueError("shared_frac must be in [0, 1]")
+    rng = np.random.RandomState(seed)
+    draw = lambda k: rng.randint(4, vocab, int(k)).tolist()  # noqa: E731
+    span = lambda lo_hi: rng.randint(lo_hi[0], lo_hi[1] + 1)  # noqa: E731
+    shared_system = draw(system_len)
+    n_shared = int(round(shared_frac * sessions))
+    hist = [
+        list(shared_system) if s < n_shared else draw(system_len)
+        for s in range(sessions)
+    ]
+    reqs: list[list[int]] = []
+    keys: list[str] = []
+    for _t in range(turns):
+        for s in range(sessions):
+            hist[s] = hist[s] + draw(span(user_len))
+            prompt = hist[s][:max_len] if max_len else list(hist[s])
+            reqs.append(prompt)
+            keys.append(f"session-{s}")
+            hist[s] = hist[s] + draw(span(reply_len))
+    return reqs, keys
+
 
 @dataclasses.dataclass(frozen=True)
 class LoadgenConfig:
@@ -177,7 +239,11 @@ class EngineTarget:
     def __init__(self, session: Any):
         self.session = session
 
-    def submit(self, tokens, *, budget=None, mask=None, arrival=None) -> int:
+    def submit(self, tokens, *, budget=None, mask=None, arrival=None,
+               session=None) -> int:
+        # a bare engine has no affinity tier: the session key is accepted
+        # (the driver passes it uniformly) and dropped
+        del session
         return self.session.submit(
             tokens, max_new=budget, attention_mask=mask, arrival=arrival
         )
@@ -212,9 +278,11 @@ class RouterTarget:
         self.router = router
         self._reported: set[int] = set()
 
-    def submit(self, tokens, *, budget=None, mask=None, arrival=None) -> int:
+    def submit(self, tokens, *, budget=None, mask=None, arrival=None,
+               session=None) -> int:
         return self.router.submit(
-            tokens, max_new=budget, attention_mask=mask, arrival=arrival
+            tokens, max_new=budget, attention_mask=mask, arrival=arrival,
+            session=session,
         )
 
     def advance(self) -> list[int]:
@@ -263,6 +331,7 @@ def drive_open_loop(
     *,
     budgets: Sequence[int] | None = None,
     masks: Sequence[Sequence[int] | None] | None = None,
+    sessions: Sequence[Any] | None = None,
     clock: Callable[[], float] | None = None,
     wait: Callable[[float], None] | None = None,
     max_wall_s: float = 0.0,
@@ -283,6 +352,10 @@ def drive_open_loop(
         )
     if budgets is not None and len(budgets) != n:
         raise ValueError(f"budgets has {len(budgets)} entries for {n} requests")
+    if sessions is not None and len(sessions) != n:
+        raise ValueError(
+            f"sessions has {len(sessions)} keys for {n} requests"
+        )
     clock = clock or time.perf_counter
     wait = wait or time.sleep
     t0 = clock()
@@ -299,6 +372,7 @@ def drive_open_loop(
                 budget=budgets[i] if budgets is not None else None,
                 mask=masks[i] if masks is not None else None,
                 arrival=submit_at[i],
+                session=sessions[i] if sessions is not None else None,
             )
             rids[i], idx_of[rid] = rid, i
             i += 1
@@ -471,6 +545,7 @@ def sweep_qps(
     *,
     budgets: Sequence[int] | None = None,
     masks: Sequence[Sequence[int] | None] | None = None,
+    sessions: Sequence[Any] | None = None,
     clock: Callable[[], float] | None = None,
     wait: Callable[[float], None] | None = None,
     emit: bool = True,
@@ -489,8 +564,8 @@ def sweep_qps(
         )
         rows, wall_s = drive_open_loop(
             target_factory(), requests, schedule,
-            budgets=budgets, masks=masks, clock=clock, wait=wait,
-            max_wall_s=cfg.max_wall_s,
+            budgets=budgets, masks=masks, sessions=sessions,
+            clock=clock, wait=wait, max_wall_s=cfg.max_wall_s,
         )
         point = summarize_point(
             rows, offered_qps=float(qps), ttft_slo_ms=cfg.ttft_slo_ms,

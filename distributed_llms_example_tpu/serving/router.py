@@ -370,6 +370,12 @@ class ReplicaRouter:
             for blocks in r.session.slot_blocks:
                 if blocks:
                     r.engine.pool.free(blocks)
+            if r.engine.prefix:
+                # the dead replica's warm set dies with it: its pool
+                # content is device state that no re-prefilled survivor
+                # may match against — a follow-up turn re-prefills cold
+                # on whichever replica inherits the session
+                r.engine.pool.drop_warm()
         r.session = None
 
     # ------------------------------------------------------------- drain
@@ -750,6 +756,27 @@ class ReplicaRouter:
             "ttft_p99_ms": round(p99 * 1e3, 1),
             **goodput,
         }
+        if any(r.engine.paged and r.engine.prefix for r in self.replicas):
+            # tier-wide prefix-cache ledger, summed over surviving
+            # replicas' closed sessions (a dead replica's stats die with
+            # its session — the drop is part of the failure's cost)
+            lookups = hits = saved = total = 0
+            for r in self.replicas:
+                st = r.engine.last_stats
+                if st is None:
+                    continue
+                lookups += st.prefix_lookups
+                hits += st.prefix_hits
+                saved += st.prefill_tokens_saved
+                total += st.prefill_tokens_total
+            summary["prefix_lookups"] = lookups
+            summary["prefix_hits"] = hits
+            summary["prefix_hit_rate"] = round(hits / max(lookups, 1), 4)
+            summary["prefill_tokens_saved"] = saved
+            summary["prefill_tokens_total"] = total
+            summary["prefill_tokens_saved_frac"] = round(
+                saved / max(total, 1), 4
+            )
         if self.t_fail is not None:
             summary["t_fail_s"] = round(self.t_fail - self.t_open, 4)
             if self.t_recovered is not None:

@@ -58,6 +58,9 @@ _HIGHER_BETTER = (
     # HBM attribution (obs/memprof.py): more headroom under the budget
     # is strictly better
     "hbm_headroom_gib",
+    # prefix cache (serving/cache_pool.py): more reuse is the whole
+    # point — a higher hit rate / saved fraction means less prefill work
+    "hit_rate", "prefill_tokens_saved",
 )
 _LOWER_BETTER = (
     "_ms", "ttft", "wall_s", "_seconds", "overhead", "exposed_",
@@ -100,6 +103,9 @@ _CONFIG_LEAVES = (
     # regression ("hbm_budget_gib" would otherwise match nothing, but
     # "hbm_budget_bytes" must not match "_bytes_in_use"-adjacent rules)
     "hbm_budget",
+    # the warm-retention byte budget is an LRU ceiling, not a
+    # measurement: growing it between rounds is a config change
+    "prefix_cache_budget",
 )
 
 

@@ -87,6 +87,15 @@ def main(argv: list[str] | None = None) -> int:
              "this ceiling, or when no point measured one (0 = off)",
     )
     p.add_argument(
+        "--min-prefix-hit-rate", type=float, default=0.0,
+        help="optional prefix-cache gate: fail when the prefix cache's "
+             "hit rate (router aggregate when one exists, else the last "
+             "prefix-enabled serve_summary) falls below this floor, or "
+             "when NO prefix-enabled summary was emitted — a round that "
+             "silently loses --prefix-cache fails instead of passing "
+             "unmeasured (0 = off)",
+    )
+    p.add_argument(
         "--max-peak-hbm-frac", type=float, default=0.0,
         help="optional memory gate: fail when the measured HBM peak "
              "(runtime memory_window where sampled, else the static "
@@ -128,6 +137,8 @@ def main(argv: list[str] | None = None) -> int:
         flags += ["--min-slo-attainment", str(args.min_slo_attainment)]
     if args.max_p99_ttft_ms > 0:
         flags += ["--max-p99-ttft-ms", str(args.max_p99_ttft_ms)]
+    if args.min_prefix_hit_rate > 0:
+        flags += ["--min-prefix-hit-rate", str(args.min_prefix_hit_rate)]
     if args.max_peak_hbm_frac > 0:
         flags += ["--max-peak-hbm-frac", str(args.max_peak_hbm_frac)]
     if args.min_hbm_headroom_gib > 0:

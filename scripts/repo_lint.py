@@ -359,6 +359,20 @@ MEMSTATS_OWNERS = {
 }
 _MEMSTATS_FNS = ("memory_stats", "live_buffers")
 
+# Rule 16: KV-block identity is owned by serving/cache_pool.py — the
+# chained content hash and the refcount ledger ARE the correctness
+# argument for cross-request block sharing.  A second hash definition
+# (or a refcount poked from outside the owner) silently breaks the
+# "refcount == live references" invariant the pool's own
+# ref_invariant_violations() audits, and a divergent hash chain makes
+# two different prefixes collide into one block.  Everyone else goes
+# through the owner's public API: chain_hashes / match_chain / acquire
+# / register / free / drop_warm.
+PREFIX_IDENTITY_OWNER = os.path.join(PACKAGE, "serving", "cache_pool.py")
+_PREFIX_LEDGER_ATTRS = ("_ref", "_hash_of", "_index", "_lru")
+_PREFIX_HASH_MODULE = "hashlib"
+PREFIX_HASH_RULE_DIRS = (os.path.join(PACKAGE, "serving"),)
+
 
 def _names_contain_lr(node: ast.AST) -> bool:
     return any(
@@ -641,6 +655,45 @@ def _memstats_violations(tree: ast.AST, rel: str) -> list[str]:
     return violations
 
 
+def _prefix_identity_violations(tree: ast.AST, rel: str) -> list[str]:
+    """Rule 16: the block-identity ledger (``._ref``/``._hash_of``/
+    ``._index``/``._lru`` attribute access) anywhere outside the owner,
+    and hashlib (import or call) anywhere in serving/ outside the owner
+    — a second block-hash computation forks the chained-hash identity
+    the pool's dedup is keyed on."""
+    violations: list[str] = []
+    in_serving = any(
+        rel.startswith(d + os.sep) for d in PREFIX_HASH_RULE_DIRS
+    )
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in _PREFIX_LEDGER_ATTRS
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: .{node.attr} access outside "
+                "serving/cache_pool.py pokes the block-identity ledger "
+                "directly — refcounts mutated outside the owner break the "
+                "refcount == live-references invariant "
+                "(ref_invariant_violations); go through acquire/register/"
+                "free/match_chain/drop_warm"
+            )
+        elif in_serving and isinstance(node, (ast.Import, ast.ImportFrom)):
+            mod = (
+                node.module if isinstance(node, ast.ImportFrom)
+                else ",".join(a.name for a in node.names)
+            )
+            if mod and _PREFIX_HASH_MODULE in mod.split(","):
+                violations.append(
+                    f"{rel}:{node.lineno}: hashlib in serving/ outside "
+                    "cache_pool.py — a second block-hash definition forks "
+                    "the chained content identity (two prefixes can "
+                    "collide, or identical prefixes stop matching); use "
+                    "cache_pool.block_hash/chain_hashes"
+                )
+    return violations
+
+
 def _trace_emit_violations(tree: ast.AST, rel: str) -> list[str]:
     violations: list[str] = []
     for node in ast.walk(tree):
@@ -906,6 +959,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         violations.extend(_percentile_violations(tree, rel))
     if rel not in MEMSTATS_OWNERS:
         violations.extend(_memstats_violations(tree, rel))
+    if rel != PREFIX_IDENTITY_OWNER:
+        violations.extend(_prefix_identity_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)

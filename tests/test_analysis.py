@@ -648,6 +648,42 @@ def test_repo_lint_clean_and_catches_violations(tmp_path):
     rel = os.path.join("distributed_llms_example_tpu", "serving", "okmem.py")
     assert repo_lint.lint_file(str(ok_mem), rel) == []
 
+    # rule 16: the block-identity ledger is cache_pool.py's alone — a
+    # refcount poked from outside the owner breaks the refcount ==
+    # live-references invariant, and a second hashlib-based block hash
+    # in serving/ forks the chained content identity
+    bad_px = tmp_path / "px.py"
+    bad_px.write_text(
+        "import hashlib\n"
+        "from hashlib import sha256\n"
+        "pool._ref[b] -= 1\n"
+        "h = pool._hash_of.get(b)\n"
+        "blk = pool._index[h]\n"
+        "pool._lru.pop(b, None)\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "serving", "px.py")
+    assert len(repo_lint.lint_file(str(bad_px), rel)) == 6
+    # ...the owner holds the ledger and the hash
+    rel = os.path.join("distributed_llms_example_tpu", "serving", "cache_pool.py")
+    assert repo_lint.lint_file(str(bad_px), rel) == []
+    # hashlib outside serving/ is fine (checkpoint digests etc.); the
+    # ledger attrs stay forbidden repo-wide
+    bad_ref = tmp_path / "ref.py"
+    bad_ref.write_text("import hashlib\npool._ref[b] += 1\n")
+    rel = os.path.join("distributed_llms_example_tpu", "io", "ref.py")
+    assert len(repo_lint.lint_file(str(bad_ref), rel)) == 1
+    # the sanctioned API stays legal everywhere in serving/
+    ok_px = tmp_path / "okpx.py"
+    ok_px.write_text(
+        "from distributed_llms_example_tpu.serving import cache_pool\n"
+        "hashes = cache_pool.chain_hashes(toks, 8)\n"
+        "chain = pool.match_chain(hashes)\n"
+        "pool.acquire(chain)\n"
+        "pool.free(chain)\n"
+    )
+    rel = os.path.join("distributed_llms_example_tpu", "serving", "okpx.py")
+    assert repo_lint.lint_file(str(ok_px), rel) == []
+
 
 # ---------------------------------------------------------------------------
 # grad accumulation (ISSUE 5): accumulator-mirror spec lint, the

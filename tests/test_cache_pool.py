@@ -620,3 +620,295 @@ def test_pool_rules_lint_and_scale_spec(mesh8):
         assert spec[1] == "tensor", (path, spec)
     # the one definition both sides derive from
     assert kv_scale_spec((8, 4, 24), dict(mesh8.shape))[1] == "tensor"
+
+
+# ----------------------------------------------- prefix cache: pool unit
+
+
+def test_chain_hash_collision_discipline():
+    """Chained identity: a block's hash commits to its WHOLE prefix, so
+    equal hash at position k implies blocks 0..k-1 matched too; token
+    boundaries are part of the identity (no concatenation ambiguity);
+    the partial tail block has no identity at all."""
+    bs = 4
+    a = cache_pool.chain_hashes([1, 2, 3, 4, 5, 6, 7, 8], bs)
+    b = cache_pool.chain_hashes([9, 9, 9, 9, 5, 6, 7, 8], bs)
+    assert len(a) == len(b) == 2
+    # identical second-block TOKENS, different predecessor → different hash
+    assert a[0] != b[0] and a[1] != b[1]
+    # extending past a full block never perturbs the existing chain
+    c = cache_pool.chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], bs)
+    assert c == a  # the 1-token tail is unhashed (no stable identity)
+    # boundary discipline: [1, 23] vs [12, 3] must not collide
+    assert cache_pool.block_hash(None, [1, 23]) != cache_pool.block_hash(None, [12, 3])
+
+
+def test_pool_register_first_writer_wins_and_acquire_errors():
+    pool = cache_pool.CachePool(num_blocks=8, block_size=4)
+    pool.warm_capacity = 8
+    h = cache_pool.chain_hashes([1, 2, 3, 4], 4)
+    b1 = pool.alloc(1)
+    b2 = pool.alloc(1)
+    pool.register(b1, h)
+    pool.register(b2, h)  # duplicate content: first writer keeps the hash
+    assert pool.lookup(h[0]) == b1[0]
+    assert pool.match_chain(h) == b1
+    # the anonymous duplicate reclaims to the FREE list, not the warm LRU
+    pool.free(b2)
+    assert pool.blocks_warm == 0
+    # the registered block parks warm at refcount 0...
+    pool.free(b1)
+    assert pool.blocks_warm == 1 and pool.match_chain(h) == b1
+    # ...and revives via acquire
+    pool.acquire(b1)
+    assert pool.blocks_in_use == 1 and pool.blocks_warm == 0
+    pool.free(b1)
+    # a chain match gone stale (block neither live nor warm) raises
+    pool.drop_warm()
+    with pytest.raises(ValueError, match="neither live nor warm"):
+        pool.acquire(b1)
+
+
+def test_pool_warm_lru_eviction_order():
+    """Warm retention evicts strictly oldest-first; re-acquire refreshes
+    recency; alloc pressure reclaims warm blocks before refusing; and
+    drop_warm clears the whole set (the replica-death path)."""
+    pool = cache_pool.CachePool(num_blocks=4, block_size=4)
+    pool.warm_capacity = 2
+    chains = [cache_pool.chain_hashes([i, i, i, i], 4) for i in (1, 2, 3)]
+    blocks = []
+    for h in chains:
+        (b,) = pool.alloc(1)
+        pool.register([b], h)
+        blocks.append(b)
+    for b in blocks:
+        pool.free([b])  # park in order 0, 1, 2 — capacity 2 evicts 0
+    assert pool.blocks_warm == 2
+    assert pool.match_chain(chains[0]) == []
+    assert pool.match_chain(chains[1]) == [blocks[1]]
+    # revive 1 then re-park: now 1 is NEWEST, so pressure evicts 2 first
+    pool.acquire([blocks[1]])
+    pool.free([blocks[1]])
+    grant = pool.alloc(3)  # 2 free + 1 evicted warm (block 2, the oldest)
+    assert grant is not None
+    assert pool.match_chain(chains[2]) == []
+    assert pool.match_chain(chains[1]) == [blocks[1]]
+    pool.free(grant)
+    assert pool.drop_warm() == 1
+    assert pool.blocks_warm == 0 and pool.match_chain(chains[1]) == []
+    assert pool.blocks_free == pool.num_blocks
+
+
+def test_pool_prefix_refcount_churn_invariant():
+    """Property sweep over admit/share/free churn with warm retention:
+    random sessions match-acquire-alloc-register like the engine's
+    admission, free in random order — after EVERY operation the walked
+    refcount invariant holds and the free/used/warm partition is exact."""
+    rng = np.random.RandomState(17)
+    pool = cache_pool.CachePool(num_blocks=30, block_size=4)
+    pool.warm_capacity = 8
+    live: list[list[int]] = []  # per-request block lists (the block tables)
+    for _ in range(300):
+        if live and rng.rand() < 0.45:
+            chain = live.pop(rng.randint(len(live)))
+            pool.free(list(reversed(chain)))
+        else:
+            # small alphabet → real prefix collisions across requests
+            toks = [int(t) for t in rng.randint(0, 3, int(rng.randint(4, 17)))]
+            hashes = cache_pool.chain_hashes(toks, 4)
+            p = len(toks)
+            chain = pool.match_chain(hashes[: (p - 1) // 4])
+            k = len(chain)
+            need = max(1, -(-p // 4)) - k + 1  # + one decode block
+            if k:
+                pool.acquire(chain)
+            fresh = pool.alloc(need)
+            if fresh is None:
+                if k:
+                    pool.free(list(reversed(chain)))  # transactional rollback
+                continue
+            blocks = chain + fresh
+            full = p // 4
+            if full:
+                pool.register(blocks[:full], hashes[:full])
+            live.append(blocks)
+        assert pool.ref_invariant_violations(live) == []
+        assert pool.blocks_free + pool.blocks_in_use == 30
+    for chain in live:
+        pool.free(list(reversed(chain)))
+    assert pool.ref_invariant_violations([]) == []
+    assert pool.blocks_in_use == 0
+
+
+# ----------------------------------------------- prefix cache: engine
+
+
+def _prefix_engine(lm, W, L, **kw):
+    kw.setdefault("pool_blocks", 24)  # headroom: warm retention lives in it
+    return _engine(
+        lm, is_seq2seq=False, W=W, L=L,
+        paged_kv=True, kv_block_size=8,
+        prefix_cache=True, prefix_cache_budget_gib=0.25, **kw,
+    )
+
+
+def _chat_requests(rng, sys_len=8, n=8, lo=2, hi=8):
+    sys_toks = [int(t) for t in rng.randint(4, 120, sys_len)]
+    return [
+        sys_toks + [int(t) for t in rng.randint(4, 120, rng.randint(lo, hi))]
+        for _ in range(n)
+    ]
+
+
+def test_engine_prefix_warm_vs_cold_bit_identical(llama_runs):
+    """THE warm-path acceptance pin (greedy): shared-prefix requests
+    through the prefix cache produce tokens BIT-identical to the flat
+    cold engine, with real hits (the shared system-prompt block prefills
+    once), an exact reuse ledger, and a drained pool whose warm set
+    holds exactly the one registered chain block.  A SECOND session on
+    the same engine drops the stale warm set (its device pool was
+    re-zeroed) and is bit-identical again — no cross-session splice."""
+    lm, params, _, W, L, flat_eng, _ = llama_runs
+    rng = np.random.RandomState(23)
+    reqs = _chat_requests(rng)
+    flat = flat_eng.generate(params, reqs)
+    eng = _prefix_engine(lm, W, L)
+    outs = eng.generate(params, reqs)
+    assert outs == flat
+    st = eng.last_stats
+    # every request was eligible; all but the first matched the shared
+    # 8-token system block (pool headroom keeps it warm/live throughout)
+    assert st.prefix_lookups == len(reqs)
+    assert st.prefix_hits == len(reqs) - 1
+    assert st.prefill_tokens_saved == (len(reqs) - 1) * 8
+    assert st.prefill_tokens_total == sum(len(r) for r in reqs)
+    assert eng.pool.blocks_in_use == 0
+    # all requests share ONE full block (the system prompt): first writer
+    # wins, so exactly one block is registered and retained warm
+    assert eng.pool.blocks_warm == 1
+    # compiled-program budget: one warm_admit per bucket, nothing retraced
+    assert eng.trace_counts == {
+        "prefill": 1, "admit": 1, "warm_admit": 1, "decode_step": 1,
+    }
+    outs2 = eng.generate(params, reqs)
+    assert outs2 == flat
+    assert eng.last_stats.prefix_hits == len(reqs) - 1
+    assert eng.trace_counts == {
+        "prefill": 1, "admit": 1, "warm_admit": 1, "decode_step": 1,
+    }
+
+
+def test_engine_prefix_cow_divergence_and_slot_reuse(llama_runs):
+    """COW discipline through divergence and slot reuse, stepwise: A and
+    B share one system block then diverge (B admits warm, holding the
+    SHARED block and allocating only its own tail — never writing the
+    shared block); C repeats A exactly and re-acquires A's chain from
+    the warm LRU through a REUSED slot.  Tokens bit-identical to cold
+    throughout, and the walked refcount invariant holds after every
+    step."""
+    lm, params, _, W, L, flat_eng, _ = llama_runs
+    rng = np.random.RandomState(29)
+    sys_toks = [int(t) for t in rng.randint(4, 120, 8)]
+    a = sys_toks + [int(t) for t in rng.randint(4, 120, 5)]
+    b = sys_toks + [int(t) for t in rng.randint(4, 120, 5)]
+    reqs = [a, b, list(a)]
+    flat = flat_eng.generate(params, reqs)
+    eng = _prefix_engine(lm, W, L)
+    sess = eng.open(params)
+    for r in reqs:
+        sess.submit(r)
+    shared_in_use = None
+    while sess.has_work():
+        sess.step()
+        assert sess.prefix_ref_violations() == []
+        if shared_in_use is None and sess.active.all():
+            # A and B live together: 3 blocks each (2 prompt + 1 decode)
+            # MINUS the one shared system block
+            shared_in_use = eng.pool.blocks_in_use
+    sess.finalize()
+    assert shared_in_use == 5
+    assert list(sess.outputs) == flat
+    st = eng.last_stats
+    # B matched the system block; C matched its full chain (1 block —
+    # the last prompt block always re-prefills for first-token logits)
+    assert st.prefix_hits == 2
+    assert st.prefix_lookups == 3
+    assert eng.pool.blocks_in_use == 0
+    assert eng.pool.ref_invariant_violations([]) == []
+
+
+def test_engine_prefix_custom_mask_ineligible(llama_runs):
+    """A request with a custom attention mask has no token-only identity:
+    it neither matches nor registers (zero lookups), and tokens stay
+    bit-identical to the flat engine under the same masks."""
+    lm, params, _, W, L, flat_eng, _ = llama_runs
+    rng = np.random.RandomState(31)
+    reqs = _chat_requests(rng, n=4)
+    masks = [[1] * len(r) for r in reqs]
+    flat = flat_eng.generate(params, reqs, attention_masks=masks)
+    eng = _prefix_engine(lm, W, L)
+    outs = eng.generate(params, reqs, attention_masks=masks)
+    assert outs == flat
+    st = eng.last_stats
+    assert st.prefix_lookups == 0 and st.prefix_hits == 0
+    assert eng.pool.blocks_warm == 0  # nothing registered, nothing retained
+    assert eng.pool.blocks_in_use == 0
+
+
+def test_engine_prefix_warm_beam_bit_identical(llama_runs):
+    """Beam-search leg of the bit-identity contract: a KV prefix
+    reconstructed from WARM pool blocks matches a cold prefill's cache
+    region (to the cross-program ulp — the engine's compiled prefill
+    and the generator's eager one fuse differently, the same class of
+    difference the engine-vs-static token pins absorb), and a
+    num_beams=2 decode over the spliced carry emits exactly the cold
+    run's beam tokens — the warm path changes where prefix KV comes
+    from, never what it holds."""
+    from distributed_llms_example_tpu.evaluation.generation import CausalGenerator
+
+    lm, params, _, W, L, _, _ = llama_runs
+    rng = np.random.RandomState(37)
+    prompt = [int(t) for t in rng.randint(4, 120, 12)]
+    eng = _prefix_engine(lm, W, L)
+    sess = eng.open(params)
+    sess.submit(list(prompt))
+    while sess.has_work():
+        sess.step()
+    sess.finalize()
+    # the finished request's full-block chain is warm and matchable
+    hashes = cache_pool.chain_hashes(prompt, eng.block_size)
+    chain = eng.pool.match_chain(hashes[: (len(prompt) - 1) // eng.block_size])
+    assert len(chain) == 1
+    bt = np.full((1, eng.n_tiles), eng.pool.num_blocks, np.int32)
+    bt[0, : len(chain)] = chain
+    warm_view = cache_pool.gather_cache(sess.state["pool"], jnp.asarray(bt))
+    # cold reference: the generator's own prefill at the same width
+    gen = CausalGenerator(lm.module, lm.config, L, num_beams=2)
+    ids = np.full((1, W), lm.config.pad_token_id, np.int32)
+    mask = np.zeros((1, W), np.int32)
+    ids[0, : len(prompt)] = prompt
+    mask[0, : len(prompt)] = 1
+    carry_cold = gen.prefill(params, jnp.asarray(ids), jnp.asarray(mask))
+    kbs = len(chain) * eng.block_size
+    for cold, warm in zip(
+        jax.tree.leaves(carry_cold["cache"]), jax.tree.leaves(warm_view)
+    ):
+        if getattr(cold, "ndim", 0) == 4:
+            # warm pool bytes ≈ cold prefill bytes over the cached prefix
+            # (exact within one program; here across two compilations)
+            np.testing.assert_allclose(
+                np.asarray(warm)[0, :, :kbs, :],
+                np.asarray(cold)[0, :, :kbs, :], atol=1e-5,
+            )
+
+    def splice(c, w):
+        if getattr(c, "ndim", 0) == 4 and c.shape[-1] == w.shape[-1]:
+            rep = jnp.repeat(w[:, :, :kbs, :], 2, axis=0)  # K beams share it
+            return c.at[:, :, :kbs, :].set(rep)
+        return c
+
+    carry_warm = dict(carry_cold)
+    carry_warm["cache"] = jax.tree.map(splice, carry_cold["cache"], warm_view)
+    out_cold = np.asarray(gen.finalize(gen.decode_loop(params, carry_cold)))
+    out_warm = np.asarray(gen.finalize(gen.decode_loop(params, carry_warm)))
+    np.testing.assert_array_equal(out_warm, out_cold)

@@ -574,6 +574,11 @@ def test_bench_diff_directions_for_loadgen_leaves():
     assert d("cfg.burst_size") == 0
     # ...while a genuine wall measurement still gates lower-better
     assert d("loadgen.points.wall_s") == -1
+    # prefix-cache leaves: hit rate and tokens saved are higher-better,
+    # the LRU byte ceiling is config
+    assert d("serve_prefix.hit_rate") == 1
+    assert d("serve_prefix.prefill_tokens_saved") == 1
+    assert d("cfg.prefix_cache_budget") == 0
 
 
 # ---------------------------------------------------------------------------
@@ -684,3 +689,41 @@ def test_real_engine_open_loop_collapse_and_token_determinism(mesh8, capsys):
             out = sess.output(rid)
             if len(out) == budgets[rid]:  # ran to completion
                 assert out == oracle[rid]
+
+
+# ------------------------------------------------------- chatbot workload
+
+
+def test_chatbot_workload_replayable_and_multi_turn():
+    """The chatbot mix (prefix-cache bench workload): bit-replayable from
+    its seed; turn t+1's prompt EXTENDS turn t's exactly (history grows,
+    never rewrites — the property prefix matching feeds on); the shared
+    fraction of sessions opens with one identical system prompt; and
+    session keys group turns."""
+    from distributed_llms_example_tpu.serving.loadgen import chatbot_requests
+
+    reqs, keys = chatbot_requests(sessions=10, turns=4, seed=3)
+    again, keys2 = chatbot_requests(sessions=10, turns=4, seed=3)
+    assert reqs == again and keys == keys2
+    other, _ = chatbot_requests(sessions=10, turns=4, seed=4)
+    assert reqs != other
+    assert len(reqs) == 40 and len(set(keys)) == 10
+    # group by session, in turn order (the interleave is turn-major)
+    by_session: dict = {}
+    for req, key in zip(reqs, keys):
+        by_session.setdefault(key, []).append(req)
+    for turns in by_session.values():
+        assert len(turns) == 4
+        for a, b in zip(turns, turns[1:]):
+            assert b[: len(a)] == a and len(b) > len(a)
+    # 90% of sessions open with the SAME system prompt, the rest diverge
+    openers = [tuple(t[0][:12]) for t in by_session.values()]
+    top = max(set(openers), key=openers.count)
+    assert openers.count(top) == 9
+    # max_len caps the submitted prompt while history keeps growing
+    capped, _ = chatbot_requests(sessions=2, turns=6, seed=5, max_len=20)
+    assert max(len(r) for r in capped) == 20
+    with pytest.raises(ValueError):
+        chatbot_requests(sessions=0, turns=4)
+    with pytest.raises(ValueError):
+        chatbot_requests(sessions=2, turns=4, shared_frac=1.5)

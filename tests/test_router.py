@@ -761,3 +761,138 @@ def test_serve_router_cli_end_to_end(tmp_path):
     # nothing lost to the crash: no record carries a shed marker (a
     # tokens==0 row is legal — random-init t5 can emit EOS immediately)
     assert all("shed" not in r for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache across the replica tier
+# ---------------------------------------------------------------------------
+
+
+def test_router_crash_drops_warm_set_bit_identical(llama_pool):
+    """Replica-kill leg of the prefix-cache contract: session-keyed
+    multi-turn traffic over prefix-enabled replicas, replica_crash
+    mid-run — every request still completes with tokens bit-identical
+    to the cold single-engine oracle, the DEAD replica's warm set is
+    dropped with it (its device pool is gone, so its chains must not
+    stay matchable) with zero leaked blocks, and the router summary
+    still carries the surviving tier's reuse ledger."""
+    lm, params, _, _, _ = llama_pool
+    rng = np.random.RandomState(41)
+    sys_toks = [int(t) for t in rng.randint(4, 120, 8)]
+    reqs, keys = [], []
+    for i in range(10):
+        reqs.append(
+            sys_toks + [int(t) for t in rng.randint(4, 120, rng.randint(2, 8))]
+        )
+        keys.append(f"session-{i % 3}")
+    oracle = _llama_engine(lm).generate(params, reqs)
+
+    def prefix_engine():
+        return ServingEngine(
+            lm.module, lm.config, None,
+            ServeConfig(
+                max_slots=2, prefill_batch=2, max_new_tokens=8,
+                max_source_length=16, log_every_steps=0,
+                paged_kv=True, kv_block_size=8, pool_blocks=24,
+                prefix_cache=True, prefix_cache_budget_gib=0.25,
+            ),
+            is_seq2seq=False,
+        )
+
+    router = ReplicaRouter(
+        [prefix_engine(), prefix_engine()], params,
+        RouterConfig(log_every_ticks=0, chaos=parse_chaos("replica_crash@4")),
+    )
+    outs = router.serve(reqs, sessions=keys)
+    eos, pad = lm.config.eos_token_id, lm.config.pad_token_id
+    for got, want in zip(outs, oracle):
+        assert trim_eos(got, eos, pad) == trim_eos(want, eos, pad)
+    summary = router.last_stats
+    assert summary["completed"] == len(reqs) and summary["shed"] == 0
+    dead = [r for r in router.replicas if r.state == "dead"]
+    assert len(dead) == 1
+    # the dead replica's warm chains died with it — and nothing leaked
+    assert dead[0].engine.pool.blocks_warm == 0
+    assert dead[0].engine.pool.blocks_in_use == 0
+    # the survivor kept (re-)building the shared block: the tier-level
+    # ledger reports real reuse despite the mid-run warm drop
+    assert summary["prefix_lookups"] > 0
+    assert summary["prefix_hits"] > 0
+    assert 0.0 < summary["prefix_hit_rate"] <= 1.0
+    assert summary["prefill_tokens_saved_frac"] > 0.0
+    # the survivor's retained set is still live-matchable for a follow-up
+    survivor = next(r for r in router.replicas if r.state != "dead")
+    assert survivor.engine.pool.blocks_warm > 0
+
+
+def test_prefix_report_section_and_gate(llama_pool, tmp_path, capsys):
+    """The report's prefix rollup + the strict gate cutting both ways:
+    a prefix-enabled run renders the '## Prefix cache' section and
+    passes a floor its hit rate meets, fails one above it — and a run
+    with NO prefix measurement fails the gate outright (missing
+    measurement is never a pass)."""
+    from distributed_llms_example_tpu.obs.report import main as report_main
+    from scripts.obs_gate import main as gate_main
+
+    lm, params, _, _, _ = llama_pool
+    rng = np.random.RandomState(43)
+    sys_toks = [int(t) for t in rng.randint(4, 120, 8)]
+    reqs = [
+        sys_toks + [int(t) for t in rng.randint(4, 120, rng.randint(2, 8))]
+        for _ in range(6)
+    ]
+    eng = ServingEngine(
+        lm.module, lm.config, None,
+        ServeConfig(
+            max_slots=2, prefill_batch=2, max_new_tokens=8,
+            max_source_length=16, log_every_steps=0,
+            paged_kv=True, kv_block_size=8, pool_blocks=24,
+            prefix_cache=True, prefix_cache_budget_gib=0.25,
+        ),
+        is_seq2seq=False,
+    )
+    out = tmp_path / "run"
+    sink_mod.install_sink(sink_mod.build_sink("jsonl", str(out)))
+    eng.generate(params, reqs)
+    sink_mod.install_sink(sink_mod.build_sink("stdout", ""))
+    report = build_report(str(out))
+    px = report["prefix"]
+    assert px is not None and px["scope"] == "engine"
+    assert px["hit_rate"] == pytest.approx(
+        eng.last_stats.prefix_hits / max(eng.last_stats.prefix_lookups, 1),
+        abs=1e-4,
+    )
+    assert px["prefill_tokens_saved"] == eng.last_stats.prefill_tokens_saved
+    md = render_markdown(report)
+    assert "## Prefix cache" in md and "prefill tokens saved" in md
+    capsys.readouterr()
+    # the gate cuts both ways around the measured rate
+    rate = px["hit_rate"]
+    assert report_main([
+        str(out), "--strict", "--json",
+        "--min-prefix-hit-rate", str(rate - 0.01),
+    ]) == 0
+    assert report_main([
+        str(out), "--strict", "--json",
+        "--min-prefix-hit-rate", str(rate + 0.01),
+    ]) == 1
+    # ...and forwards through the pinned-flags wrapper
+    assert gate_main([
+        str(out), "--min-dispatch-efficiency", "0",
+        "--min-prefix-hit-rate", str(rate - 0.01),
+    ]) == 0
+    # a run with no prefix-enabled summary: the gate fails as missing
+    cold = tmp_path / "cold"
+    sink_mod.install_sink(sink_mod.build_sink("jsonl", str(cold)))
+    ServingEngine(
+        lm.module, lm.config, None,
+        ServeConfig(max_slots=2, prefill_batch=2, max_new_tokens=8,
+                    max_source_length=16, log_every_steps=0),
+        is_seq2seq=False,
+    ).generate(params, reqs[:2])
+    sink_mod.install_sink(sink_mod.build_sink("stdout", ""))
+    assert build_report(str(cold))["prefix"] is None
+    assert report_main([
+        str(cold), "--strict", "--json", "--min-prefix-hit-rate", "0.1",
+    ]) == 1
+    capsys.readouterr()

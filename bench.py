@@ -2077,6 +2077,160 @@ def _prefix_main() -> None:
     }))
 
 
+def _spec_measure(
+    clm, mesh, cparams, *,
+    slots: int, src: int, new_tokens: int,
+    sessions: int, turns: int, seed: int,
+    spec_tokens: int, draft_model: str,
+) -> dict:
+    """The speculative-decode A/B (ISSUE 20): the seeded chatbot mix
+    through the SAME paged engine config twice — plain greedy (the
+    baseline) and draft-then-verify (``--spec-tokens k``, n-gram
+    self-drafting by default or ``draft_model`` through the registry).
+    Both legs decode the mix's scripted per-turn reply lengths
+    (``chatbot_requests(with_budgets=True)``) as per-request budgets, so
+    the token counts are identical by construction — apples-to-apples.
+    Stamps the acceptance pins: tokens bit-identical to plain,
+    accepted_tokens_per_step (per-slot; plain decode ≡ 1.0),
+    acceptance_rate, decode tok/s both legs and ``vs_plain`` (relative
+    decode-throughput delta, positive = speculation won), p95 TTFT both
+    legs (speculation must not touch prefill)."""
+    import jax
+
+    from distributed_llms_example_tpu.serving.engine import (
+        ServeConfig,
+        ServingEngine,
+    )
+    from distributed_llms_example_tpu.serving.loadgen import chatbot_requests
+
+    requests, _keys, budgets = chatbot_requests(
+        sessions=sessions, turns=turns, seed=seed,
+        vocab=min(clm.config.vocab_size, 1000),
+        system_len=max(src * 3 // 4, 8), user_len=(2, 4),
+        # scripted replies span up to the decode cap: speculation needs
+        # room (acceptance is clamped to budget - emitted - 1), and a
+        # 2..4-token reply would pin every round to partial acceptance
+        reply_len=(4, max(new_tokens, 5)),
+        max_len=src, with_budgets=True,
+    )
+    base = dict(
+        max_slots=slots, prefill_batch=slots, max_new_tokens=new_tokens,
+        max_source_length=src, log_every_steps=0, request_spans=False,
+        # same pool shape as the prefix A/B: block size 8 keeps rollback
+        # granularity honest, 4x-worst-case headroom keeps admission off
+        # the critical path
+        paged_kv=True, kv_block_size=8,
+        pool_blocks=4 * slots * ((src + new_tokens) // 8),
+    )
+    n_chips = max(jax.device_count(), 1)
+
+    def run(**kw):
+        eng = ServingEngine(
+            clm.module, clm.config, mesh, ServeConfig(**base, **kw),
+            is_seq2seq=False,
+        )
+        t0 = time.perf_counter()
+        outs = eng.generate(cparams, requests, max_new=budgets)
+        return eng, outs, max(time.perf_counter() - t0, 1e-9)
+
+    plain_eng, plain_outs, plain_wall = run()
+    ps = plain_eng.last_stats
+    spec_eng, spec_outs, spec_wall = run(
+        spec_tokens=spec_tokens, spec_draft_model=draft_model,
+    )
+    ss = spec_eng.last_stats
+    _, p95_plain = ps.ttft_percentiles()
+    _, p95_spec = ss.ttft_percentiles()
+    plain_tps = ps.tokens_per_sec()
+    spec_tps = ss.tokens_per_sec()
+    return {
+        "requests": len(requests),
+        "chat_sessions": sessions,
+        "chat_turns": turns,
+        "decode_budget_tokens": int(sum(budgets)),
+        "spec_tokens": spec_tokens,
+        "spec_draft_model": draft_model or "ngram",
+        # the acceptance pin: speculative tokens == plain greedy tokens
+        "bit_identical": list(spec_outs) == list(plain_outs),
+        "accepted_tokens_per_step": round(
+            ss.spec_emitted / max(ss.spec_slot_rounds, 1), 4
+        ),
+        "acceptance_rate": round(
+            ss.spec_accepted / max(ss.spec_drafted, 1), 4
+        ),
+        "spec_drafted_tokens": ss.spec_drafted,
+        "spec_accepted_tokens": ss.spec_accepted,
+        "decode_tokens_per_sec_chip": round(spec_tps / n_chips, 1),
+        "decode_tokens_per_sec_chip_plain": round(plain_tps / n_chips, 1),
+        "vs_plain": round(spec_tps / max(plain_tps, 1e-9) - 1.0, 4),
+        "ttft_p95_ms": round(p95_spec * 1e3, 1),
+        "ttft_p95_ms_plain": round(p95_plain * 1e3, 1),
+        "wall_s": round(spec_wall, 3),
+        "wall_s_plain": round(plain_wall, 3),
+    }
+
+
+def _spec_main() -> None:
+    """BENCH_MODE=serve-spec: the standalone speculative-decode record —
+    chatbot mix, spec vs plain, on a causal paged engine
+    (BENCH_SPEC_MODEL, default the registry's causal test model — random
+    init is fine: greedy decode is deterministic, the acceptance rule is
+    argmax-exact, and every claim here is weight-independent; the tok/s
+    delta is a TPU verdict, CPU pins correctness and the acceptance
+    ledger)."""
+    import jax
+
+    from distributed_llms_example_tpu.core.config import MeshConfig, parse_mesh_arg
+    from distributed_llms_example_tpu.core.mesh import build_mesh
+    from distributed_llms_example_tpu.models.registry import load_model
+    from distributed_llms_example_tpu.parallel.sharding import shard_params
+
+    name = os.environ.get("BENCH_SPEC_MODEL", "llama-test")
+    clm = load_model(name)
+    if clm.is_seq2seq:
+        raise SystemExit(
+            f"BENCH_SPEC_MODEL={name!r} is seq2seq; speculation verifies "
+            "through the causal decode path — pick a causal model"
+        )
+    n_chips = jax.device_count()
+    mesh_spec = os.environ.get("BENCH_SERVE_MESH", "")
+    mesh = build_mesh(parse_mesh_arg(mesh_spec) if mesh_spec else MeshConfig(data=-1))
+    batch_shards = 1
+    for a in ("data", "fsdp", "expert"):
+        batch_shards *= mesh.shape.get(a, 1)
+    src = int(os.environ.get("BENCH_SPEC_SRC", "64"))
+    new_tokens = int(os.environ.get("BENCH_SPEC_NEW", "16"))
+    slots = int(os.environ.get("BENCH_SPEC_SLOTS_PER_SHARD", "2")) * batch_shards
+    sessions = int(os.environ.get("BENCH_SPEC_SESSIONS", "6"))
+    turns = int(os.environ.get("BENCH_SPEC_TURNS", "5"))
+    seed = int(os.environ.get("BENCH_SPEC_SEED", "0"))
+    spec_tokens = int(os.environ.get("BENCH_SPEC_TOKENS", "3"))
+    draft = os.environ.get("BENCH_SPEC_DRAFT", "")
+    params = clm.params if clm.params is not None else jax.device_get(clm.init_params(0))
+    sharded = shard_params(params, mesh)
+    record = _spec_measure(
+        clm, mesh, sharded,
+        slots=slots, src=src, new_tokens=new_tokens,
+        sessions=sessions, turns=turns, seed=seed,
+        spec_tokens=spec_tokens, draft_model=draft,
+    )
+    print(json.dumps({
+        "grad_compression": "off",
+        "metric": f"{name} speculative vs plain greedy decode "
+                  f"(chatbot mix: {sessions} sessions x {turns} turns, "
+                  f"slots {slots}, src {src} / max_new {new_tokens}, "
+                  f"k={spec_tokens}, draft {draft or 'ngram'}) — "
+                  f"serving/spec.py draft-then-verify on mesh "
+                  f"{mesh_spec or 'data=-1'}; no reference number exists",
+        "value": record["accepted_tokens_per_step"],
+        "unit": "accepted tokens per verify step per slot (plain = 1.0)",
+        "vs_baseline": None,
+        **{k: v for k, v in record.items() if k != "accepted_tokens_per_step"},
+        "chips": n_chips,
+        "backend": jax.default_backend(),
+    }))
+
+
 def main() -> None:
     # Child-side wall-clock budget: the add-on measurements (grad-accum,
     # dropout, rbg-dropout, trainer loop, trainer-rbg) each compile their
@@ -2782,6 +2936,42 @@ def main() -> None:
             print(f"bench: serve block failed ({e})", file=sys.stderr)
             skipped_passes.append(f"serve block failed ({str(e)[:200]})")
 
+    # speculative-decode block: spec vs plain greedy on the chatbot mix
+    # (serving/spec.py), riding the flagship's params when the flagship
+    # is causal.  A seq2seq flagship is a CONFIG skip, stamped like a
+    # budget skip — speculation verifies through the causal decode path,
+    # and a silently missing spec field would read as "measured, no win".
+    if os.environ.get("BENCH_SPEC", "1") != "0":
+        if lm.is_seq2seq:
+            msg = (
+                "serve-spec A/B skipped (flagship model is seq2seq; "
+                "speculation verifies through the causal decode path — "
+                "run BENCH_MODE=serve-spec on a causal model instead)"
+            )
+            print(f"bench: {msg}", file=sys.stderr)
+            skipped_passes.append(msg)
+        elif not over_budget("serve-spec A/B", 4 * est_step_pass):
+            try:
+                batch_shards = 1
+                for a in ("data", "fsdp", "expert"):
+                    batch_shards *= mesh.shape.get(a, 1)
+                spec_slots = int(os.environ.get("BENCH_SPEC_SLOTS_PER_SHARD", "2")) * batch_shards
+                result["serve_spec"] = _spec_measure(
+                    lm, mesh, state.params,
+                    slots=spec_slots,
+                    src=int(os.environ.get("BENCH_SPEC_SRC", "64")),
+                    new_tokens=int(os.environ.get("BENCH_SPEC_NEW", "16")),
+                    sessions=int(os.environ.get("BENCH_SPEC_SESSIONS", "6")),
+                    turns=int(os.environ.get("BENCH_SPEC_TURNS", "5")),
+                    seed=int(os.environ.get("BENCH_SPEC_SEED", "0")),
+                    spec_tokens=int(os.environ.get("BENCH_SPEC_TOKENS", "3")),
+                    draft_model=os.environ.get("BENCH_SPEC_DRAFT", ""),
+                )
+                emit_result()
+            except Exception as e:
+                print(f"bench: serve-spec A/B failed ({e})", file=sys.stderr)
+                skipped_passes.append(f"serve-spec A/B failed ({str(e)[:200]})")
+
     # memory stamp: the static bucketed HBM account (obs/memprof.py) at
     # the measured shape plus the allocator watermark this process set —
     # the "where did the bytes go" record for the headline pass.  The
@@ -2861,6 +3051,8 @@ if __name__ == "__main__":
             _loadgen_main()
         elif os.environ.get("BENCH_MODE", "") == "serve-prefix":
             _prefix_main()
+        elif os.environ.get("BENCH_MODE", "") == "serve-spec":
+            _spec_main()
         elif os.environ.get("BENCH_MODE", "") == "host-input":
             _host_input_main()
         else:

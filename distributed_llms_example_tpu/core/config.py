@@ -327,6 +327,13 @@ class TrainConfig:
 # (kept here so config stays importable without jax/flax)
 REMAT_POLICIES = ("full", "dots")
 
+# Speculative-decode draft cap: the verify step scores spec_tokens + 1
+# positions in ONE flash_decode call, and the kernel's q block holds at
+# most 8 rows (ops/flash_attention.py MAX_DECODE_Q_ROWS) — so at most 7
+# drafts ride each round.  Kept here (jax-free) so the CLI layer can
+# validate --spec-tokens without importing the ops stack.
+SPEC_MAX_DRAFT_TOKENS = 7
+
 _D = TrainConfig()
 
 

@@ -185,6 +185,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "strictly at refcount 0), so a follow-up turn "
                         "prefills only its delta (0 = no warm retention; "
                         "live sharing still applies)")
+    p.add_argument("--spec-tokens", type=int, default=0,
+                   help="causal families: speculative decode — draft this "
+                        "many tokens per slot per round and verify all "
+                        "k+1 positions in ONE decode call "
+                        "(serving/spec.py); output stays bit-identical "
+                        "to plain greedy, only cheaper per token "
+                        "(0 = off, max 7 = the flash-decode q-row cap "
+                        "minus the bonus row)")
+    p.add_argument("--spec-draft-model", type=str, default="",
+                   help="registry name of a shrunk causal draft model "
+                        "sharing the target's vocab ('' = n-gram "
+                        "self-drafting over each slot's own prompt + "
+                        "generated tokens, zero extra model)")
     p.add_argument("--hbm-budget-gib", type=float, default=16.0,
                    help="per-chip HBM ceiling in GiB for the serve "
                         "summary's bucketed memory account (obs/memprof.py "
@@ -346,6 +359,8 @@ def _serve_config_from_args(args):
         kv_block_size=args.kv_block_size,
         prefix_cache=args.prefix_cache,
         prefix_cache_budget_gib=args.prefix_cache_budget_gib,
+        spec_tokens=getattr(args, "spec_tokens", 0),
+        spec_draft_model=getattr(args, "spec_draft_model", ""),
         hbm_budget_gib=args.hbm_budget_gib,
         postmortem_dir=args.postmortem_dir,
     )
@@ -560,20 +575,24 @@ def serve_loadgen_main(argv: list[str] | None = None) -> int:
         args, extra_flags=("router",) if args.replicas >= 1 else ()
     )
     sessions = None
+    budgets = None
     if args.workload == "chatbot":
         from distributed_llms_example_tpu.serving.loadgen import (
             chatbot_requests,
         )
 
         # synthetic seeded token streams (prompts file ignored): the
-        # shared-prefix structure, not the text, is what the mix drives
-        requests, sessions = chatbot_requests(
+        # shared-prefix structure, not the text, is what the mix drives;
+        # the scripted reply lengths become per-request decode budgets
+        # so every sweep over one seed decodes the same token counts
+        requests, sessions, budgets = chatbot_requests(
             sessions=args.chat_sessions,
             turns=args.chat_turns,
             seed=args.loadgen_seed,
             vocab=int(lm.config.vocab_size),
             shared_frac=args.chat_shared_frac,
             max_len=args.max_source_length,
+            with_budgets=True,
         )
     serve_cfg = _serve_config_from_args(args)
     cfg = LoadgenConfig(
@@ -625,7 +644,9 @@ def serve_loadgen_main(argv: list[str] | None = None) -> int:
         def target_factory():
             return EngineTarget(engine.open(params))
 
-    summary = sweep_qps(target_factory, requests, cfg, sessions=sessions)
+    summary = sweep_qps(
+        target_factory, requests, cfg, sessions=sessions, budgets=budgets
+    )
     if args.output_file:
         from distributed_llms_example_tpu.obs.sink import ProductJsonlWriter
 

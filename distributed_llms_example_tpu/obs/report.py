@@ -783,6 +783,49 @@ def prefix_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
     }
 
 
+def spec_report(processes: dict[int, list[dict]]) -> dict[str, Any] | None:
+    """The speculative-decode rollup: the acceptance ledger from
+    ``serve_summary`` events whose engine ran with speculation on
+    (``spec_decode: true``) plus the router's cross-replica aggregate
+    when one exists (a ``router_summary`` carrying ``acceptance_rate``).
+    The router aggregate is authoritative when present — same precedence
+    as the prefix-cache rollup.
+
+    ``acceptance_rate`` is the gate input: None when no spec-enabled
+    engine ever summarized, and the strict ``--min-acceptance-rate``
+    gate treats that as a failure, never a pass."""
+    serve: list[dict] = []
+    router: list[dict] = []
+    windows = 0
+    for _, records in sorted(processes.items()):
+        ev = _by_event(records)
+        serve.extend(
+            r for r in ev.get("serve_summary", []) if r.get("spec_decode")
+        )
+        router.extend(
+            r for r in ev.get("router_summary", []) if "acceptance_rate" in r
+        )
+        windows += sum(
+            1 for r in ev.get("serve_window", []) if "acceptance_rate" in r
+        )
+    if not (serve or router):
+        return None
+    src = router[-1] if router else serve[-1]
+    latest = serve[-1] if serve else {}
+    return {
+        "scope": "router" if router else "engine",
+        "acceptance_rate": src.get("acceptance_rate"),
+        "accepted_tokens_per_step": src.get("accepted_tokens_per_step"),
+        "drafted_tokens": src.get("spec_drafted_tokens"),
+        "accepted_tokens": src.get("spec_accepted_tokens"),
+        "spec_tokens": latest.get("spec_tokens", src.get("spec_tokens")),
+        "draft_model": latest.get("spec_draft_model"),
+        "spec_steps": latest.get("spec_steps"),
+        "windows": windows,
+        "engines": len(serve),
+    }
+
+
 def memory_report(
     processes: dict[int, list[dict]],
     postmortems: dict[int, dict] | None = None,
@@ -900,6 +943,7 @@ def build_report(output_dir: str) -> dict[str, Any]:
         "memory": memory_report(processes, run["postmortems"]),
         "loadgen": loadgen_report(processes),
         "prefix": prefix_report(processes),
+        "spec": spec_report(processes),
         "recovery": recovery_report(processes),
         "anomalies": anomalies,
         "recorders": {
@@ -1271,6 +1315,23 @@ def render_markdown(report: dict[str, Any], *, last: int = 20) -> str:
             f"warm set {_fmt(px.get('pool_blocks_warm'))} blocks / "
             f"{_fmt(px.get('warm_bytes'))} bytes at last summary"
         )
+    sp = report.get("spec")
+    if sp is not None:
+        add("")
+        add("## Speculative decode")
+        add(
+            f"- scope={sp.get('scope')} engines={sp.get('engines')} "
+            f"k={_fmt(sp.get('spec_tokens'))} "
+            f"draft={_fmt(sp.get('draft_model'))} — accepted tokens per "
+            f"step: **{_fmt(sp.get('accepted_tokens_per_step'))}** "
+            "(plain decode = 1.0)"
+        )
+        add(
+            f"- draft acceptance: {_fmt(sp.get('accepted_tokens'))}"
+            f"/{_fmt(sp.get('drafted_tokens'))} proposals "
+            f"(rate {_fmt(sp.get('acceptance_rate'))}) over "
+            f"{_fmt(sp.get('spec_steps'))} verify rounds"
+        )
     rec = report.get("recovery") or {}
     add("")
     add("## Recovery timeline")
@@ -1450,6 +1511,15 @@ def main(argv: list[str] | None = None) -> int:
              "--prefix-cache must fail here, never pass unmeasured",
     )
     p.add_argument(
+        "--min-acceptance-rate", type=float, default=0.0,
+        help="with --strict: fail when speculative decode's draft "
+             "acceptance rate (acceptance_rate — the router aggregate "
+             "when one exists, else the last spec-enabled serve_summary) "
+             "falls below this floor, or when NO spec-enabled summary "
+             "exists at all (0 = the gate is off); a run that silently "
+             "loses --spec-tokens must fail here, never pass unmeasured",
+    )
+    p.add_argument(
         "--max-peak-hbm-frac", type=float, default=0.0,
         help="with --strict: fail when the measured HBM peak (the runtime "
              "memory_window peak where sampled, else the static account's "
@@ -1622,6 +1692,26 @@ def main(argv: list[str] | None = None) -> int:
                     f"{args.min_prefix_hit_rate} floor — the workload is "
                     "not sharing prefixes, the warm budget is too small, "
                     "or custom attention masks made requests ineligible",
+                    file=sys.stderr,
+                )
+                rc = 1
+        if args.min_acceptance_rate > 0:
+            rate = (report.get("spec") or {}).get("acceptance_rate")
+            if rate is None:
+                print(
+                    "strict: --min-acceptance-rate set but no "
+                    "spec-enabled serve_summary found (run with "
+                    "--spec-tokens > 0) — a missing measurement must "
+                    "never read as a pass",
+                    file=sys.stderr,
+                )
+                rc = 1
+            elif rate < args.min_acceptance_rate:
+                print(
+                    f"strict: acceptance_rate {rate} below the "
+                    f"{args.min_acceptance_rate} floor — the drafter is "
+                    "mispredicting this workload (try a draft model, "
+                    "fewer --spec-tokens, or a more repetitive mix)",
                     file=sys.stderr,
                 )
                 rc = 1

@@ -1111,16 +1111,25 @@ def flash_decode(
     return out if dtype is None else out.astype(dtype)
 
 
+# The decode kernel's q-block ceiling: plain decode steps are 1 row, and
+# speculative verify (serving/spec.py) rides the SAME entry with a q block
+# of spec_tokens + 1 rows — the per-row length masks already express the
+# staggered offsets, so k drafts verify for about the price of one step.
+# core.config.SPEC_MAX_DRAFT_TOKENS = this - 1 (the bonus row).
+MAX_DECODE_Q_ROWS = 8
+
+
 def flash_decode_supported(
     q_len: int, kv_len: int, head_dim: int, block_k: int | None = None
 ) -> bool:
     """True when a cached decode step is kernel-eligible: the cache length
     tiles into 8-aligned blocks, the head dim is lane-aligned, and the q
-    block is small enough to live in scratch (decode steps are 1; beam
-    reorder keeps it 1 — the cap just keeps prefill-sized calls out)."""
+    block is small enough to live in scratch (plain decode steps are 1
+    row, speculative verify up to ``MAX_DECODE_Q_ROWS`` — the cap keeps
+    prefill-sized calls out)."""
     bk = auto_block(kv_len) if block_k is None else min(block_k, kv_len)
     return (
-        0 < q_len <= 8
+        0 < q_len <= MAX_DECODE_Q_ROWS
         and bk > 0
         and kv_len % bk == 0
         and bk % 8 == 0

@@ -162,7 +162,8 @@ def select_decode_impl(
     ``select_attention_impl``, pure and unit-testable.
 
     ``auto`` picks the Pallas **decode kernel** (``flash_decode``: one
-    short q block against the cached K/V buffer, per-row length mask,
+    short q block — a single decode row, or the speculative verify's
+    k+1 rows — against the cached K/V buffer, per-row length mask,
     dead-tile skip) on TPU when the cache length tiles and — under a
     multi-device mesh — batch/heads split evenly over (data×fsdp) and
     ``tensor`` (the kernel runs per-shard under ``shard_map``, like
@@ -425,8 +426,11 @@ class MultiHeadAttention(nn.Module):
         gates ``probs_dropout_rate`` (training passes False + a "dropout"
         rng, like every other dropout).  ``cache_positions``: (batch,)
         per-row cache write offsets for continuous-batching decode (each
-        serving slot at its own position; q_len must be 1) — defaults to
-        the shared ``cache_index`` counter."""
+        serving slot at its own position; q_len rows > 1 write the
+        contiguous span starting there — warm prefix admission and the
+        speculative verify block both ride this, up to the decode
+        kernel's ``MAX_DECODE_Q_ROWS``) — defaults to the shared
+        ``cache_index`` counter."""
         q = self._split(self.q_proj(hidden), self.num_heads)
         if cross_kv is not None:
             k, v = cross_kv

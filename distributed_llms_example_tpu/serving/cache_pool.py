@@ -428,6 +428,34 @@ def scatter_step(
     return jax.tree.map(scat, pool_tree, new_cache)
 
 
+def scatter_span(
+    pool_tree: Any,
+    new_cache: Any,
+    block_tables: jnp.ndarray,
+    offsets: jnp.ndarray,
+    span: int,
+    *,
+    num_blocks: int,
+    block_size: int,
+):
+    """``scatter_step`` over a contiguous span: write positions
+    ``offsets[s] .. offsets[s] + span - 1`` of each slot view back into
+    the slot's pool blocks — the speculative-decode verify write (the
+    k+1 candidate rows land together; acceptance is mask discipline, so
+    rejected rows are written-but-dark until the next span overwrites
+    them).  Every position resolves through the SAME sentinel/parked
+    drops as the single-step scatter: a speculative write can only land
+    in a block the slot already owns, so rejection never touches the
+    free-list and the prefix index never sees a speculative block."""
+    out = pool_tree
+    for j in range(span):
+        out = scatter_step(
+            out, new_cache, block_tables, offsets + j,
+            num_blocks=num_blocks, block_size=block_size,
+        )
+    return out
+
+
 def scatter_admit(
     pool_tree: Any, chunk_cache: Any, admit_blocks: jnp.ndarray, block_size: int
 ):

@@ -69,6 +69,7 @@ from distributed_llms_example_tpu.parallel.activation import (
     kv_cache_context,
 )
 from distributed_llms_example_tpu.serving import cache_pool
+from distributed_llms_example_tpu.serving import spec as spec_decode
 from distributed_llms_example_tpu.utils.jsonlog import log_json
 
 
@@ -116,7 +117,15 @@ class ServeConfig:
     & multi-turn sessions"; tokens stay BIT-identical to cold-start).
     ``prefix_cache_budget_gib``: warm-retention LRU budget for finished
     requests' prefix blocks, evicted strictly at refcount 0 (0 = no
-    retention: sharing only among concurrently-live requests)."""
+    retention: sharing only among concurrently-live requests).
+    ``spec_tokens`` (causal families only): speculative decode — draft
+    k tokens per slot per round and verify all k+1 positions in ONE
+    decode call (serving/spec.py); output is BIT-identical to plain
+    greedy, only cheaper per token (0 = off; at most
+    ``core.config.SPEC_MAX_DRAFT_TOKENS``, the flash-decode q-row cap
+    minus the bonus row).  ``spec_draft_model``: registry name of a
+    shrunk causal draft model sharing the target's vocab ("" = n-gram
+    self-drafting, zero extra model)."""
 
     max_slots: int = 8
     prefill_batch: int = 0  # 0 = max_slots
@@ -132,6 +141,8 @@ class ServeConfig:
     kv_block_size: int = 0  # 0 = auto (the kv tile size for the cache width)
     prefix_cache: bool = False
     prefix_cache_budget_gib: float = 0.0
+    spec_tokens: int = 0  # speculative decode: drafts per verify round (0 = off)
+    spec_draft_model: str = ""  # registry draft model ("" = n-gram self-draft)
     # the bucketed HBM account (obs/memprof.py): the capacity gauges'
     # cache-bytes arithmetic lands in the shared params/kv_cache taxonomy
     # and the serve_summary carries its fit verdict against this ceiling
@@ -168,6 +179,20 @@ class ServeStats:
     prefix_hits: int = 0
     prefill_tokens_total: int = 0
     prefill_tokens_saved: int = 0
+    # speculative-decode ledger (spec_tokens > 0 only): a step is one
+    # verify round; drafted counts k proposals per live slot, accepted
+    # the drafts the target's argmax confirmed, emitted every appended
+    # token (accepted + the bonus token).  slot_rounds counts one per
+    # LIVE slot per verify round, so accepted_tokens_per_step =
+    # spec_emitted / spec_slot_rounds is the per-sequence multi-token
+    # yield in [1, k+1] — plain decode is 1.0 by construction, so > 1.0
+    # is the speculative win (a batch-wide tokens/round reading would
+    # exceed 1 with two live slots even with every draft rejected)
+    spec_steps: int = 0
+    spec_slot_rounds: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_emitted: int = 0
     ttft_s: list[float] = dataclasses.field(default_factory=list)
     # per-request TTFT decomposition (same order as ttft_s): time spent
     # waiting for a slot vs inside the request's prefill call
@@ -352,6 +377,30 @@ class ServingEngine:
                 "prefix_cache shares paged pool blocks — it requires "
                 "paged_kv (the flat cache has no block identity to share)"
             )
+        # speculative decode (serving/spec.py): the verify q block is
+        # spec_tokens + 1 rows, capped by the flash-decode kernel's q-row
+        # limit (ops/flash_attention.py MAX_DECODE_Q_ROWS)
+        self.spec = int(self.serve.spec_tokens or 0)
+        self.drafter: spec_decode.DraftRunner | None = None
+        if self.spec:
+            from distributed_llms_example_tpu.core.config import (
+                SPEC_MAX_DRAFT_TOKENS,
+            )
+
+            if self.is_seq2seq:
+                raise ValueError(
+                    "spec_tokens applies to causal decode (the verify q "
+                    "block rides the causal decode cache's staggered "
+                    "per-row offsets); seq2seq families run plain decode"
+                )
+            if not 1 <= self.spec <= SPEC_MAX_DRAFT_TOKENS:
+                raise ValueError(
+                    f"spec_tokens={self.spec} must be in "
+                    f"[1, {SPEC_MAX_DRAFT_TOKENS}]: the verify step "
+                    "scores spec_tokens + 1 positions in one decode call "
+                    "and the flash decode q block caps at "
+                    f"{SPEC_MAX_DRAFT_TOKENS + 1} rows"
+                )
         mesh_axes = dict(mesh.shape) if mesh is not None else {}
         # known-bad serving compositions are matrix rows, not scattered
         # raises — same table the trainer/lint consult
@@ -378,6 +427,30 @@ class ServingEngine:
         # is pinnable by comparing these before/after serving traffic
         self.trace_counts: dict[str, int] = {}
         self._warmed = False
+        if self.spec and self.serve.spec_draft_model:
+            from distributed_llms_example_tpu.models.registry import load_model
+
+            dm = load_model(self.serve.spec_draft_model)
+            if dm.is_seq2seq:
+                raise ValueError(
+                    f"spec_draft_model={self.serve.spec_draft_model!r} is "
+                    "seq2seq — the draft model proposes causal decode "
+                    "tokens, so it must be a causal family"
+                )
+            if dm.config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"spec_draft_model={self.serve.spec_draft_model!r} "
+                    f"vocab {dm.config.vocab_size} != target vocab "
+                    f"{config.vocab_size} — draft proposals are token ids "
+                    "compared against the target argmax, so the vocabs "
+                    "must be the same id space"
+                )
+            self.drafter = spec_decode.DraftRunner(
+                dm, slots=self.S, src_width=self.W, max_new=self.L,
+                buckets=self.buckets, prefill_batch=self.prefill_batch,
+                k=self.spec, pad=self.pad,
+                kv_cache_dtype=self.serve.kv_cache_dtype, wrap=self._wrap,
+            )
         self._build_programs()
         self.last_stats: ServeStats | None = None
 
@@ -630,6 +703,14 @@ class ServingEngine:
             self._warm_admit = self._wrap(
                 self._warm_admit_core, donate=(1,), name="warm_admit"
             )
+        if self.spec:
+            verify = spec_decode.build_verify(
+                model, slots=S, k=self.spec, pad=self.pad,
+                paged=self.paged,
+                num_blocks=self.pool.num_blocks if self.paged else 0,
+                block_size=self.block_size if self.paged else 0,
+            )
+            self._verify = self._wrap(verify, donate=(1,), name="spec_verify")
 
     # --------------------------------------------------------------- state
     def _leaf_spec(self, path: str, x):
@@ -781,6 +862,22 @@ class ServingEngine:
             _, state = self._step(params, state, bt, pos, pos, idle)
         else:
             _, state = self._step(params, state, pos, pos, idle)
+        if self.spec:
+            # one all-idle verify round: the spec program joins the
+            # zero-recompile contract alongside the plain step
+            x0 = jnp.full((S, self.spec + 1), self.pad, jnp.int32)
+            room0 = jnp.zeros((S,), jnp.int32)
+            if self.paged:
+                sbt = jnp.full(
+                    (S, self.n_tiles), self.pool.num_blocks, jnp.int32
+                )
+                _, _, state = self._verify(
+                    params, state, x0, sbt, pos, pos, idle, room0
+                )
+            else:
+                _, _, state = self._verify(
+                    params, state, x0, pos, pos, idle, room0
+                )
         self._warmed = True
         return state
 
@@ -934,6 +1031,15 @@ class ServeSession:
         # queueing-telemetry window counters: submissions vs completions
         # inside the window — their imbalance IS the queue growing
         self._win_arrivals, self._win_done = 0, 0
+        # speculative decode: what each slot appended last round (the
+        # draft model's catch-up feed next round; None until the slot's
+        # first post-admit round) + the draft model's own cache state
+        self._spec_fed: list[list[int] | None] = [None] * S
+        self.draft_state = None
+        if eng.drafter is not None:
+            self.draft_state = eng.drafter.init_state()
+            self.draft_state = eng.drafter.warm(self.draft_state)
+        self._win_spec_steps, self._win_spec_emitted = 0, 0
         self._finalized = False
 
     # ------------------------------------------------------------- intake
@@ -1072,6 +1178,7 @@ class ServeSession:
         chain still matches at shorter prefixes."""
         self.active[slot] = False
         self.slot_req[slot] = -1
+        self._spec_fed[slot] = None
         self._win_done += 1
         if self.eng.paged and self.slot_blocks[slot]:
             chain = self.slot_chain[slot]
@@ -1462,6 +1569,157 @@ class ServeSession:
             account=self._memory_account(),
         )
 
+    def _spec_dispatch(self, offsets):
+        """Assemble one draft-then-verify round.  Drafts come from the
+        n-gram self-drafter or the shrunk draft model; serving/spec.py
+        owns BOTH drafters and all acceptance/rollback math (repo_lint
+        rule 17) — this method only packs inputs and runs the compiled
+        programs.  Returns host arrays ``(target_tokens (S, k+1),
+        n_emit (S,))``."""
+        eng = self.eng
+        K, S = eng.spec, eng.S
+        x = np.full((S, K + 1), eng.pad, np.int32)
+        room = np.zeros((S,), np.int32)
+        live = np.nonzero(self.active)[0]
+        for s in live:
+            rid = int(self.slot_req[s])
+            x[s, 0] = self.outputs[rid][-1]
+            # remaining budget minus the always-emitted bonus token: the
+            # verify clamp that keeps a round from decoding past
+            # max_new_tokens (clamping truncates, never alters, output)
+            room[s] = max(int(self.budgets[rid]) - int(self.emitted[s]) - 1, 0)
+        if eng.drafter is not None:
+            self._draft_admissions()
+            fed = np.full((S, K + 1), eng.pad, np.int32)
+            n_fed = np.zeros((S,), np.int32)
+            pos0 = np.zeros((S,), np.int32)
+            rope0 = np.zeros((S,), np.int32)
+            for s in live:
+                f = self._spec_fed[s]
+                fed[s, : len(f)] = f
+                n_fed[s] = len(f)
+                pos0[s] = int(self.base[s]) + int(self.emitted[s]) - len(f)
+                rope0[s] = int(self.lengths[s]) + int(self.emitted[s]) - len(f)
+            drafts, self.draft_state = eng.drafter.round(
+                self.draft_state, jnp.asarray(fed), jnp.asarray(n_fed),
+                jnp.asarray(pos0), jnp.asarray(rope0),
+                jnp.asarray(self.active),
+            )
+            dr = np.asarray(jax.device_get(drafts))
+            x[live, 1:] = dr[live]
+        else:
+            hist = [
+                self.requests[int(self.slot_req[s])]
+                + self.outputs[int(self.slot_req[s])]
+                if self.active[s]
+                else None
+                for s in range(S)
+            ]
+            x[:, 1:] = spec_decode.ngram_drafts(hist, K, eng.pad)
+        rope = self.lengths + self.emitted - 1
+        if eng.paged:
+            target, n_emit, self.state = eng._verify(
+                self.params, self.state, jnp.asarray(x),
+                jnp.asarray(self.slot_bt),
+                jnp.asarray(offsets.astype(np.int32)),
+                jnp.asarray(rope.astype(np.int32)),
+                jnp.asarray(self.active), jnp.asarray(room),
+            )
+        else:
+            target, n_emit, self.state = eng._verify(
+                self.params, self.state, jnp.asarray(x),
+                jnp.asarray(offsets.astype(np.int32)),
+                jnp.asarray(rope.astype(np.int32)),
+                jnp.asarray(self.active), jnp.asarray(room),
+            )
+        return (
+            np.asarray(jax.device_get(target)),
+            np.asarray(jax.device_get(n_emit)),
+        )
+
+    def _draft_admissions(self) -> None:
+        """Bring slots admitted this round into the draft model's cache:
+        the target prefilled their prompts during admission, so the draft
+        prefills the SAME prompts at the same bucket width into its own
+        flat cache (full prompts even under warm prefix hits — the draft
+        cache shares nothing) and the catch-up feed starts from the
+        admission's first emitted token."""
+        eng = self.eng
+        need = [
+            s for s in np.nonzero(self.active)[0] if self._spec_fed[s] is None
+        ]
+        if not need:
+            return
+        for s in need:
+            self._spec_fed[s] = [self.outputs[int(self.slot_req[s])][-1]]
+        import collections
+
+        by_bucket = collections.defaultdict(list)
+        for s in need:
+            by_bucket[int(self.base[s])].append(s)
+        C = eng.prefill_batch
+        for bucket, slots_ in sorted(by_bucket.items()):
+            for i in range(0, len(slots_), C):
+                chunk = slots_[i : i + C]
+                ids = np.full((C, bucket), eng.pad, np.int32)
+                mask = np.zeros((C, bucket), np.int32)
+                slot_idx = np.full((C,), eng.S, np.int32)
+                for r, s in enumerate(chunk):
+                    rid = int(self.slot_req[s])
+                    toks = self.requests[rid][:bucket]
+                    ids[r, : len(toks)] = toks
+                    mask[r, : len(toks)] = 1
+                    if self.attn_masks[rid] is not None:
+                        m = list(self.attn_masks[rid][:bucket])
+                        mask[r, : len(m)] = m
+                    slot_idx[r] = s
+                self.draft_state = eng.drafter.admit_prompt(
+                    self.draft_state, jnp.asarray(ids), jnp.asarray(mask),
+                    jnp.asarray(slot_idx),
+                )
+
+    def _spec_append(self, toks, n_emit, now, finished) -> int:
+        """Append one verify round's accepted-prefix + bonus tokens per
+        live slot, with the SAME eos/budget eviction as the plain loop —
+        a round whose accepted prefix crosses eos stops emitting there
+        (trailing accepted tokens are discarded with the slot; greedy
+        would never have decoded past eos either).  Returns the number of
+        tokens actually appended."""
+        eng, stats = self.eng, self.stats
+        appended = 0
+        slot_rounds = 0
+        for slot in np.nonzero(self.active)[0]:
+            rid = int(self.slot_req[slot])
+            n = int(n_emit[slot])
+            slot_rounds += 1
+            stats.spec_drafted += eng.spec
+            stats.spec_accepted += n - 1
+            fed: list[int] = []
+            evicted = False
+            for j in range(n):
+                tok = int(toks[slot, j])
+                self.outputs[rid].append(tok)
+                fed.append(tok)
+                appended += 1
+                if self.ttft[rid] is None:
+                    self.ttft[rid] = now - self.submit_t[rid]
+                    self.first_tok_wall[rid] = now
+                self.emitted[slot] += 1
+                if tok == eng.eos or self.emitted[slot] >= self.budgets[rid]:
+                    self._evict_slot(slot)
+                    self._finish_request(rid, slot, now)
+                    finished.append(rid)
+                    evicted = True
+                    break
+            if not evicted:
+                self._spec_fed[slot] = fed
+        stats.spec_steps += 1
+        stats.spec_slot_rounds += slot_rounds
+        stats.spec_emitted += appended
+        self._win_spec_steps += slot_rounds
+        self._win_spec_emitted += appended
+        return appended
+
     def _step_round(self) -> list[int]:
         if self._finalized:
             raise RuntimeError("session already finalized")
@@ -1474,7 +1732,9 @@ class ServeSession:
             self.emitted if eng.is_seq2seq else (self.base + self.emitted - 1)
         )
         t0 = time.perf_counter()
-        if eng.is_seq2seq:
+        if eng.spec:
+            spec_toks, spec_emit = self._spec_dispatch(offsets)
+        elif eng.is_seq2seq:
             tokens, self.state = eng._step(
                 self.params, self.state,
                 jnp.asarray(offsets.astype(np.int32)),
@@ -1497,33 +1757,41 @@ class ServeSession:
                 jnp.asarray(rope.astype(np.int32)),
                 jnp.asarray(self.active),
             )
-        toks = np.asarray(jax.device_get(tokens))
+        if not eng.spec:
+            toks = np.asarray(jax.device_get(tokens))
         dt = time.perf_counter() - t0
         self.stats.decode_seconds += dt
         self.stats.decode_steps += 1
         self.progress += 1
         self._win_decode += dt
         n_active = self.active_count
-        self.stats.decode_tokens += n_active
         self.stats.slot_occupancy += n_active / eng.S
-        self._win_tokens += n_active
         self._win_occ += n_active / eng.S
         self._bpt_samples.append(
             self._bytes_in_use() / max(self._live_tokens(), 1)
         )
         now = time.perf_counter()
-        for slot in np.nonzero(self.active)[0]:
-            rid = int(self.slot_req[slot])
-            tok = int(toks[slot])
-            self.outputs[rid].append(tok)
-            if self.ttft[rid] is None:
-                self.ttft[rid] = now - self.submit_t[rid]
-                self.first_tok_wall[rid] = now
-            self.emitted[slot] += 1
-            if tok == eng.eos or self.emitted[slot] >= self.budgets[rid]:
-                self._evict_slot(slot)  # slot (and its blocks) free NOW
-                self._finish_request(rid, slot, now)
-                finished.append(rid)
+        if eng.spec:
+            # a verify round appends 1..k+1 tokens per slot — the
+            # accounting counts tokens actually emitted, so tok/s stays
+            # an honest cross-mode comparison
+            appended = self._spec_append(spec_toks, spec_emit, now, finished)
+        else:
+            appended = n_active
+            for slot in np.nonzero(self.active)[0]:
+                rid = int(self.slot_req[slot])
+                tok = int(toks[slot])
+                self.outputs[rid].append(tok)
+                if self.ttft[rid] is None:
+                    self.ttft[rid] = now - self.submit_t[rid]
+                    self.first_tok_wall[rid] = now
+                self.emitted[slot] += 1
+                if tok == eng.eos or self.emitted[slot] >= self.budgets[rid]:
+                    self._evict_slot(slot)  # slot (and its blocks) free NOW
+                    self._finish_request(rid, slot, now)
+                    finished.append(rid)
+        self.stats.decode_tokens += appended
+        self._win_tokens += appended
         every = eng.serve.log_every_steps
         if every and self.stats.decode_steps % every == 0:
             w_dt = max(now - self._win_t0, 1e-9)
@@ -1574,12 +1842,23 @@ class ServeSession:
                     window["warm_bytes"] = (
                         eng.pool.blocks_warm * self._per_block
                     )
+            if eng.spec:
+                # the speculative ledger live: window-local multi-token
+                # yield + the cumulative draft acceptance rate
+                window["accepted_tokens_per_step"] = round(
+                    self._win_spec_emitted / max(self._win_spec_steps, 1), 4
+                )
+                window["acceptance_rate"] = round(
+                    self.stats.spec_accepted
+                    / max(self.stats.spec_drafted, 1), 4
+                )
             if self.replica is not None:
                 window["replica"] = int(self.replica)
             log_json(window)
             self._win_tokens, self._win_t0, self._win_occ = 0, now, 0.0
             self._win_prefill, self._win_decode = 0.0, 0.0
             self._win_arrivals, self._win_done = 0, 0
+            self._win_spec_steps, self._win_spec_emitted = 0, 0
         return finished
 
     # ------------------------------------------------------------ closing
@@ -1685,6 +1964,24 @@ class ServeSession:
                 summary["warm_bytes"] = (
                     eng.pool.blocks_warm * self._per_block
                 )
+        if eng.spec:
+            # the speculative-decode ledger: how many target tokens each
+            # verify round yielded (accepted_tokens_per_step > 1.0 is the
+            # win) and how often drafts survived the target's argmax —
+            # the serve-spec bench and the --min-acceptance-rate strict
+            # gate read straight off this block
+            summary["spec_decode"] = True
+            summary["spec_tokens"] = eng.spec
+            summary["spec_draft_model"] = eng.serve.spec_draft_model or "ngram"
+            summary["spec_steps"] = stats.spec_steps
+            summary["spec_drafted_tokens"] = stats.spec_drafted
+            summary["spec_accepted_tokens"] = stats.spec_accepted
+            summary["accepted_tokens_per_step"] = round(
+                stats.spec_emitted / max(stats.spec_slot_rounds, 1), 4
+            )
+            summary["acceptance_rate"] = round(
+                stats.spec_accepted / max(stats.spec_drafted, 1), 4
+            )
         if self.replica is not None:
             summary["replica"] = int(self.replica)
         # the shared bucketed account (params + kv_cache over the one

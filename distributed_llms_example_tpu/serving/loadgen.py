@@ -79,7 +79,8 @@ def chatbot_requests(
     reply_len: tuple[int, int] = (2, 6),
     shared_frac: float = 0.9,
     max_len: int = 0,
-) -> tuple[list[list[int]], list[str]]:
+    with_budgets: bool = False,
+) -> tuple:
     """The shared-prefix chat mix: (requests, session_keys) in arrival
     order — the workload the prefix cache exists for.
 
@@ -102,7 +103,17 @@ def chatbot_requests(
     requests AND keys, the same replay contract as
     ``arrival_schedule``.  ``session_keys`` feed the router's session
     affinity so a conversation's turns land on the replica whose pool
-    holds its blocks."""
+    holds its blocks.
+
+    ``with_budgets=True`` returns ``(requests, session_keys,
+    decode_budgets)`` instead, where each budget is the length of the
+    turn's synthetic assistant reply — the number of tokens the engine
+    would decode to reproduce the scripted conversation.  The budgets
+    come from the SAME ``reply_len`` draws that extend the histories
+    (no extra rng consumption), so the 2-tuple and 3-tuple forms of one
+    seed describe the identical conversation; spec-decode A/B runs use
+    them as per-request ``max_new_tokens`` so both legs decode the same
+    token counts."""
     if sessions < 1 or turns < 1:
         raise ValueError("sessions and turns must be >= 1")
     if not 0.0 <= shared_frac <= 1.0:
@@ -118,13 +129,18 @@ def chatbot_requests(
     ]
     reqs: list[list[int]] = []
     keys: list[str] = []
+    budgets: list[int] = []
     for _t in range(turns):
         for s in range(sessions):
             hist[s] = hist[s] + draw(span(user_len))
             prompt = hist[s][:max_len] if max_len else list(hist[s])
             reqs.append(prompt)
             keys.append(f"session-{s}")
-            hist[s] = hist[s] + draw(span(reply_len))
+            reply = draw(span(reply_len))
+            budgets.append(len(reply))
+            hist[s] = hist[s] + reply
+    if with_budgets:
+        return reqs, keys, budgets
     return reqs, keys
 
 
@@ -555,8 +571,23 @@ def sweep_qps(
     router), the SAME request set and the SAME arrival seed throughout,
     so points differ only by offered rate.  Emits one ``loadgen_point``
     per grid point and a final ``loadgen_summary`` carrying the whole
-    curve + knee; returns the summary dict."""
+    curve + knee; returns the summary dict.
+
+    When ``budgets`` is given (the chatbot mix's per-turn decode
+    lengths), every ``loadgen_point`` and the summary carry
+    ``decode_budget_tokens``/``decode_budget_mean`` so a later
+    spec-vs-plain comparison can confirm both sweeps decoded the same
+    scripted token counts — apples-to-apples, stamped in the JSONL
+    rather than re-derived."""
     points: list[dict] = []
+    budget_stamp: dict = {}
+    if budgets is not None and len(budgets) > 0:
+        budget_stamp = {
+            "decode_budget_tokens": int(sum(int(b) for b in budgets)),
+            "decode_budget_mean": round(
+                float(sum(int(b) for b in budgets)) / len(budgets), 2
+            ),
+        }
     for qps in cfg.qps_grid:
         schedule = arrival_schedule(
             cfg.process, qps=float(qps), n=len(requests), seed=cfg.seed,
@@ -571,6 +602,7 @@ def sweep_qps(
             rows, offered_qps=float(qps), ttft_slo_ms=cfg.ttft_slo_ms,
             wall_s=wall_s,
         )
+        point.update(budget_stamp)
         points.append(point)
         if emit:
             log_json({
@@ -588,6 +620,7 @@ def sweep_qps(
         "ttft_slo_ms": round(float(cfg.ttft_slo_ms), 1),
         "track_tol": cfg.track_tol,
         "knee_qps": knee,
+        **budget_stamp,
         "points": points,
     }
     if emit:

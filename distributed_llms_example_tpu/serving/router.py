@@ -777,6 +777,31 @@ class ReplicaRouter:
             summary["prefill_tokens_saved_frac"] = round(
                 saved / max(total, 1), 4
             )
+        if any(getattr(r.engine, "spec", 0) for r in self.replicas):
+            # tier-wide speculative-decode ledger over surviving replicas
+            # — the degraded-mode leg reads its multi-token yield off the
+            # SAME fields, so "the speedup survives a replica kill" is a
+            # router_summary claim, not a per-replica one
+            drafted = accepted = emitted = rounds = 0
+            for r in self.replicas:
+                st = r.engine.last_stats
+                if st is None:
+                    continue
+                drafted += st.spec_drafted
+                accepted += st.spec_accepted
+                emitted += st.spec_emitted
+                rounds += st.spec_slot_rounds
+            summary["spec_tokens"] = max(
+                getattr(r.engine, "spec", 0) for r in self.replicas
+            )
+            summary["spec_drafted_tokens"] = drafted
+            summary["spec_accepted_tokens"] = accepted
+            summary["acceptance_rate"] = round(
+                accepted / max(drafted, 1), 4
+            )
+            summary["accepted_tokens_per_step"] = round(
+                emitted / max(rounds, 1), 4
+            )
         if self.t_fail is not None:
             summary["t_fail_s"] = round(self.t_fail - self.t_open, 4)
             if self.t_recovered is not None:

@@ -61,6 +61,10 @@ _HIGHER_BETTER = (
     # prefix cache (serving/cache_pool.py): more reuse is the whole
     # point — a higher hit rate / saved fraction means less prefill work
     "hit_rate", "prefill_tokens_saved",
+    # speculative decode (serving/spec.py): more drafts surviving the
+    # target's argmax and more tokens per verify round mean fewer decode
+    # dispatches per emitted token — tok/s leaves are covered above
+    "acceptance_rate", "accepted_tokens_per_step", "vs_plain",
 )
 _LOWER_BETTER = (
     "_ms", "ttft", "wall_s", "_seconds", "overhead", "exposed_",
@@ -106,6 +110,13 @@ _CONFIG_LEAVES = (
     # the warm-retention byte budget is an LRU ceiling, not a
     # measurement: growing it between rounds is a config change
     "prefix_cache_budget",
+    # speculative-decode knobs: the draft count and draft-model choice
+    # are experiment settings — retuning k between rounds is
+    # information, never a regression ("spec_tokens" matches only the
+    # config leaf; the drafted/accepted LEDGER leaves are
+    # spec_drafted_tokens / spec_accepted_tokens, which it does not
+    # substring-match)
+    "spec_tokens", "spec_draft_model",
 )
 
 

@@ -96,6 +96,15 @@ def main(argv: list[str] | None = None) -> int:
              "unmeasured (0 = off)",
     )
     p.add_argument(
+        "--min-acceptance-rate", type=float, default=0.0,
+        help="optional speculative-decode gate: fail when the draft "
+             "acceptance rate (router aggregate when one exists, else "
+             "the last spec-enabled serve_summary) falls below this "
+             "floor, or when NO spec-enabled summary was emitted — a "
+             "round that silently loses --spec-tokens fails instead of "
+             "passing unmeasured (0 = off)",
+    )
+    p.add_argument(
         "--max-peak-hbm-frac", type=float, default=0.0,
         help="optional memory gate: fail when the measured HBM peak "
              "(runtime memory_window where sampled, else the static "
@@ -139,6 +148,8 @@ def main(argv: list[str] | None = None) -> int:
         flags += ["--max-p99-ttft-ms", str(args.max_p99_ttft_ms)]
     if args.min_prefix_hit_rate > 0:
         flags += ["--min-prefix-hit-rate", str(args.min_prefix_hit_rate)]
+    if args.min_acceptance_rate > 0:
+        flags += ["--min-acceptance-rate", str(args.min_acceptance_rate)]
     if args.max_peak_hbm_frac > 0:
         flags += ["--max-peak-hbm-frac", str(args.max_peak_hbm_frac)]
     if args.min_hbm_headroom_gib > 0:

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo conventions the type system cannot hold.
 
-Fifteen rules, all born from real regressions at TPU scale:
+Seventeen rules, all born from real regressions at TPU scale:
 
 1. **No host syncs in the train-step hot path.**  ``jax.device_get`` /
    ``.block_until_ready()`` inside ``train/step.py`` stall async dispatch —
@@ -180,6 +180,24 @@ Fifteen rules, all born from real regressions at TPU scale:
    never reach the ``memory_window`` events the "Where did the bytes
    go" report renders.  Readers call ``memprof.hbm_stats()`` /
    ``Watermark`` — one read path, one semantics.
+
+16. **No KV-block identity outside ``serving/cache_pool.py``.**  The
+   chained content hash and the refcount ledger ARE the correctness
+   argument for cross-request block sharing: a second hash definition
+   in serving/ forks the identity (two prefixes collide, or identical
+   prefixes stop matching), and a refcount poked from outside the
+   owner breaks the refcount == live-references invariant its own
+   ``ref_invariant_violations()`` audits.  Everyone else uses the
+   public API: chain_hashes / match_chain / acquire / register / free
+   / drop_warm.
+
+17. **No speculative-decode acceptance math outside ``serving/spec.py``
+   (+ the cache_pool span scatter).**  The acceptance rule IS the
+   bit-identity contract — accept the longest draft == target-argmax
+   prefix, emit the target's bonus token, rebuild the mask span.  An
+   inline draft-vs-target compare or cumprod prefix fold in the engine
+   or router is a second copy of that contract; the copies drift, and
+   "spec output == greedy output" stops being one provable property.
 
 Run: ``python scripts/repo_lint.py`` (nonzero exit on violations).  Wired
 into the fast test suite (tests/test_analysis.py, tests/test_obs.py,
@@ -372,6 +390,23 @@ PREFIX_IDENTITY_OWNER = os.path.join(PACKAGE, "serving", "cache_pool.py")
 _PREFIX_LEDGER_ATTRS = ("_ref", "_hash_of", "_index", "_lru")
 _PREFIX_HASH_MODULE = "hashlib"
 PREFIX_HASH_RULE_DIRS = (os.path.join(PACKAGE, "serving"),)
+
+# Rule 17: speculative-decode acceptance/rollback math is owned by
+# serving/spec.py (the acceptance rule IS the bit-identity contract:
+# accept the longest draft == target-argmax prefix, then the target's
+# own bonus token) and serving/cache_pool.py (the span scatter whose
+# sentinel discipline keeps speculative writes inside owned blocks).  A
+# second acceptance expression inline in the engine or router — a
+# draft-vs-target token compare, or the cumprod longest-prefix fold —
+# forks the contract: the two copies drift, and "spec output ==
+# greedy output" silently stops being one provable property.
+SPEC_DECODE_OWNERS = {
+    os.path.join(PACKAGE, "serving", "spec.py"),
+    PREFIX_IDENTITY_OWNER,
+}
+SPEC_DECODE_RULE_DIRS = (os.path.join(PACKAGE, "serving"),)
+_SPEC_DRAFT_NAMES = ("draft", "drafts", "proposed", "spec_toks")
+_SPEC_TARGET_NAMES = ("target", "argmax", "verified")
 
 
 def _names_contain_lr(node: ast.AST) -> bool:
@@ -694,6 +729,49 @@ def _prefix_identity_violations(tree: ast.AST, rel: str) -> list[str]:
     return violations
 
 
+def _spec_decode_violations(tree: ast.AST, rel: str) -> list[str]:
+    """Rule 17: speculative acceptance/rollback math in serving/ outside
+    its owners — a ``cumprod`` call (the longest-accepted-prefix fold)
+    or an Eq compare whose one side is draft-named and other side
+    target-named (the acceptance comparison itself)."""
+    if not any(rel.startswith(d + os.sep) for d in SPEC_DECODE_RULE_DIRS):
+        return []
+    violations: list[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and (
+            (isinstance(node.func, ast.Attribute) and node.func.attr == "cumprod")
+            or (isinstance(node.func, ast.Name) and node.func.id == "cumprod")
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: cumprod in serving/ outside "
+                "serving/spec.py — the longest-accepted-prefix fold is "
+                "the speculative acceptance rule, owned by "
+                "spec.acceptance_lengths; a second copy drifts from the "
+                "bit-identity contract"
+            )
+        elif isinstance(node, ast.Compare) and any(
+            isinstance(op, ast.Eq) for op in node.ops
+        ):
+            sides = [node.left] + list(node.comparators)
+            names = [_names_in(s) for s in sides]
+            drafty = any(
+                any(any(d in n for d in _SPEC_DRAFT_NAMES) for n in ns)
+                for ns in names
+            )
+            targety = any(
+                any(any(t in n for t in _SPEC_TARGET_NAMES) for n in ns)
+                for ns in names
+            )
+            if drafty and targety:
+                violations.append(
+                    f"{rel}:{node.lineno}: draft-vs-target token compare "
+                    "in serving/ outside serving/spec.py — inline "
+                    "acceptance logic forks the bit-identity contract; "
+                    "call spec.acceptance_lengths"
+                )
+    return violations
+
+
 def _trace_emit_violations(tree: ast.AST, rel: str) -> list[str]:
     violations: list[str] = []
     for node in ast.walk(tree):
@@ -961,6 +1039,8 @@ def lint_file(path: str, rel: str) -> list[str]:
         violations.extend(_memstats_violations(tree, rel))
     if rel != PREFIX_IDENTITY_OWNER:
         violations.extend(_prefix_identity_violations(tree, rel))
+    if rel not in SPEC_DECODE_OWNERS:
+        violations.extend(_spec_decode_violations(tree, rel))
     # rule 5: does this file import Dropout from the shared helper?
     helper_dropout_import = any(
         isinstance(n, ast.ImportFrom)
